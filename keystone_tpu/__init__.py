@@ -1,0 +1,50 @@
+"""keystone_tpu: a TPU-native large-scale ML pipeline framework.
+
+A ground-up re-design of the capabilities of KeystoneML (AMPLab's
+Spark/Scala pipeline framework, surveyed in SURVEY.md) for TPUs: type-safe
+composable Transformer/Estimator pipelines over an optimizing DAG, executed
+on `jax.sharding.Mesh` device meshes with XLA collectives instead of a
+Spark cluster, with distributed linear algebra (normal equations, block
+coordinate descent, TSQR) as sharded JAX programs and image/NLP feature
+kernels as TPU-friendly ops.
+"""
+from .parallel.dataset import ArrayDataset, Dataset, HostDataset, as_dataset
+from .parallel.mesh import get_mesh, make_mesh, mesh_scope, set_mesh
+from .workflow import (
+    Cacher,
+    Estimator,
+    FittedPipeline,
+    Identity,
+    LabelEstimator,
+    Pipeline,
+    PipelineDataset,
+    PipelineDatum,
+    PipelineEnv,
+    Transformer,
+    transformer,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ArrayDataset",
+    "Dataset",
+    "HostDataset",
+    "as_dataset",
+    "get_mesh",
+    "make_mesh",
+    "mesh_scope",
+    "set_mesh",
+    "Cacher",
+    "Estimator",
+    "FittedPipeline",
+    "Identity",
+    "LabelEstimator",
+    "Pipeline",
+    "PipelineDataset",
+    "PipelineDatum",
+    "PipelineEnv",
+    "Transformer",
+    "transformer",
+    "__version__",
+]

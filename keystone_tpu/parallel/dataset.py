@@ -1,0 +1,216 @@
+"""Dataset: the distributed-collection substrate replacing Spark RDDs.
+
+The reference framework's data model is ``RDD[T]`` — a lazily evaluated,
+partitioned collection (SURVEY.md layer 0). The TPU-native equivalent is:
+
+* `ArrayDataset` — a pytree of batch-major `jax.Array`s whose leading
+  (example) dimension is sharded over the mesh ``data`` axis. Per-item
+  transforms become ``jit(vmap(f))`` over the sharded batch, which is the
+  analogue of the reference's per-partition GEMM batching
+  (``utils/MatrixUtils.scala:48`` ``rowsToMatrixIter`` + per-partition map).
+  Since shard counts must divide the leading dim, the batch is padded with
+  zero rows up to a multiple of the shard count; ``n`` records the true
+  item count and padded rows are re-zeroed after every map so linear
+  reductions (sums, Grams) stay exact.
+* `HostDataset` — a plain Python list of items for host-side stages
+  (tokenization, ragged features, IO), the analogue of RDDs of JVM objects
+  that never touch BLAS.
+
+Laziness lives one level up, in ``workflow.expression`` (as in the
+reference's ``workflow/graph/Expression.scala``) — datasets themselves are
+eager, like a cached RDD.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS, batch_sharding, get_mesh, num_data_shards
+
+
+def _pad_to(x: np.ndarray, rows: int) -> np.ndarray:
+    if x.shape[0] == rows:
+        return x
+    pad = [(0, rows - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+    return np.pad(x, pad)
+
+
+class Dataset:
+    """Abstract distributed collection of items."""
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        raise NotImplementedError
+
+    def collect(self) -> List[Any]:
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def cache(self) -> "Dataset":
+        return self
+
+
+class ArrayDataset(Dataset):
+    """Batch-major, mesh-sharded, zero-padded dataset of fixed-shape items.
+
+    ``data`` is a pytree of arrays sharing leading dim ``padded_n``; rows at
+    index >= n are zero. All arrays are sharded ``P('data')`` on ``mesh``.
+    """
+
+    def __init__(self, data: Any, n: int, mesh: Optional[Mesh] = None,
+                 _already_sharded: bool = False):
+        self.mesh = mesh or get_mesh()
+        self.n = int(n)
+        if _already_sharded:
+            self.data = data
+        else:
+            self.data = _shard_pytree(data, self.n, self.mesh)
+
+    # -- construction -----------------------------------------------------
+    @staticmethod
+    def from_numpy(array: Any, mesh: Optional[Mesh] = None) -> "ArrayDataset":
+        leaves = jax.tree_util.tree_leaves(array)
+        if not leaves:
+            raise ValueError("empty pytree")
+        n = leaves[0].shape[0]
+        return ArrayDataset(array, n, mesh)
+
+    @staticmethod
+    def from_items(items: Sequence[Any], mesh: Optional[Mesh] = None) -> "ArrayDataset":
+        stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *items)
+        return ArrayDataset.from_numpy(stacked, mesh)
+
+    # -- properties -------------------------------------------------------
+    @property
+    def padded_n(self) -> int:
+        return jax.tree_util.tree_leaves(self.data)[0].shape[0]
+
+    @property
+    def mask(self) -> jax.Array:
+        """bool[padded_n], True for real rows."""
+        return _row_mask(self.padded_n, self.n, self.mesh)
+
+    def __len__(self) -> int:
+        return self.n
+
+    # -- transforms -------------------------------------------------------
+    def map(self, fn: Callable[[Any], Any]) -> "ArrayDataset":
+        """Apply a per-item pure function, batched via vmap under jit."""
+        out = _masked_vmap(fn, self.data, self.n, self.padded_n, self.mesh)
+        return ArrayDataset(out, self.n, self.mesh, _already_sharded=True)
+
+    def map_batch(self, fn: Callable[[Any], Any]) -> "ArrayDataset":
+        """Apply a whole-batch function (padded rows included; fn must keep
+        leading dim and should preserve zero padding or rely on re-masking)."""
+        out = fn(self.data)
+        out = _apply_mask(out, self.n, self.mesh)
+        return ArrayDataset(out, self.n, self.mesh, _already_sharded=True)
+
+    def zip(self, *others: "ArrayDataset") -> "ArrayDataset":
+        """Zip datasets of equal length into a dataset of tuples."""
+        for o in others:
+            if o.n != self.n:
+                raise ValueError("zip requires equal lengths")
+        data = (self.data,) + tuple(o.data for o in others)
+        pn = max([self.padded_n] + [o.padded_n for o in others])
+        data = jax.tree_util.tree_map(
+            lambda x: _repad(x, pn, self.mesh), data)
+        return ArrayDataset(data, self.n, self.mesh, _already_sharded=True)
+
+    # -- materialization --------------------------------------------------
+    def numpy(self) -> Any:
+        """Gather to host as a numpy pytree, padding stripped."""
+        return jax.tree_util.tree_map(lambda x: np.asarray(x)[: self.n], self.data)
+
+    def collect(self) -> List[Any]:
+        arr = self.numpy()
+        return [jax.tree_util.tree_map(lambda x: x[i], arr) for i in range(self.n)]
+
+
+class HostDataset(Dataset):
+    """Host-resident list-backed dataset for ragged / non-numeric stages."""
+
+    def __init__(self, items: Iterable[Any]):
+        self.items = list(items)
+
+    def map(self, fn: Callable[[Any], Any]) -> "HostDataset":
+        return HostDataset([fn(x) for x in self.items])
+
+    def collect(self) -> List[Any]:
+        return list(self.items)
+
+    def __len__(self) -> int:
+        return len(self.items)
+
+    def to_device(self, mesh: Optional[Mesh] = None) -> ArrayDataset:
+        return ArrayDataset.from_items(
+            [np.asarray(x) for x in self.items], mesh)
+
+
+def as_dataset(data: Any, mesh: Optional[Mesh] = None) -> Dataset:
+    if isinstance(data, Dataset):
+        return data
+    if isinstance(data, (list, tuple)) and data and not hasattr(data[0], "shape"):
+        return HostDataset(data)
+    if isinstance(data, (list, tuple)):
+        return ArrayDataset.from_items(list(data), mesh)
+    return ArrayDataset.from_numpy(data, mesh)
+
+
+# -- internals ------------------------------------------------------------
+
+def _padded_rows(n: int, mesh: Mesh) -> int:
+    k = num_data_shards(mesh)
+    return max(((n + k - 1) // k) * k, k)
+
+
+def _shard_pytree(data: Any, n: int, mesh: Mesh) -> Any:
+    rows = _padded_rows(n, mesh)
+    sh = batch_sharding(mesh)
+
+    def put(x):
+        x = np.asarray(x)
+        if x.shape[0] != n:
+            raise ValueError(f"leading dim {x.shape[0]} != n={n}")
+        return jax.device_put(_pad_to(x, rows), sh)
+
+    return jax.tree_util.tree_map(put, data)
+
+
+def _row_mask(padded_n: int, n: int, mesh: Mesh) -> jax.Array:
+    mask = np.zeros(padded_n, dtype=bool)
+    mask[:n] = True
+    return jax.device_put(mask, batch_sharding(mesh))
+
+
+@jax.jit
+def _zero_masked_rows(x: jax.Array, mask: jax.Array) -> jax.Array:
+    return jnp.where(
+        mask.reshape((-1,) + (1,) * (x.ndim - 1)), x, jnp.zeros((), x.dtype)
+    )
+
+
+def _apply_mask(data: Any, n: int, mesh: Mesh) -> Any:
+    leaves = jax.tree_util.tree_leaves(data)
+    pn = leaves[0].shape[0]
+    if n >= pn:
+        return data
+    mask = _row_mask(pn, n, mesh)
+    return jax.tree_util.tree_map(lambda x: _zero_masked_rows(x, mask), data)
+
+
+def _repad(x: jax.Array, rows: int, mesh: Mesh) -> jax.Array:
+    if x.shape[0] == rows:
+        return x
+    pad = [(0, rows - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+    return jax.device_put(jnp.pad(x, pad), batch_sharding(mesh))
+
+
+def _masked_vmap(fn, data, n: int, padded_n: int, mesh: Mesh):
+    out = jax.jit(jax.vmap(fn))(data)
+    return _apply_mask(out, n, mesh) if n < padded_n else out

@@ -1,0 +1,88 @@
+"""Device-mesh management for the TPU-native execution substrate.
+
+The reference runs every distributed operation through a ``SparkContext``
+over cluster executors. Here the substrate is a `jax.sharding.Mesh`: data
+parallelism shards the example/batch dimension over the ``data`` axis, and
+the feature-block / model dimension may be sharded over a ``model`` axis
+(see SURVEY.md section 2.14 for the strategy mapping).
+
+A single process-global mesh plays the role of the reference's implicit
+global SparkContext (``pipelines/*`` apps construct one ``sc`` per run).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+_lock = threading.Lock()
+_global_mesh: Optional[Mesh] = None
+
+
+def make_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    data: Optional[int] = None,
+    model: int = 1,
+) -> Mesh:
+    """Build a ('data', 'model') mesh over the given (default: all) devices."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if data is None:
+        data = n // model
+    if data * model != n:
+        raise ValueError(f"mesh shape {data}x{model} != {n} devices")
+    arr = np.asarray(devices).reshape(data, model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def set_mesh(mesh: Optional[Mesh]) -> None:
+    global _global_mesh
+    with _lock:
+        _global_mesh = mesh
+
+
+def get_mesh() -> Mesh:
+    """The process-global mesh, lazily built over all visible devices."""
+    global _global_mesh
+    with _lock:
+        if _global_mesh is None:
+            _global_mesh = make_mesh()
+        return _global_mesh
+
+
+@contextlib.contextmanager
+def mesh_scope(mesh: Mesh):
+    """Temporarily replace the global mesh (tests, multi-mesh programs)."""
+    global _global_mesh
+    with _lock:
+        prev = _global_mesh
+        _global_mesh = mesh
+    try:
+        yield mesh
+    finally:
+        with _lock:
+            _global_mesh = prev
+
+
+def num_data_shards(mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or get_mesh()
+    return mesh.shape[DATA_AXIS]
+
+
+def batch_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
+    """Sharding for a batch-major array: rows split over the data axis."""
+    mesh = mesh or get_mesh()
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
+    mesh = mesh or get_mesh()
+    return NamedSharding(mesh, P())

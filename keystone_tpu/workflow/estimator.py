@@ -1,0 +1,65 @@
+"""Estimator: fits on a dataset, yielding a Transformer.
+
+Mirrors ``workflow/Estimator.scala`` / ``workflow/graph/Estimator.scala``:
+``fit`` is the eager user-facing entry; ``with_data`` builds the lazy
+3-node fit-time subgraph (data -> estimator -> delegating transformer)
+whose estimator executes only when the pipeline is first used.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ..parallel.dataset import Dataset, as_dataset
+from .graph import Graph
+from .operators import DelegatingOperator, EstimatorOperator
+from .pipeline import DataInput, Pipeline, _add_data_input
+from .transformer import Transformer
+
+
+class Estimator(EstimatorOperator):
+    def fit(self, data: Any) -> Transformer:
+        """Eagerly fit on a dataset (or raw arrays), returning the fitted
+        transformer (reference ``Estimator.fit``, Estimator.scala:20)."""
+        from .pipeline import PipelineDataset
+
+        if isinstance(data, PipelineDataset):
+            data = data.get()
+        return self._fit(as_dataset(data))
+
+    def _fit(self, ds: Dataset) -> Transformer:
+        raise NotImplementedError
+
+    def fit_datasets(self, inputs):
+        return self._fit(inputs[0])
+
+    def with_data(self, data: DataInput) -> Pipeline:
+        """Lazy pipeline: source -> (fitted on ``data``) -> sink
+        (reference ``withData``, Estimator.scala:32-39)."""
+        g = Graph()
+        g, data_id = _add_data_input(g, data)
+        g, est_id = g.add_node(self, (data_id,))
+        g, src = g.add_source()
+        g, dl = g.add_node(DelegatingOperator(), (est_id, src))
+        g, sink = g.add_sink(dl)
+        return Pipeline(g, src, sink)
+
+
+class LambdaEstimator(Estimator):
+    """Function lift (reference Estimator.scala:51-53)."""
+
+    def __init__(self, fn: Callable[[Dataset], Transformer], name: str = "LambdaEst"):
+        self.fn = fn
+        self.name = name
+
+    def eq_key(self):
+        return (LambdaEstimator, self.fn, self.name)
+
+    def _fit(self, ds: Dataset) -> Transformer:
+        return self.fn(ds)
+
+    def label(self) -> str:
+        return self.name
+
+
+def estimator(fn: Callable[[Dataset], Transformer]) -> LambdaEstimator:
+    return LambdaEstimator(fn, getattr(fn, "__name__", "LambdaEst"))

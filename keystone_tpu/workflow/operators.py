@@ -1,0 +1,175 @@
+"""Operator algebra: the untyped execution layer under the typed API.
+
+Mirrors ``workflow/graph/Operator.scala`` — each DAG node holds an
+Operator; ``execute`` consumes the dependencies' lazy Expressions and
+returns a lazy Expression. Type dispatch between per-datum and batch
+execution follows ``Operator.scala:66-100`` (TransformerOperator applies
+``batch_transform`` iff any input is a dataset).
+
+Operator equality drives common-subexpression elimination and the prefix
+cache (reference ``EquivalentNodeMergeRule.scala``, ``Prefix.scala``): two
+operators are equal iff their ``eq_key()`` match. The default key is the
+class plus all public, hashable ``__dict__`` entries, so parameterized
+nodes written as plain classes get structural equality for free; nodes
+holding unhashable state override ``eq_key``.
+"""
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import numpy as np
+
+from ..parallel.dataset import Dataset
+from .expression import (
+    DatasetExpression,
+    DatumExpression,
+    Expression,
+    TransformerExpression,
+)
+
+
+def _hashable(v: Any) -> Any:
+    """Best-effort conversion of a parameter value to a hashable token."""
+    if isinstance(v, np.ndarray):
+        return ("ndarray", v.shape, str(v.dtype), v.tobytes())
+    if hasattr(v, "shape") and hasattr(v, "dtype"):  # jax.Array
+        arr = np.asarray(v)
+        return ("array", arr.shape, str(arr.dtype), arr.tobytes())
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    try:
+        hash(v)
+        return v
+    except TypeError:
+        return id(v)
+
+
+class Operator:
+    """A unit of computation stored at a graph node."""
+
+    def execute(self, deps: Sequence[Expression]) -> Expression:
+        raise NotImplementedError
+
+    def label(self) -> str:
+        return type(self).__name__
+
+    def eq_key(self) -> Tuple:
+        items = tuple(
+            (k, _hashable(v))
+            for k, v in sorted(self.__dict__.items())
+            if not k.startswith("_")
+        )
+        return (type(self),) + items
+
+    def __eq__(self, other: Any) -> bool:
+        return type(self) is type(other) and self.eq_key() == other.eq_key()
+
+    def __hash__(self) -> int:
+        return hash(self.eq_key())
+
+
+class DatasetOperator(Operator):
+    """A constant dataset (reference ``DatasetOperator``, Operator.scala:25-33)."""
+
+    def __init__(self, dataset: Dataset):
+        self.dataset = dataset
+
+    def eq_key(self) -> Tuple:
+        return (DatasetOperator, id(self.dataset))
+
+    def execute(self, deps: Sequence[Expression]) -> Expression:
+        assert not deps
+        return DatasetExpression(self.dataset, eager=True)
+
+    def label(self) -> str:
+        return "Dataset"
+
+
+class DatumOperator(Operator):
+    """A constant single item (reference ``DatumOperator``, Operator.scala:41-52)."""
+
+    def __init__(self, datum: Any):
+        self.datum = datum
+
+    def eq_key(self) -> Tuple:
+        return (DatumOperator, id(self.datum))
+
+    def execute(self, deps: Sequence[Expression]) -> Expression:
+        assert not deps
+        return DatumExpression(self.datum, eager=True)
+
+    def label(self) -> str:
+        return "Datum"
+
+
+class TransformerOperator(Operator):
+    """An operator transforming data, with per-datum and batch paths
+    (reference ``TransformerOperator``, Operator.scala:66-100)."""
+
+    def single_transform(self, inputs: Sequence[Any]) -> Any:
+        raise NotImplementedError
+
+    def batch_transform(self, inputs: Sequence[Dataset]) -> Dataset:
+        raise NotImplementedError
+
+    def execute(self, deps: Sequence[Expression]) -> Expression:
+        if any(isinstance(d, DatasetExpression) for d in deps):
+            return DatasetExpression(
+                lambda: self.batch_transform([d.get() for d in deps])
+            )
+        return DatumExpression(
+            lambda: self.single_transform([d.get() for d in deps])
+        )
+
+
+class EstimatorOperator(Operator):
+    """Fits on datasets, yielding a TransformerOperator
+    (reference ``EstimatorOperator.fitRDDs``, Operator.scala:112-125)."""
+
+    def fit_datasets(self, inputs: Sequence[Dataset]) -> TransformerOperator:
+        raise NotImplementedError
+
+    def execute(self, deps: Sequence[Expression]) -> Expression:
+        return TransformerExpression(
+            lambda: self.fit_datasets([d.get() for d in deps])
+        )
+
+
+class DelegatingOperator(Operator):
+    """Applies a fitted transformer produced upstream: dep 0 is the
+    TransformerExpression, the rest are data (reference
+    ``DelegatingOperator``, Operator.scala:135-164)."""
+
+    def execute(self, deps: Sequence[Expression]) -> Expression:
+        assert deps, "delegating operator requires a transformer dependency"
+        t, data = deps[0], deps[1:]
+        assert isinstance(t, TransformerExpression)
+        if any(isinstance(d, DatasetExpression) for d in data):
+            return DatasetExpression(
+                lambda: t.get().batch_transform([d.get() for d in data])
+            )
+        return DatumExpression(
+            lambda: t.get().single_transform([d.get() for d in data])
+        )
+
+    def label(self) -> str:
+        return "Delegate"
+
+
+class ExpressionOperator(Operator):
+    """Wraps an already-computed Expression (saved state substituted by the
+    optimizer; reference ``ExpressionOperator``, Operator.scala:172-177)."""
+
+    def __init__(self, expression: Expression):
+        self.expression = expression
+
+    def eq_key(self) -> Tuple:
+        return (ExpressionOperator, id(self.expression))
+
+    def execute(self, deps: Sequence[Expression]) -> Expression:
+        return self.expression
+
+    def label(self) -> str:
+        return "Saved"

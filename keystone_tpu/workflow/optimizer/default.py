@@ -1,0 +1,38 @@
+"""Default optimizer.
+
+Mirrors ``workflow/graph/DefaultOptimizer.scala:5-10``: one Once batch of
+[SavedStateLoad, UnusedBranchRemoval] followed by CSE to fixpoint. (The
+reference's ExtractSaveablePrefixes step is subsumed by the executor's
+``is_saveable`` check — see ``executor.py``.)
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+from .rule import Batch, FixedPoint, Once, Optimizer
+from .rules import (
+    EquivalentNodeMergeRule,
+    SavedStateLoadRule,
+    UnusedBranchRemovalRule,
+)
+
+
+class DefaultOptimizer(Optimizer):
+    @property
+    def batches(self) -> Sequence[Batch]:
+        return [
+            Batch(
+                "saved-state and pruning",
+                Once(),
+                [SavedStateLoadRule(), UnusedBranchRemovalRule()],
+            ),
+            Batch("CSE", FixedPoint(100), [EquivalentNodeMergeRule()]),
+        ]
+
+
+class NoOpOptimizer(Optimizer):
+    """Pass-through optimizer (tests, debugging)."""
+
+    @property
+    def batches(self) -> Sequence[Batch]:
+        return []

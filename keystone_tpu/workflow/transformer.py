@@ -1,0 +1,96 @@
+"""Transformer: the per-item pure-function pipeline stage.
+
+Mirrors ``workflow/Transformer.scala`` + ``workflow/graph/Transformer.scala``:
+a Transformer is simultaneously an operator (executable node) and a
+one-node Pipeline. The user implements per-item ``apply`` with jnp ops;
+batch execution is ``jit(vmap(apply))`` over the mesh-sharded batch —
+the TPU-native analogue of the reference's default
+``in.map(apply)`` / per-partition GEMM batching (Transformer.scala:27,35).
+Nodes whose batch form isn't a vmap (e.g. whole-batch GEMM with masking)
+override ``apply_dataset``.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+
+from ..parallel.dataset import ArrayDataset, Dataset, HostDataset
+from .operators import TransformerOperator
+from .pipeline import Chainable, Pipeline
+from .graph import Graph
+
+
+class Transformer(TransformerOperator, Chainable):
+    def apply(self, x: Any) -> Any:
+        """Per-item transform (pure, jax-traceable unless host-only)."""
+        raise NotImplementedError
+
+    def apply_dataset(self, ds: Dataset) -> Dataset:
+        if isinstance(ds, ArrayDataset):
+            return ds.map_batch(self._batched())
+        return ds.map(self.apply)
+
+    def _batched(self) -> Callable:
+        """jit(vmap(apply)), cached per instance to avoid re-tracing."""
+        fn = self.__dict__.get("_batched_fn")
+        if fn is None:
+            fn = jax.jit(jax.vmap(self.apply))
+            self.__dict__["_batched_fn"] = fn
+        return fn
+
+    # -- operator plumbing -------------------------------------------------
+    def single_transform(self, inputs: Sequence[Any]) -> Any:
+        return self.apply(inputs[0])
+
+    def batch_transform(self, inputs: Sequence[Dataset]) -> Dataset:
+        return self.apply_dataset(inputs[0])
+
+    def to_pipeline(self) -> Pipeline:
+        g = Graph()
+        g, src = g.add_source()
+        g, nid = g.add_node(self, (src,))
+        g, sink = g.add_sink(nid)
+        return Pipeline(g, src, sink)
+
+    # jitted callables must not leak into pickles
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("_batched_fn", None)
+        return state
+
+
+class LambdaTransformer(Transformer):
+    """Function lift (reference ``Transformer.apply(f)``,
+    Transformer.scala:55-58)."""
+
+    def __init__(self, fn: Callable[[Any], Any], name: str = "Lambda"):
+        self.fn = fn
+        self.name = name
+
+    def eq_key(self):
+        return (LambdaTransformer, self.fn, self.name)
+
+    def apply(self, x: Any) -> Any:
+        return self.fn(x)
+
+    def label(self) -> str:
+        return self.name
+
+
+def transformer(fn: Callable[[Any], Any]) -> LambdaTransformer:
+    """Decorator/lift: ``transformer(lambda x: x * 2)``."""
+    return LambdaTransformer(fn, getattr(fn, "__name__", "Lambda"))
+
+
+class HostTransformer(Transformer):
+    """A transformer whose apply runs host-side Python (tokenizers, IO).
+
+    Batch path maps over items of a HostDataset; ArrayDatasets are
+    collected to host first.
+    """
+
+    def apply_dataset(self, ds: Dataset) -> Dataset:
+        if isinstance(ds, ArrayDataset):
+            ds = HostDataset(ds.collect())
+        return ds.map(self.apply)

@@ -1,0 +1,41 @@
+"""Test harness: simulate an 8-device TPU mesh on CPU.
+
+The analogue of the reference's ``LocalSparkContext`` trait
+(``src/test/scala/pipelines/LocalSparkContext.scala:9-26``): the full
+distributed code path (sharding, collectives, mesh solvers) runs in one
+process over 8 virtual devices.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # tests never touch the real TPU
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+import jax  # noqa: E402
+
+# The image's sitecustomize imports jax at interpreter start (axon TPU
+# plugin), so the env vars above can be too late; force via config too.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_env():
+    """Reset global pipeline state between tests (the reference stops and
+    recreates its SparkContext per test)."""
+    from keystone_tpu.workflow.env import PipelineEnv
+
+    PipelineEnv.reset()
+    yield
+    PipelineEnv.reset()
+
+
+@pytest.fixture
+def mesh8():
+    from keystone_tpu.parallel.mesh import make_mesh, mesh_scope
+
+    with mesh_scope(make_mesh(jax.devices()[:8])) as m:
+        yield m

@@ -1,0 +1,117 @@
+"""Graph surgery tests, mirroring ``workflow/graph/GraphSuite.scala``."""
+import numpy as np
+import pytest
+
+from keystone_tpu.workflow.graph import Graph
+from keystone_tpu.workflow.graph_ids import NodeId, SinkId, SourceId
+from keystone_tpu.workflow.operators import DatumOperator, Operator
+
+
+class Op(Operator):
+    def __init__(self, tag):
+        self.tag = tag
+
+    def execute(self, deps):
+        raise NotImplementedError
+
+
+def build_chain():
+    g = Graph()
+    g, src = g.add_source()
+    g, a = g.add_node(Op("a"), (src,))
+    g, b = g.add_node(Op("b"), (a,))
+    g, sink = g.add_sink(b)
+    return g, src, a, b, sink
+
+
+def test_add_node_and_sink():
+    g, src, a, b, sink = build_chain()
+    assert g.sources == {src}
+    assert set(g.nodes) == {a, b}
+    assert g.get_sink_dependency(sink) == b
+    assert g.get_dependencies(b) == (a,)
+
+
+def test_ids_are_fresh():
+    g, src, a, b, sink = build_chain()
+    ids = {src.id, a.id, b.id, sink.id}
+    assert len(ids) == 4
+
+
+def test_set_operator_and_dependencies():
+    g, src, a, b, sink = build_chain()
+    g2 = g.set_operator(a, Op("c"))
+    assert g2.get_operator(a).tag == "c"
+    assert g.get_operator(a).tag == "a"  # immutability
+    g3 = g.set_dependencies(b, (src,))
+    assert g3.get_dependencies(b) == (src,)
+
+
+def test_replace_dependency():
+    g, src, a, b, sink = build_chain()
+    g2 = g.replace_dependency(a, src)
+    assert g2.get_dependencies(b) == (src,)
+
+
+def test_remove_node():
+    g, src, a, b, sink = build_chain()
+    g2 = g.replace_dependency(b, a).remove_sink(sink)
+    g2, k2 = g2.add_sink(a)
+    g2 = g2.remove_node(b)
+    assert set(g2.nodes) == {a}
+    assert g2.get_sink_dependency(k2) == a
+
+
+def test_add_graph_remaps_ids():
+    g1, src1, a1, b1, sink1 = build_chain()
+    g2, src2, a2, b2, sink2 = build_chain()
+    union, smap, kmap = g1.add_graph(g2)
+    assert len(union.sources) == 2
+    assert len(union.nodes) == 4
+    assert len(union.sinks) == 2
+    # the remapped ids are fresh
+    assert smap[src2] != src1
+    new_b = union.get_sink_dependency(kmap[sink2])
+    assert union.get_operator(new_b).tag == "b"
+    # structure preserved under remap
+    (new_a,) = union.get_dependencies(new_b)
+    assert union.get_operator(new_a).tag == "a"
+    assert union.get_dependencies(new_a) == (smap[src2],)
+
+
+def test_connect_graph_splices_source_to_sink():
+    g1, src1, a1, b1, sink1 = build_chain()
+    g2, src2, a2, b2, sink2 = build_chain()
+    merged, smap, kmap = g1.connect_graph(g2, {src2: sink1})
+    # g2's source is gone; g1's sink is gone
+    assert len(merged.sources) == 1 and src1 in merged.sources
+    assert sink1 not in merged.sinks
+    assert len(merged.sinks) == 1
+    # the chain now runs a->b->a'->b'
+    final_sink = kmap[sink2]
+    nb2 = merged.get_sink_dependency(final_sink)
+    (na2,) = merged.get_dependencies(nb2)
+    assert merged.get_dependencies(na2) == (b1,)
+
+
+def test_ancestors_descendants_linearize():
+    g, src, a, b, sink = build_chain()
+    assert g.get_ancestors(sink) == {b, a, src}
+    assert g.get_descendants(src) == {a, b, sink}
+    order = g.linearize()
+    assert order.index(a) < order.index(b)
+    assert order.index(src) < order.index(a)
+
+
+def test_to_dot():
+    g, *_ = build_chain()
+    dot = g.to_dot()
+    assert "digraph" in dot and "->" in dot
+
+
+def test_induce_subgraph():
+    g, src, a, b, sink = build_chain()
+    sub = g.induce(frozenset({a, src}))
+    assert set(sub.nodes) == {a}
+    assert sub.sources == {src}
+    assert not sub.sinks

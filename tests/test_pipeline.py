@@ -1,0 +1,194 @@
+"""Pipeline API tests, mirroring ``workflow/PipelineSuite.scala`` and
+``workflow/graph/PipelineSuite.scala``."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from keystone_tpu import (
+    ArrayDataset,
+    Cacher,
+    Estimator,
+    Identity,
+    LabelEstimator,
+    Pipeline,
+    PipelineEnv,
+    Transformer,
+    transformer,
+)
+from keystone_tpu.workflow.estimator import LambdaEstimator
+
+
+class Scale(Transformer):
+    def __init__(self, k):
+        self.k = k
+
+    def apply(self, x):
+        return x * self.k
+
+
+class AddOne(Transformer):
+    def apply(self, x):
+        return x + 1
+
+
+class MeanCenterEstimator(Estimator):
+    """Fits the dataset mean, returns a transformer subtracting it."""
+
+    num_fits = 0
+
+    def _fit(self, ds):
+        MeanCenterEstimator.num_fits += 1
+        data = ds.numpy()
+        return Scale(0) if data is None else Shift(-data.mean(axis=0))
+
+
+class Shift(Transformer):
+    def __init__(self, b):
+        self.b = np.asarray(b)
+
+    def apply(self, x):
+        return x + self.b
+
+
+class OffsetByLabelMean(LabelEstimator):
+    num_fits = 0
+
+    def _fit(self, ds, labels):
+        OffsetByLabelMean.num_fits += 1
+        return Shift(labels.numpy().mean(axis=0))
+
+
+def data(n=16, d=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.rand(n, d).astype(np.float32)
+
+
+def test_transformer_apply_datum():
+    t = Scale(3.0)
+    out = t.bind_datum(np.float32(2.0)).get()
+    assert float(out) == pytest.approx(6.0)
+
+
+def test_transformer_apply_dataset():
+    x = data()
+    out = Scale(2.0)(x).numpy()
+    np.testing.assert_allclose(out, x * 2.0, rtol=1e-6)
+
+
+def test_and_then_chaining():
+    x = data()
+    pipe = Scale(2.0) >> AddOne() >> Scale(0.5)
+    out = pipe.apply(x).numpy()
+    np.testing.assert_allclose(out, (x * 2 + 1) * 0.5, rtol=1e-6)
+
+
+def test_estimator_chain():
+    x = data()
+    pipe = AddOne().and_then(MeanCenterEstimator(), x)
+    out = pipe.apply(x).numpy()
+    expect = (x + 1) - (x + 1).mean(axis=0)
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_do_not_fit_estimators_multiple_times():
+    """Reference: PipelineSuite 'Do not fit estimators multiple times'."""
+    MeanCenterEstimator.num_fits = 0
+    x = data()
+    pipe = AddOne().and_then(MeanCenterEstimator(), x)
+    pipe.apply(x).numpy()
+    pipe.apply(data(seed=1)).numpy()
+    pipe.apply_datum(x[0]).get()
+    assert MeanCenterEstimator.num_fits == 1
+
+
+def test_label_estimator_chain():
+    OffsetByLabelMean.num_fits = 0
+    x = data()
+    y = data(seed=2)
+    pipe = Scale(1.0).and_then(OffsetByLabelMean(), x, y)
+    out = pipe.apply(x).numpy()
+    np.testing.assert_allclose(out, x + y.mean(axis=0), rtol=1e-5, atol=1e-5)
+    assert OffsetByLabelMean.num_fits == 1
+
+
+def test_gather():
+    x = data()
+    pipe = Pipeline.gather([Scale(1.0), Scale(2.0), Scale(3.0)])
+    out = pipe.apply(x).get()
+    got = out.numpy()
+    assert isinstance(got, tuple) and len(got) == 3
+    np.testing.assert_allclose(got[1], x * 2, rtol=1e-6)
+
+
+def test_fit_returns_serializable_fitted_pipeline():
+    import pickle
+
+    x = data()
+    pipe = AddOne().and_then(MeanCenterEstimator(), x) >> Scale(2.0)
+    fitted = pipe.fit()
+    out1 = fitted.apply(x).numpy()
+    blob = pickle.dumps(fitted)
+    restored = pickle.loads(blob)
+    out2 = restored.apply(x).numpy()
+    np.testing.assert_allclose(out1, out2, rtol=1e-6)
+    expect = ((x + 1) - (x + 1).mean(axis=0)) * 2
+    np.testing.assert_allclose(out1, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_fitted_pipeline_never_refits():
+    MeanCenterEstimator.num_fits = 0
+    x = data()
+    pipe = AddOne().and_then(MeanCenterEstimator(), x)
+    fitted = pipe.fit()
+    assert MeanCenterEstimator.num_fits == 1
+    fitted.apply(data(seed=3)).numpy()
+    fitted.apply(data(seed=4)).numpy()
+    assert MeanCenterEstimator.num_fits == 1
+
+
+def test_incremental_state_reuse_across_pipelines():
+    """Reference: graph/PipelineSuite 'Incrementally update execution state'.
+    Two pipelines sharing a fitted prefix on the same data fit once."""
+    MeanCenterEstimator.num_fits = 0
+    x = data()
+    ds = ArrayDataset.from_numpy(x)
+    p1 = AddOne().and_then(MeanCenterEstimator(), ds)
+    p1.apply(ds).numpy()
+    assert MeanCenterEstimator.num_fits == 1
+    p2 = AddOne().and_then(MeanCenterEstimator(), ds) >> Scale(5.0)
+    p2.apply(ds).numpy()
+    assert MeanCenterEstimator.num_fits == 1
+
+
+def test_lambda_transformer():
+    x = data()
+    pipe = transformer(lambda v: v * 4.0)
+    np.testing.assert_allclose(pipe(x).numpy(), x * 4, rtol=1e-6)
+
+
+def test_identity_and_cacher():
+    x = data()
+    pipe = Identity() >> Cacher("t") >> Scale(2.0)
+    np.testing.assert_allclose(pipe.apply(x).numpy(), x * 2, rtol=1e-6)
+
+
+def test_pipeline_gather_then_estimator():
+    x = data()
+    branches = Pipeline.gather([Scale(1.0), Scale(2.0)])
+
+    class Sum(Transformer):
+        def apply(self, xs):
+            return xs[0] + xs[1]
+
+    pipe = branches >> Sum()
+    out = pipe.apply(x).numpy()
+    np.testing.assert_allclose(out, x * 3, rtol=1e-6)
+
+
+def test_apply_datum_through_estimator_pipeline():
+    x = data()
+    pipe = AddOne().and_then(MeanCenterEstimator(), x)
+    out = np.asarray(pipe.apply_datum(x[0]).get())
+    expect = (x[0] + 1) - (x + 1).mean(axis=0)
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
