@@ -1,0 +1,50 @@
+"""CSV loading (reference ``loaders/CsvDataLoader.scala:10-30``) and the
+LabeledData convenience wrapper (reference ``loaders/LabeledData.scala``)."""
+from __future__ import annotations
+
+import glob
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..parallel.dataset import ArrayDataset
+
+
+def load_csv(path: str, dtype=np.float32) -> np.ndarray:
+    """Load one CSV file, a dir of CSVs, or a glob into a row matrix."""
+    if os.path.isdir(path):
+        files = sorted(glob.glob(os.path.join(path, "*")))
+    else:
+        files = sorted(glob.glob(path)) or [path]
+    parts = [np.loadtxt(f, delimiter=",", dtype=dtype, ndmin=2) for f in files]
+    return np.concatenate(parts, axis=0)
+
+
+@dataclass
+class LabeledData:
+    """Bundles a data dataset and its labels (reference
+    ``loaders/LabeledData.scala:8-15``)."""
+
+    data: ArrayDataset
+    labels: ArrayDataset
+
+
+def csv_data_loader(path: str) -> ArrayDataset:
+    return ArrayDataset.from_numpy(load_csv(path))
+
+
+def csv_labeled_loader(
+    path: str, label_col: int = 0, label_offset: int = 0
+) -> LabeledData:
+    """Rows of [label, features...]; ``label_offset`` is subtracted from
+    the raw label (MNIST CSVs are 1-indexed, reference
+    MnistRandomFFT.scala:35-38)."""
+    raw = load_csv(path)
+    labels = raw[:, label_col].astype(np.int32) - label_offset
+    feats = np.delete(raw, label_col, axis=1)
+    return LabeledData(
+        data=ArrayDataset.from_numpy(feats),
+        labels=ArrayDataset.from_numpy(labels),
+    )

@@ -1,0 +1,15 @@
+"""Learning nodes: solvers and models (reference ``nodes/learning``,
+SURVEY.md section 2.3)."""
+from .linear import (
+    BlockLeastSquaresEstimator,
+    BlockLinearMapper,
+    LinearMapEstimator,
+    LinearMapper,
+)
+
+__all__ = [
+    "BlockLeastSquaresEstimator",
+    "BlockLinearMapper",
+    "LinearMapEstimator",
+    "LinearMapper",
+]

@@ -1,0 +1,191 @@
+"""Linear models and least-squares estimators.
+
+TPU-native re-designs of reference ``nodes/learning/LinearMapper.scala``
+and ``nodes/learning/BlockLinearMapper.scala`` (SURVEY.md section 2.3):
+the Spark Gram-accumulate + driver-Cholesky becomes a sharded GEMM +
+all-reduce + replicated Cholesky, and block coordinate descent runs as one
+jitted program with per-block Gram psums.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops import linalg
+from ...parallel.dataset import ArrayDataset, Dataset
+from ...workflow.label_estimator import LabelEstimator
+from ...workflow.transformer import Transformer
+from ..stats import StandardScalerModel
+
+
+class LinearMapper(Transformer):
+    """out = x_model^T in (+ b), with optional feature scaler
+    (reference ``LinearMapper.scala:18-62``)."""
+
+    def __init__(
+        self,
+        weights: np.ndarray,
+        intercept: Optional[np.ndarray] = None,
+        feature_scaler: Optional[StandardScalerModel] = None,
+    ):
+        self.weights = np.asarray(weights)
+        self.intercept = None if intercept is None else np.asarray(intercept)
+        self.feature_scaler = feature_scaler
+
+    def apply(self, x):
+        if self.feature_scaler is not None:
+            x = self.feature_scaler.apply(x)
+        out = x @ self.weights
+        if self.intercept is not None:
+            out = out + self.intercept
+        return out
+
+
+class LinearMapEstimator(LabelEstimator):
+    """OLS/ridge via distributed normal equations on mean-centered features
+    and labels; intercept = label mean (reference
+    ``LinearMapper.scala:71-98``)."""
+
+    def __init__(self, lam: Optional[float] = None):
+        self.lam = lam
+
+    def _fit(self, ds: Dataset, labels: Dataset) -> LinearMapper:
+        assert isinstance(ds, ArrayDataset) and isinstance(labels, ArrayDataset)
+        n = ds.n
+        X, Y = ds.data, labels.data
+        x_mean = np.asarray(linalg.distributed_mean(X, n))
+        y_mean = np.asarray(linalg.distributed_mean(Y, n))
+        W = np.asarray(
+            _centered_normal_equations(
+                X, Y, jnp.asarray(x_mean), jnp.asarray(y_mean),
+                ds.mask, float(self.lam or 0.0),
+            )
+        )
+        return LinearMapper(
+            W,
+            intercept=y_mean,
+            feature_scaler=StandardScalerModel(x_mean),
+        )
+
+    def cost(self, n, d, k, sparsity, num_machines, cpu_w, mem_w, net_w) -> float:
+        """Reference cost model (LinearMapper.scala:100-115)."""
+        flops = n * d * (d + k) / num_machines
+        bytes_scanned = n * d / num_machines + d * d
+        network = d * (d + k)
+        return max(cpu_w * flops, mem_w * bytes_scanned) + net_w * network
+
+
+@jax.jit
+def _centered_normal_equations(X, Y, x_mean, y_mean, mask, lam):
+    m = mask[:, None].astype(X.dtype)
+    Xc = (X - x_mean) * m
+    Yc = (Y - y_mean) * m
+    return linalg.ridge_cho_solve(linalg.gram(Xc), linalg.cross(Xc, Yc), lam)
+
+
+class BlockLinearMapper(Transformer):
+    """Block-partitioned linear model (reference
+    ``BlockLinearMapper.scala:22-73``).
+
+    The reference stores ``Seq[DenseMatrix]`` blocks and applies them one
+    broadcast-GEMM at a time to bound executor memory; on TPU the blocks
+    concatenate into one sharded GEMM (the MXU-friendly layout), while the
+    per-block view is kept for API parity.
+    """
+
+    def __init__(
+        self,
+        block_weights: Sequence[np.ndarray],
+        block_size: int,
+        intercept: Optional[np.ndarray] = None,
+        feature_means: Optional[np.ndarray] = None,
+    ):
+        self.block_weights = [np.asarray(w) for w in block_weights]
+        self.block_size = block_size
+        self.intercept = None if intercept is None else np.asarray(intercept)
+        self.feature_means = (
+            None if feature_means is None else np.asarray(feature_means)
+        )
+        self.weights = np.concatenate(self.block_weights, axis=0)
+
+    def eq_key(self):
+        return (
+            BlockLinearMapper,
+            self.block_size,
+            self.weights.tobytes(),
+            None if self.intercept is None else self.intercept.tobytes(),
+            None if self.feature_means is None else self.feature_means.tobytes(),
+        )
+
+    def apply(self, x):
+        if self.feature_means is not None:
+            x = x - self.feature_means
+        out = x @ self.weights
+        if self.intercept is not None:
+            out = out + self.intercept
+        return out
+
+
+class BlockLeastSquaresEstimator(LabelEstimator):
+    """The workhorse distributed solver (reference
+    ``BlockLinearMapper.scala:196-257``): per-block mean-centering, label
+    mean-centering, block coordinate descent with L2, intercept from the
+    joint means. ``weight`` = 3*num_iter+1 passes over the data
+    (reference :204) for the auto-cache planner.
+    """
+
+    def __init__(self, block_size: int, num_iter: int, lam: float = 0.0):
+        self.block_size = block_size
+        self.num_iter = num_iter
+        self.lam = lam
+
+    @property
+    def weight(self) -> int:
+        return 3 * self.num_iter + 1
+
+    def _fit(self, ds: Dataset, labels: Dataset) -> BlockLinearMapper:
+        assert isinstance(ds, ArrayDataset) and isinstance(labels, ArrayDataset)
+        n, d = ds.n, ds.data.shape[1]
+        k = labels.data.shape[1]
+        bs = self.block_size
+        bounds = [(i, min(d, i + bs)) for i in range(0, d, bs)]
+
+        X, Y = ds.data, labels.data
+        x_mean = np.asarray(linalg.distributed_mean(X, n))
+        y_mean = np.asarray(linalg.distributed_mean(Y, n))
+        Ws = _block_solve(
+            X,
+            Y,
+            jnp.asarray(x_mean),
+            jnp.asarray(y_mean),
+            ds.mask,
+            float(self.lam),
+            tuple(bounds),
+            self.num_iter,
+        )
+        block_ws = [np.asarray(w) for w in Ws]
+        W = np.concatenate(block_ws, axis=0)
+        intercept = y_mean  # apply() centers x by the means, so b = y_mean
+        return BlockLinearMapper(
+            block_ws, bs, intercept=intercept, feature_means=x_mean
+        )
+
+    def cost(self, n, d, k, sparsity, num_machines, cpu_w, mem_w, net_w) -> float:
+        """Reference cost model (BlockLinearMapper.scala:268-282)."""
+        i = float(self.num_iter)
+        flops = i * n * d * k / num_machines
+        bytes_scanned = i * n * d
+        network = i * (d * k + num_machines * self.block_size * k)
+        return max(cpu_w * flops, mem_w * bytes_scanned) + net_w * network
+
+
+@functools.partial(jax.jit, static_argnames=("bounds", "num_iter"))
+def _block_solve(X, Y, x_mean, y_mean, mask, lam, bounds, num_iter):
+    m = mask[:, None].astype(X.dtype)
+    Yc = (Y - y_mean) * m
+    blocks = [(X[:, lo:hi] - x_mean[lo:hi]) * m for lo, hi in bounds]
+    return linalg.bcd_core(blocks, Yc, jnp.asarray(lam, X.dtype), num_passes=num_iter)

@@ -1,0 +1,132 @@
+"""Statistical feature nodes.
+
+TPU-native re-designs of the reference's ``nodes/stats`` package
+(SURVEY.md section 2.7). Every node's per-item ``apply`` is jax-traceable,
+so batch execution is a single fused XLA program over the sharded batch.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...parallel.dataset import ArrayDataset, Dataset
+from ...workflow.estimator import Estimator
+from ...workflow.transformer import Transformer
+
+EPS = 2.2e-16  # matches the reference's varConstant floor usage
+
+
+class RandomSignNode(Transformer):
+    """Elementwise multiply by a fixed +-1 vector
+    (reference ``stats/RandomSignNode.scala:11-23``)."""
+
+    def __init__(self, signs: np.ndarray):
+        self.signs = np.asarray(signs, dtype=np.float32)
+
+    @staticmethod
+    def create(size: int, seed: int = 0) -> "RandomSignNode":
+        rng = np.random.RandomState(seed)
+        return RandomSignNode(2.0 * rng.randint(0, 2, size=size) - 1.0)
+
+    def apply(self, x):
+        return x * self.signs
+
+
+class PaddedFFT(Transformer):
+    """Zero-pad to the next power of two, FFT, keep the real part of the
+    first half (reference ``stats/PaddedFFT.scala:13-20``)."""
+
+    def apply(self, x):
+        n = x.shape[-1]
+        padded = 1 << (n - 1).bit_length()
+        xp = jnp.concatenate(
+            [x, jnp.zeros((padded - n,), x.dtype)], axis=-1
+        )
+        return jnp.real(jnp.fft.fft(xp))[: padded // 2].astype(x.dtype)
+
+
+class LinearRectifier(Transformer):
+    """f(x) = max(max_val, x - alpha)
+    (reference ``stats/LinearRectifier.scala:12-17``)."""
+
+    def __init__(self, max_val: float = 0.0, alpha: float = 0.0):
+        self.max_val = float(max_val)
+        self.alpha = float(alpha)
+
+    def apply(self, x):
+        return jnp.maximum(self.max_val, x - self.alpha)
+
+
+class NormalizeRows(Transformer):
+    """L2-normalize each vector, flooring the norm at machine epsilon
+    (reference ``stats/NormalizeRows.scala:8-14``)."""
+
+    def apply(self, x):
+        norm = jnp.maximum(jnp.linalg.norm(x), EPS)
+        return x / norm
+
+
+class SignedHellingerMapper(Transformer):
+    """sign(x) * sqrt(|x|) (reference ``stats/SignedHellingerMapper.scala``)."""
+
+    def apply(self, x):
+        return jnp.sign(x) * jnp.sqrt(jnp.abs(x))
+
+
+class BatchSignedHellingerMapper(Transformer):
+    """Matrix-input variant (applied to per-image descriptor matrices)."""
+
+    def apply(self, x):
+        return jnp.sign(x) * jnp.sqrt(jnp.abs(x))
+
+
+class StandardScalerModel(Transformer):
+    """(x - mean) [/ std] (reference ``stats/StandardScaler.scala:16-31``)."""
+
+    def __init__(self, mean: np.ndarray, std: Optional[np.ndarray] = None):
+        self.mean = np.asarray(mean)
+        self.std = None if std is None else np.asarray(std)
+
+    def apply(self, x):
+        out = x - self.mean
+        if self.std is not None:
+            out = out / self.std
+        return out
+
+
+class StandardScaler(Estimator):
+    """Fit column means (and optionally stds) over the dataset.
+
+    The reference aggregates a MultivariateOnlineSummarizer via
+    treeAggregate (``stats/StandardScaler.scala:44-58``); here the moments
+    are two all-reduced column sums over the sharded batch. Degenerate
+    stds (NaN/inf/<eps) are replaced by 1.0, as in the reference.
+    """
+
+    def __init__(self, normalize_std_dev: bool = True, eps: float = 1e-12):
+        self.normalize_std_dev = normalize_std_dev
+        self.eps = eps
+
+    def _fit(self, ds: Dataset) -> StandardScalerModel:
+        assert isinstance(ds, ArrayDataset), "StandardScaler needs array data"
+        n = ds.n
+        s, sq = _moments(ds.data)
+        mean = np.asarray(s, dtype=np.float64) / n
+        if not self.normalize_std_dev:
+            return StandardScalerModel(mean.astype(np.float32))
+        # unbiased sample variance, matching MultivariateOnlineSummarizer
+        var = (np.asarray(sq, dtype=np.float64) - n * mean * mean) / max(n - 1, 1)
+        std = np.sqrt(np.maximum(var, 0.0))
+        bad = ~np.isfinite(std) | (np.abs(std) < self.eps)
+        std = np.where(bad, 1.0, std)
+        return StandardScalerModel(
+            mean.astype(np.float32), std.astype(np.float32)
+        )
+
+
+@jax.jit
+def _moments(X):
+    return jnp.sum(X, axis=0), jnp.sum(X * X, axis=0)

@@ -1,0 +1,142 @@
+"""Utility nodes (reference ``nodes/util``, SURVEY.md section 2.8)."""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...parallel.dataset import ArrayDataset, Dataset
+from ...workflow.transformer import Transformer
+
+
+class ClassLabelIndicatorsFromIntLabels(Transformer):
+    """int label -> +-1 one-hot vector
+    (reference ``util/ClassLabelIndicators.scala:15-34``)."""
+
+    def __init__(self, num_classes: int):
+        assert num_classes > 1, "numClasses must be > 1"
+        self.num_classes = num_classes
+
+    def apply(self, label):
+        idx = jnp.arange(self.num_classes)
+        return jnp.where(idx == label, 1.0, -1.0).astype(jnp.float32)
+
+
+class ClassLabelIndicatorsFromIntArrayLabels(Transformer):
+    """multi-label int array -> +-1 multi-hot vector
+    (reference ``util/ClassLabelIndicators.scala:41-55``). Inputs are
+    fixed-width padded label arrays with -1 for missing entries (the TPU
+    layout for ragged label sets)."""
+
+    def __init__(self, num_classes: int):
+        assert num_classes > 1, "numClasses must be > 1"
+        self.num_classes = num_classes
+
+    def apply(self, labels):
+        base = jnp.full((self.num_classes,), -1.0, dtype=jnp.float32)
+        valid = labels >= 0
+        onehot = jax.nn.one_hot(
+            jnp.where(valid, labels, 0), self.num_classes, dtype=jnp.float32
+        )
+        hits = jnp.sum(onehot * valid[:, None].astype(jnp.float32), axis=0)
+        return jnp.where(hits > 0, 1.0, base)
+
+
+class VectorCombiner(Transformer):
+    """Concatenate a gathered tuple of vectors into one vector
+    (reference ``util/VectorCombiner.scala:12-14``)."""
+
+    def apply(self, xs):
+        return jnp.concatenate(list(xs), axis=-1)
+
+
+class MaxClassifier(Transformer):
+    """argmax (reference ``util/MaxClassifier.scala:9-11``)."""
+
+    def apply(self, x):
+        return jnp.argmax(x, axis=-1).astype(jnp.int32)
+
+
+class TopKClassifier(Transformer):
+    """Indices of the k largest values, descending
+    (reference ``util/TopKClassifier.scala:9-11``)."""
+
+    def __init__(self, k: int):
+        self.k = k
+
+    def apply(self, x):
+        _, idx = jax.lax.top_k(x, self.k)
+        return idx.astype(jnp.int32)
+
+
+class VectorSplitter(Transformer):
+    """Split the feature dimension into blocks of ``block_size``
+    (reference ``util/VectorSplitter.scala:11-36``). Returns a tuple of
+    sub-vectors per item; block boundaries are static."""
+
+    def __init__(self, block_size: int, num_features: int = None):
+        self.block_size = block_size
+        self.num_features = num_features
+
+    def _bounds(self, d: int):
+        bs = self.block_size
+        nb = (d + bs - 1) // bs
+        return [(i * bs, min(d, (i + 1) * bs)) for i in range(nb)]
+
+    def apply(self, x):
+        d = self.num_features or x.shape[-1]
+        return tuple(x[..., lo:hi] for lo, hi in self._bounds(d))
+
+
+class FloatToDouble(Transformer):
+    """Precision promotion (reference ``util/FloatToDouble.scala``). On TPU
+    f64 is unsupported; this promotes to the highest available float so
+    downstream solvers run at full precision."""
+
+    def apply(self, x):
+        return x.astype(jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+
+
+class DoubleToFloat(Transformer):
+    def apply(self, x):
+        return x.astype(jnp.float32)
+
+
+class MatrixVectorizer(Transformer):
+    """Flatten a matrix into a vector, column-major to match Breeze's
+    ``toDenseVector`` (reference ``util/MatrixVectorizer.scala``)."""
+
+    def apply(self, x):
+        return x.T.reshape(-1)
+
+
+class Densify(Transformer):
+    """Sparse -> dense passthrough (reference ``util/Densify.scala:10-21``).
+    ArrayDatasets are already dense; sparse host datasets are stacked."""
+
+    def apply(self, x):
+        return x
+
+    def apply_dataset(self, ds: Dataset) -> Dataset:
+        from ...parallel.dataset import HostDataset
+
+        if isinstance(ds, ArrayDataset):
+            return ds
+        items = ds.collect()
+        dense = [
+            np.asarray(
+                it.todense() if hasattr(it, "todense") else it, dtype=np.float32
+            ).ravel()
+            for it in items
+        ]
+        return ArrayDataset.from_items(dense)
+
+
+class Cast(Transformer):
+    def __init__(self, dtype: str):
+        self.dtype = dtype
+
+    def apply(self, x):
+        return x.astype(self.dtype)

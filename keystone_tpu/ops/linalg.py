@@ -125,29 +125,29 @@ def block_coordinate_descent(
     blocks inside mlmatrix's solver; here applied per block as given —
     callers pass the per-block value).
     """
-    num_blocks = len(blocks)
-    k = Y.shape[1]
+    run = jax.jit(functools.partial(bcd_core, num_passes=num_passes))
+    return list(run(tuple(blocks), Y, jnp.asarray(lam, Y.dtype)))
+
+
+def bcd_core(blocks, Y, lam, *, num_passes: int):
+    """Traceable BCD body (callable from inside other jitted programs)."""
     dtype = Y.dtype
-
-    @jax.jit
-    def run(blocks, Y, lam):
-        # Precompute per-block Cholesky factors once per solve: the Gram of
-        # each block is pass-invariant, so multi-pass BCD reuses factors.
-        factors = []
-        for A in blocks:
-            G = gram(A) + lam * jnp.eye(A.shape[1], dtype=dtype)
-            factors.append(jax.scipy.linalg.cho_factor(G, lower=True))
-        Ws = [jnp.zeros((A.shape[1], k), dtype) for A in blocks]
-        pred = jnp.zeros_like(Y)
-        for _ in range(num_passes):
-            for i, A in enumerate(blocks):
-                target = Y - pred + A @ Ws[i]
-                Wi = jax.scipy.linalg.cho_solve(factors[i], cross(A, target))
-                pred = pred + A @ (Wi - Ws[i])
-                Ws[i] = Wi
-        return Ws
-
-    return list(run(tuple(blocks), Y, jnp.asarray(lam, dtype)))
+    k = Y.shape[1]
+    # Precompute per-block Cholesky factors once per solve: the Gram of
+    # each block is pass-invariant, so multi-pass BCD reuses factors.
+    factors = []
+    for A in blocks:
+        G = gram(A) + lam * jnp.eye(A.shape[1], dtype=dtype)
+        factors.append(jax.scipy.linalg.cho_factor(G, lower=True))
+    Ws = [jnp.zeros((A.shape[1], k), dtype) for A in blocks]
+    pred = jnp.zeros_like(Y)
+    for _ in range(num_passes):
+        for i, A in enumerate(blocks):
+            target = Y - pred + A @ Ws[i]
+            Wi = jax.scipy.linalg.cho_solve(factors[i], cross(A, target))
+            pred = pred + A @ (Wi - Ws[i])
+            Ws[i] = Wi
+    return Ws
 
 
 def solve_one_pass_l2(

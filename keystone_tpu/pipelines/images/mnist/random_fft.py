@@ -1,0 +1,101 @@
+"""MnistRandomFFT: random-FFT featurization + block least squares.
+
+Mirrors reference ``pipelines/images/mnist/MnistRandomFFT.scala:21-113``:
+gather(num_ffts x [RandomSign -> PaddedFFT -> LinearRectifier]) ->
+VectorCombiner -> BlockLeastSquares(block_size, 1, lambda) -> MaxClassifier,
+trained on MNIST CSVs with 1-indexed labels.
+"""
+from __future__ import annotations
+
+import argparse
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ....evaluation.multiclass import evaluate_multiclass
+from ....loaders.csv_loader import LabeledData, csv_labeled_loader
+from ....nodes.learning import BlockLeastSquaresEstimator
+from ....nodes.stats import LinearRectifier, PaddedFFT, RandomSignNode
+from ....nodes.util import ClassLabelIndicatorsFromIntLabels, MaxClassifier, VectorCombiner
+from ....workflow.pipeline import Pipeline
+
+NUM_CLASSES = 10
+MNIST_IMAGE_SIZE = 784
+
+
+@dataclass
+class MnistRandomFFTConfig:
+    train_location: str = ""
+    test_location: str = ""
+    num_ffts: int = 200
+    block_size: int = 2048
+    lam: float = 0.0
+    seed: int = 0
+
+
+def build_featurizer(config: MnistRandomFFTConfig) -> Pipeline:
+    rng = np.random.RandomState(config.seed)
+    branches = []
+    for _ in range(config.num_ffts):
+        signs = 2.0 * rng.randint(0, 2, size=MNIST_IMAGE_SIZE) - 1.0
+        branches.append(
+            RandomSignNode(signs) >> PaddedFFT() >> LinearRectifier(0.0)
+        )
+    return Pipeline.gather(branches) >> VectorCombiner()
+
+
+def run(config: MnistRandomFFTConfig, train: Optional[LabeledData] = None,
+        test: Optional[LabeledData] = None):
+    """Returns (pipeline, train_metrics, test_metrics)."""
+    start = time.time()
+    if train is None:
+        train = csv_labeled_loader(config.train_location, label_offset=1)
+    if test is None:
+        test = csv_labeled_loader(config.test_location, label_offset=1)
+
+    labels = ClassLabelIndicatorsFromIntLabels(NUM_CLASSES)(train.labels)
+    featurizer = build_featurizer(config)
+    pipeline = (
+        featurizer.and_then(
+            BlockLeastSquaresEstimator(config.block_size, 1, config.lam),
+            train.data,
+            labels,
+        )
+        >> MaxClassifier()
+    )
+
+    train_eval = evaluate_multiclass(
+        pipeline(train.data), train.labels, NUM_CLASSES
+    )
+    print(f"TRAIN Error is {100 * train_eval.total_error:.2f}%")
+    test_eval = evaluate_multiclass(pipeline(test.data), test.labels, NUM_CLASSES)
+    print(f"TEST Error is {100 * test_eval.total_error:.2f}%")
+    print(f"Pipeline took {time.time() - start:.1f} s")
+    return pipeline, train_eval, test_eval
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("MnistRandomFFT")
+    p.add_argument("--trainLocation", required=True)
+    p.add_argument("--testLocation", required=True)
+    p.add_argument("--numFFTs", type=int, default=200)
+    p.add_argument("--blockSize", type=int, default=2048)
+    p.add_argument("--lambda", dest="lam", type=float, default=0.0)
+    p.add_argument("--seed", type=int, default=0)
+    a = p.parse_args(argv)
+    run(
+        MnistRandomFFTConfig(
+            train_location=a.trainLocation,
+            test_location=a.testLocation,
+            num_ffts=a.numFFTs,
+            block_size=a.blockSize,
+            lam=a.lam,
+            seed=a.seed,
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

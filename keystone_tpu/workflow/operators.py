@@ -63,11 +63,22 @@ class Operator:
         )
         return (type(self),) + items
 
+    def _cached_eq_key(self) -> Tuple:
+        # Nodes are logically frozen after construction; caching avoids
+        # re-serializing large parameter arrays on every CSE comparison.
+        key = self.__dict__.get("_eq_key_val")
+        if key is None:
+            key = self.eq_key()
+            self.__dict__["_eq_key_val"] = key
+        return key
+
     def __eq__(self, other: Any) -> bool:
-        return type(self) is type(other) and self.eq_key() == other.eq_key()
+        return type(self) is type(other) and (
+            self._cached_eq_key() == other._cached_eq_key()
+        )
 
     def __hash__(self) -> int:
-        return hash(self.eq_key())
+        return hash(self._cached_eq_key())
 
 
 class DatasetOperator(Operator):

@@ -35,6 +35,6 @@ def compute_prefix(
             memo[gid] = None
             return None
         dep_prefixes.append(p)
-    result = ("prefix", graph.get_operator(gid).eq_key(), tuple(dep_prefixes))
+    result = ("prefix", graph.get_operator(gid)._cached_eq_key(), tuple(dep_prefixes))
     memo[gid] = result
     return result

@@ -57,6 +57,7 @@ class Transformer(TransformerOperator, Chainable):
     def __getstate__(self):
         state = dict(self.__dict__)
         state.pop("_batched_fn", None)
+        state.pop("_eq_key_val", None)
         return state
 
 

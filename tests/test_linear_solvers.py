@@ -1,0 +1,82 @@
+"""Linear model node tests (mirrors BlockLinearMapperSuite /
+LinearMapperSuite)."""
+import numpy as np
+import pytest
+
+from keystone_tpu.nodes.learning import (
+    BlockLeastSquaresEstimator,
+    BlockLinearMapper,
+    LinearMapEstimator,
+    LinearMapper,
+)
+from keystone_tpu.parallel.dataset import ArrayDataset
+
+
+def make_problem(n=200, d=24, k=3, seed=0):
+    rng = np.random.RandomState(seed)
+    A = rng.randn(n, d).astype(np.float32)
+    W = rng.randn(d, k).astype(np.float32)
+    b = rng.randn(k).astype(np.float32)
+    Y = (A @ W + b + 0.01 * rng.randn(n, k)).astype(np.float32)
+    return A, Y
+
+
+def centered_ridge(A, Y, lam):
+    Am, Ym = A.mean(0), Y.mean(0)
+    Ac = (A - Am).astype(np.float64)
+    Yc = (Y - Ym).astype(np.float64)
+    W = np.linalg.solve(Ac.T @ Ac + lam * np.eye(A.shape[1]), Ac.T @ Yc)
+    return W, Am, Ym
+
+
+def test_linear_map_estimator_matches_centered_ridge():
+    A, Y = make_problem()
+    model = LinearMapEstimator(lam=0.5).fit(A, Y)
+    W, Am, Ym = centered_ridge(A, Y, 0.5)
+    np.testing.assert_allclose(model.weights, W, rtol=2e-3, atol=2e-3)
+    out = model(A).numpy()
+    expect = (A - Am) @ W + Ym
+    np.testing.assert_allclose(out, expect, rtol=2e-3, atol=2e-3)
+
+
+def test_block_least_squares_single_block_matches_ridge():
+    A, Y = make_problem()
+    model = BlockLeastSquaresEstimator(block_size=64, num_iter=1, lam=0.3).fit(A, Y)
+    W, Am, Ym = centered_ridge(A, Y, 0.3)
+    np.testing.assert_allclose(model.weights, W, rtol=5e-3, atol=5e-3)
+
+
+def test_block_least_squares_multi_block_converges():
+    """Block solver approaches the exact joint solve with iterations
+    (reference BlockLinearMapperSuite:17-55)."""
+    A, Y = make_problem(n=400, d=30, k=2, seed=3)
+    lam = 0.4
+    model = BlockLeastSquaresEstimator(block_size=10, num_iter=25, lam=lam).fit(A, Y)
+    W, Am, Ym = centered_ridge(A, Y, lam)
+    np.testing.assert_allclose(model.weights, W, rtol=3e-2, atol=3e-2)
+    out = model(A).numpy()
+    expect = (A - Am) @ W + Ym
+    np.testing.assert_allclose(out, expect, rtol=5e-2, atol=5e-2)
+
+
+def test_block_linear_mapper_apply_blocks_equivalent():
+    rng = np.random.RandomState(0)
+    blocks = [rng.randn(8, 3).astype(np.float32) for _ in range(3)]
+    x = rng.randn(5, 24).astype(np.float32)
+    mapper = BlockLinearMapper(blocks, 8)
+    out = mapper(x).numpy()
+    expect = x @ np.concatenate(blocks, 0)
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-4)
+
+
+def test_weight_property():
+    est = BlockLeastSquaresEstimator(block_size=10, num_iter=4, lam=0)
+    assert est.weight == 13  # 3*numIter+1, BlockLinearMapper.scala:204
+
+
+def test_padding_does_not_corrupt_solve():
+    # n=101 deliberately not divisible by 8
+    A, Y = make_problem(n=101, d=16, k=2, seed=5)
+    model = BlockLeastSquaresEstimator(block_size=16, num_iter=1, lam=0.2).fit(A, Y)
+    W, Am, Ym = centered_ridge(A, Y, 0.2)
+    np.testing.assert_allclose(model.weights, W, rtol=5e-3, atol=5e-3)

@@ -1,0 +1,49 @@
+"""End-to-end MnistRandomFFT on synthetic separable data (the reference's
+integration test is the app itself, README.md:15-28)."""
+import numpy as np
+
+from keystone_tpu.evaluation.multiclass import evaluate_multiclass
+from keystone_tpu.loaders.csv_loader import LabeledData
+from keystone_tpu.parallel.dataset import ArrayDataset
+from keystone_tpu.pipelines.images.mnist.random_fft import (
+    MnistRandomFFTConfig,
+    run,
+)
+
+
+CENTERS = np.random.RandomState(42).randn(10, 784).astype(np.float32) * 2.0
+
+
+def synthetic_mnist(n, seed):
+    """Linearly separable 784-dim 10-class blobs (shared class centers)."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, size=n)
+    X = CENTERS[labels] + 0.5 * rng.randn(n, 784).astype(np.float32)
+    return LabeledData(
+        data=ArrayDataset.from_numpy(X.astype(np.float32)),
+        labels=ArrayDataset.from_numpy(labels.astype(np.int32)),
+    )
+
+
+def test_mnist_random_fft_end_to_end():
+    train = synthetic_mnist(400, seed=0)
+    test = synthetic_mnist(100, seed=1)
+    config = MnistRandomFFTConfig(
+        num_ffts=2, block_size=512, lam=10.0, seed=0
+    )
+    pipeline, train_eval, test_eval = run(config, train=train, test=test)
+    # Separable blobs through random features must be nearly perfect
+    assert train_eval.total_error < 0.05
+    assert test_eval.total_error < 0.15
+
+
+def test_evaluator_exact_values():
+    preds = np.array([0, 1, 1, 2, 2, 2])
+    actual = np.array([0, 1, 2, 2, 2, 0])
+    m = evaluate_multiclass(preds, actual, 3)
+    assert m.total == 6
+    assert m.confusion[0, 0] == 1 and m.confusion[0, 2] == 1
+    assert m.confusion[2, 2] == 2 and m.confusion[2, 1] == 1
+    assert abs(m.total_accuracy - 4 / 6) < 1e-9
+    p, r, f1 = m.class_metrics(2)
+    assert abs(p - 2 / 3) < 1e-9 and abs(r - 2 / 3) < 1e-9

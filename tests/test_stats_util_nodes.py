@@ -1,0 +1,146 @@
+"""Stats/util node tests vs numpy golden implementations (mirrors the
+reference's per-node suites)."""
+import numpy as np
+import pytest
+
+from keystone_tpu.nodes.stats import (
+    LinearRectifier,
+    NormalizeRows,
+    PaddedFFT,
+    RandomSignNode,
+    SignedHellingerMapper,
+    StandardScaler,
+)
+from keystone_tpu.nodes.util import (
+    ClassLabelIndicatorsFromIntArrayLabels,
+    ClassLabelIndicatorsFromIntLabels,
+    MatrixVectorizer,
+    MaxClassifier,
+    TopKClassifier,
+    VectorCombiner,
+    VectorSplitter,
+)
+from keystone_tpu.parallel.dataset import ArrayDataset
+
+
+def test_random_sign_node():
+    x = np.arange(6, dtype=np.float32)
+    node = RandomSignNode(np.array([1, -1, 1, -1, 1, -1], np.float32))
+    out = node(x[None, :]).numpy()
+    np.testing.assert_array_equal(out[0], x * np.array([1, -1, 1, -1, 1, -1]))
+
+
+def test_random_sign_create_seeded():
+    a = RandomSignNode.create(100, seed=7)
+    b = RandomSignNode.create(100, seed=7)
+    np.testing.assert_array_equal(a.signs, b.signs)
+    assert set(np.unique(a.signs)) <= {-1.0, 1.0}
+
+
+def test_padded_fft_matches_numpy():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 20).astype(np.float32)
+    out = PaddedFFT()(x).numpy()
+    # next pow2 of 20 = 32 -> first 16 real parts
+    padded = np.pad(x, ((0, 0), (0, 12)))
+    expect = np.real(np.fft.fft(padded, axis=-1))[:, :16]
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-4)
+    assert out.shape == (3, 16)
+
+
+def test_linear_rectifier():
+    x = np.array([[-1.0, 0.5, 2.0]], np.float32)
+    out = LinearRectifier(0.0, 0.25)(x).numpy()
+    np.testing.assert_allclose(out[0], np.maximum(0.0, x[0] - 0.25))
+
+
+def test_normalize_rows():
+    x = np.array([[3.0, 4.0]], np.float32)
+    out = NormalizeRows()(x).numpy()
+    np.testing.assert_allclose(out[0], [0.6, 0.8], rtol=1e-6)
+
+
+def test_signed_hellinger():
+    x = np.array([[-4.0, 9.0]], np.float32)
+    out = SignedHellingerMapper()(x).numpy()
+    np.testing.assert_allclose(out[0], [-2.0, 3.0], rtol=1e-6)
+
+
+def test_standard_scaler_matches_numpy():
+    rng = np.random.RandomState(1)
+    x = rng.randn(50, 6).astype(np.float32) * 3 + 1
+    model = StandardScaler().fit(x)
+    np.testing.assert_allclose(model.mean, x.mean(0), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        model.std, x.std(0, ddof=1), rtol=1e-3, atol=1e-4
+    )
+    out = model(x).numpy()
+    np.testing.assert_allclose(out.mean(0), 0, atol=1e-4)
+    np.testing.assert_allclose(out.std(0, ddof=1), 1, rtol=1e-3)
+
+
+def test_standard_scaler_degenerate_column():
+    x = np.ones((10, 3), np.float32)
+    model = StandardScaler().fit(x)
+    np.testing.assert_array_equal(model.std, np.ones(3))
+
+
+def test_standard_scaler_mean_only():
+    x = np.random.RandomState(0).rand(20, 4).astype(np.float32)
+    model = StandardScaler(normalize_std_dev=False).fit(x)
+    assert model.std is None
+
+
+def test_class_label_indicators():
+    node = ClassLabelIndicatorsFromIntLabels(4)
+    out = node(np.array([0, 2, 3], np.int32)).numpy()
+    np.testing.assert_array_equal(
+        out,
+        [[1, -1, -1, -1], [-1, -1, 1, -1], [-1, -1, -1, 1]],
+    )
+
+
+def test_class_label_indicators_array():
+    node = ClassLabelIndicatorsFromIntArrayLabels(5)
+    # padded multi-labels: -1 = absent
+    labels = np.array([[0, 2, -1], [4, -1, -1]], np.int32)
+    out = node(labels).numpy()
+    np.testing.assert_array_equal(out[0], [1, -1, 1, -1, -1])
+    np.testing.assert_array_equal(out[1], [-1, -1, -1, -1, 1])
+
+
+def test_vector_combiner():
+    a = np.ones((4, 2), np.float32)
+    b = np.zeros((4, 3), np.float32)
+    dsa = ArrayDataset.from_numpy(a)
+    z = dsa.zip(ArrayDataset.from_numpy(b))
+    out = VectorCombiner().apply_dataset(z).numpy()
+    assert out.shape == (4, 5)
+    np.testing.assert_array_equal(out[:, :2], a)
+
+
+def test_max_classifier():
+    x = np.array([[0.1, 0.9, 0.2], [1.0, -1.0, 0.0]], np.float32)
+    out = MaxClassifier()(x).numpy()
+    np.testing.assert_array_equal(out, [1, 0])
+
+
+def test_topk_classifier():
+    x = np.array([[0.1, 0.9, 0.5, -0.2]], np.float32)
+    out = TopKClassifier(3)(x).numpy()
+    np.testing.assert_array_equal(out[0], [1, 2, 0])
+
+
+def test_vector_splitter():
+    x = np.arange(10, dtype=np.float32)[None, :]
+    out = VectorSplitter(4)(x).get()
+    parts = out.numpy()
+    assert len(parts) == 3
+    np.testing.assert_array_equal(parts[0][0], [0, 1, 2, 3])
+    np.testing.assert_array_equal(parts[2][0], [8, 9])
+
+
+def test_matrix_vectorizer_column_major():
+    x = np.array([[[1.0, 2.0], [3.0, 4.0]]], np.float32)  # one 2x2 matrix
+    out = MatrixVectorizer()(x).numpy()
+    np.testing.assert_array_equal(out[0], [1, 3, 2, 4])  # column-major
