@@ -1,0 +1,43 @@
+"""CIFAR-10 binary loader (reference ``loaders/CifarLoader.scala:14-51``).
+
+Record layout: 1 label byte + 3072 pixel bytes (1024 R, 1024 G, 1024 B,
+each a row-major 32x32 plane). Pixels stay in [0, 255] floats exactly like
+the reference's byte-backed image layout.
+"""
+from __future__ import annotations
+
+import glob
+import os
+
+import numpy as np
+
+from ..parallel.dataset import ArrayDataset
+from .csv_loader import LabeledData
+
+NROW, NCOL, NCHAN = 32, 32, 3
+RECORD = 1 + NROW * NCOL * NCHAN
+
+
+def load_cifar_numpy(path: str):
+    """Returns (images (n,32,32,3) float32 in [0,255], labels (n,) int32)."""
+    if os.path.isdir(path):
+        files = sorted(glob.glob(os.path.join(path, "*.bin")))
+    else:
+        files = sorted(glob.glob(path)) or [path]
+    imgs, labels = [], []
+    for f in files:
+        raw = np.fromfile(f, dtype=np.uint8)
+        assert raw.size % RECORD == 0, f"corrupt CIFAR file {f}"
+        rec = raw.reshape(-1, RECORD)
+        labels.append(rec[:, 0].astype(np.int32))
+        planes = rec[:, 1:].reshape(-1, NCHAN, NROW, NCOL)
+        imgs.append(planes.transpose(0, 2, 3, 1).astype(np.float32))
+    return np.concatenate(imgs), np.concatenate(labels)
+
+
+def cifar_loader(path: str) -> LabeledData:
+    images, labels = load_cifar_numpy(path)
+    return LabeledData(
+        data=ArrayDataset.from_numpy(images),
+        labels=ArrayDataset.from_numpy(labels),
+    )

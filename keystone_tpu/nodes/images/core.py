@@ -1,0 +1,298 @@
+"""Image pipeline nodes.
+
+TPU-native re-designs of the reference's ``nodes/images`` package
+(SURVEY.md section 2.4). Images are (H, W, C) float arrays; batch
+execution vmaps/convolves over the sharded batch.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops import image_ops
+from ...parallel.dataset import ArrayDataset, Dataset
+from ...workflow.transformer import Transformer
+
+
+class ImageVectorizer(Transformer):
+    """Flatten an image to a vector (reference ``images/ImageVectorizer``)."""
+
+    def apply(self, img):
+        return img.reshape(-1)
+
+
+class PixelScaler(Transformer):
+    """Divide pixels by 255 (reference ``images/PixelScaler``)."""
+
+    def apply(self, img):
+        return img / 255.0
+
+
+class GrayScaler(Transformer):
+    """MATLAB-weight grayscale (reference ``images/GrayScaler``)."""
+
+    def apply(self, img):
+        return image_ops.to_grayscale(img)
+
+
+class Cropper(Transformer):
+    """Static crop [x0:x1, y0:y1] (reference ``images/Cropper``)."""
+
+    def __init__(self, x0: int, y0: int, x1: int, y1: int):
+        self.x0, self.y0, self.x1, self.y1 = x0, y0, x1, y1
+
+    def apply(self, img):
+        return img[self.x0 : self.x1, self.y0 : self.y1, :]
+
+
+class SymmetricRectifier(Transformer):
+    """Channel-doubling rectifier [max(v, x-a), max(v, -x-a)]
+    (reference ``images/SymmetricRectifier.scala:12-30``)."""
+
+    def __init__(self, max_val: float = 0.0, alpha: float = 0.0):
+        self.max_val = float(max_val)
+        self.alpha = float(alpha)
+
+    def apply(self, img):
+        pos = jnp.maximum(self.max_val, img - self.alpha)
+        neg = jnp.maximum(self.max_val, -img - self.alpha)
+        return jnp.concatenate([pos, neg], axis=-1)
+
+
+class Pooler(Transformer):
+    """Strided spatial pooling (reference ``images/Pooler.scala:20-68``).
+    pixel_fn/pool_fn are named ('identity'|'abs'|'square',
+    'sum'|'max'|'mean') so node equality stays structural."""
+
+    def __init__(
+        self,
+        stride: int,
+        pool_size: int,
+        pixel_fn: str = "identity",
+        pool_fn: str = "sum",
+    ):
+        self.stride = stride
+        self.pool_size = pool_size
+        self.pixel_fn = pixel_fn
+        self.pool_fn = pool_fn
+
+    def apply(self, img):
+        return image_ops.pool_image(
+            img, self.stride, self.pool_size, self.pixel_fn, self.pool_fn
+        )
+
+
+class Convolver(Transformer):
+    """Filter-bank convolution with optional per-patch normalization and
+    whitening fold-in (reference ``images/Convolver.scala:20-45``).
+
+    ``filters`` is (num_filters, conv_size^2 * channels) in (dy, dx, c)
+    feature order, pre-whitened by the caller exactly as in the reference
+    (filters_normalized @ whitener.T); the whitener's means are subtracted
+    from each normalized patch. Executes as pure XLA convolutions — see
+    ``ops/image_ops.filter_bank_convolve``.
+    """
+
+    def __init__(
+        self,
+        filters: np.ndarray,
+        img_height: int,
+        img_width: int,
+        img_channels: int,
+        whitener: Optional["ZCAWhitener"] = None,
+        normalize_patches: bool = True,
+        var_constant: float = 10.0,
+    ):
+        self.filters = np.asarray(filters, dtype=np.float32)
+        self.img_height = img_height
+        self.img_width = img_width
+        self.img_channels = img_channels
+        self.whitener = whitener
+        self.normalize_patches = normalize_patches
+        self.var_constant = var_constant
+        self.conv_size = int(
+            round((self.filters.shape[1] / img_channels) ** 0.5)
+        )
+
+    def eq_key(self):
+        return (
+            Convolver,
+            self.filters.tobytes(),
+            self.img_height,
+            self.img_width,
+            self.img_channels,
+            None if self.whitener is None else self.whitener.means.tobytes(),
+            self.normalize_patches,
+            self.var_constant,
+        )
+
+    def apply(self, img):
+        means = None if self.whitener is None else jnp.asarray(self.whitener.means)
+        return image_ops.filter_bank_convolve(
+            img,
+            jnp.asarray(self.filters),
+            self.conv_size,
+            self.img_channels,
+            self.normalize_patches,
+            means,
+            self.var_constant,
+        )
+
+
+class Windower(Transformer):
+    """Dense sliding-window patch extraction (reference
+    ``images/Windower.scala:14-55``). A 1->many node: each image yields
+    all its windows, so the output dataset has n * num_windows items.
+    Padding rows of the input batch map to trailing zero windows, so the
+    true count stays exact."""
+
+    def __init__(self, stride: int, window_size: int):
+        self.stride = stride
+        self.window_size = window_size
+
+    def apply(self, img):
+        w = image_ops.extract_windows(img, self.window_size, self.stride)
+        nH, nW, S, _, C = w.shape
+        return w.reshape(nH * nW, S, S, C)
+
+    def apply_dataset(self, ds: Dataset) -> Dataset:
+        assert isinstance(ds, ArrayDataset)
+        out = ds.map_batch(self._batched())
+        data = out.data  # (P, num_windows, S, S, C)
+        P, num_windows = data.shape[0], data.shape[1]
+        flat = _flatten_leading(data)
+        return ArrayDataset(
+            flat, n=ds.n * num_windows, mesh=ds.mesh, _already_sharded=True
+        )
+
+
+class RandomPatcher(Transformer):
+    """Uniformly random crops, ``num_patches`` per image (reference
+    ``images/RandomPatcher.scala:17-46``). Deterministic per (seed, item
+    index)."""
+
+    def __init__(self, num_patches: int, patch_size_x: int, patch_size_y: int,
+                 seed: int = 0):
+        self.num_patches = num_patches
+        self.patch_size_x = patch_size_x
+        self.patch_size_y = patch_size_y
+        self.seed = seed
+
+    def apply_dataset(self, ds: Dataset) -> Dataset:
+        assert isinstance(ds, ArrayDataset)
+        px, py, npp = self.patch_size_x, self.patch_size_y, self.num_patches
+        seed = self.seed
+
+        def batch(imgs):
+            P, H, W, C = imgs.shape
+            keys = jax.vmap(jax.random.fold_in, (None, 0))(
+                jax.random.PRNGKey(seed), jnp.arange(P)
+            )
+
+            def one(img, key):
+                kx, ky = jax.random.split(key)
+                xs = jax.random.randint(kx, (npp,), 0, H - px + 1)
+                ys = jax.random.randint(ky, (npp,), 0, W - py + 1)
+
+                def crop(x, y):
+                    return jax.lax.dynamic_slice(img, (x, y, 0), (px, py, C))
+
+                return jax.vmap(crop)(xs, ys)
+
+            return jax.vmap(one)(imgs, keys)
+
+        out = ds.map_batch(batch)
+        return ArrayDataset(
+            _flatten_leading(out.data),
+            n=ds.n * npp,
+            mesh=ds.mesh,
+            _already_sharded=True,
+        )
+
+
+class CenterCornerPatcher(Transformer):
+    """Center + four corner crops, optionally with horizontal flips —
+    test-time augmentation (reference ``images/CenterCornerPatcher.scala``).
+    Yields 5 (or 10) patches per image."""
+
+    def __init__(self, patch_size_x: int, patch_size_y: int, horizontal_flips: bool = False):
+        self.patch_size_x = patch_size_x
+        self.patch_size_y = patch_size_y
+        self.horizontal_flips = horizontal_flips
+
+    @property
+    def patches_per_image(self) -> int:
+        return 10 if self.horizontal_flips else 5
+
+    def apply(self, img):
+        H, W, C = img.shape
+        px, py = self.patch_size_x, self.patch_size_y
+        starts = [
+            (0, 0),
+            (0, W - py),
+            (H - px, 0),
+            (H - px, W - py),
+            ((H - px) // 2, (W - py) // 2),
+        ]
+        crops = [img[x : x + px, y : y + py, :] for x, y in starts]
+        if self.horizontal_flips:
+            crops = crops + [c[:, ::-1, :] for c in crops]
+        return jnp.stack(crops)
+
+    def apply_dataset(self, ds: Dataset) -> Dataset:
+        assert isinstance(ds, ArrayDataset)
+        out = ds.map_batch(self._batched())
+        return ArrayDataset(
+            _flatten_leading(out.data),
+            n=ds.n * self.patches_per_image,
+            mesh=ds.mesh,
+            _already_sharded=True,
+        )
+
+
+class RandomFlipper(Transformer):
+    """Horizontal flip with probability p — train-time augmentation
+    (reference ``images/RandomImageTransformer.scala:16-30``)."""
+
+    def __init__(self, prob: float = 0.5, seed: int = 0):
+        self.prob = prob
+        self.seed = seed
+
+    def apply_dataset(self, ds: Dataset) -> Dataset:
+        assert isinstance(ds, ArrayDataset)
+        prob, seed = self.prob, self.seed
+
+        def batch(imgs):
+            P = imgs.shape[0]
+            flips = jax.random.uniform(jax.random.PRNGKey(seed), (P,)) < prob
+            flipped = imgs[:, :, ::-1, :]
+            return jnp.where(flips[:, None, None, None], flipped, imgs)
+
+        return ds.map_batch(batch)
+
+    def apply(self, img):
+        return img
+
+
+class LabelExtractor(Transformer):
+    """(image, label) -> label (reference ``images/LabeledImageExtractors``)."""
+
+    def apply(self, item):
+        return item[1]
+
+
+class ImageExtractor(Transformer):
+    """(image, label) -> image."""
+
+    def apply(self, item):
+        return item[0]
+
+
+def _flatten_leading(data):
+    """(P, M, ...) -> (P*M, ...), preserving row sharding."""
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:]), data
+    )

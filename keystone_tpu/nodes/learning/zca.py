@@ -1,0 +1,50 @@
+"""ZCA whitening (reference ``nodes/learning/ZCAWhitener.scala``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...parallel.dataset import ArrayDataset, Dataset
+from ...workflow.estimator import Estimator
+from ...workflow.transformer import Transformer
+
+
+class ZCAWhitener(Transformer):
+    """(x - means) @ whitener (reference ZCAWhitener.scala:12-18).
+    Operates on patch matrices or vectors."""
+
+    def __init__(self, whitener: np.ndarray, means: np.ndarray):
+        self.whitener = np.asarray(whitener, dtype=np.float32)
+        self.means = np.asarray(means, dtype=np.float32)
+
+    def apply(self, x):
+        return (x - self.means) @ self.whitener
+
+
+class ZCAWhitenerEstimator(Estimator):
+    """Fit W = V diag((s^2/(n-1) + eps)^-1/2) V^T on the (sampled) input
+    matrix (reference ZCAWhitenerEstimator.scala:30-76, which runs LAPACK
+    sgesvd on the driver; here the SVD is a replicated XLA computation)."""
+
+    def __init__(self, eps: float = 0.1):
+        self.eps = eps
+
+    def fit_single(self, mat: np.ndarray) -> ZCAWhitener:
+        W, means = _fit_zca(jnp.asarray(mat, jnp.float32), self.eps)
+        return ZCAWhitener(np.asarray(W), np.asarray(means))
+
+    def _fit(self, ds: Dataset) -> ZCAWhitener:
+        assert isinstance(ds, ArrayDataset)
+        return self.fit_single(ds.numpy())
+
+
+@jax.jit
+def _fit_zca(mat, eps):
+    n = mat.shape[0]
+    means = jnp.mean(mat, axis=0)
+    centered = mat - means
+    _, s, vt = jnp.linalg.svd(centered, full_matrices=False)
+    scale = (s * s / (n - 1.0) + eps) ** -0.5
+    W = (vt.T * scale) @ vt
+    return W, means

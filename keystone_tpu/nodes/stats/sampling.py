@@ -1,0 +1,64 @@
+"""Sampling nodes (reference ``stats/Sampling.scala``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...parallel.dataset import ArrayDataset, Dataset, HostDataset
+from ...workflow.transformer import Transformer
+
+
+class Sampler(Transformer):
+    """Random subsample of approximately ``size`` items (reference
+    ``Sampler``: RDD takeSample without replacement). Deterministic seed."""
+
+    def __init__(self, size: int, seed: int = 42):
+        self.size = size
+        self.seed = seed
+
+    def apply(self, x):
+        return x
+
+    def apply_dataset(self, ds: Dataset) -> Dataset:
+        n = len(ds)
+        take = min(self.size, n)
+        rng = np.random.RandomState(self.seed)
+        idx = rng.choice(n, size=take, replace=False)
+        idx.sort()
+        if isinstance(ds, ArrayDataset):
+            import jax
+
+            data = jax.tree_util.tree_map(
+                lambda x: np.asarray(x)[idx], ds.data
+            )
+            return ArrayDataset(data, take, ds.mesh)
+        items = ds.collect()
+        return HostDataset([items[i] for i in idx])
+
+
+class ColumnSampler(Transformer):
+    """Sample ``num_cols`` columns of each per-item (d, cols) matrix
+    (reference ``ColumnSampler``, used to subsample SIFT descriptors)."""
+
+    def __init__(self, num_cols: int, seed: int = 42):
+        self.num_cols = num_cols
+        self.seed = seed
+
+    def apply(self, x):
+        # deterministic per-node sample of columns; jax-traceable via fixed
+        # host-side indices requires static col count, so sample uniformly
+        # with a fixed numpy draw over the static shape
+        cols = x.shape[-1]
+        rng = np.random.RandomState(self.seed)
+        idx = rng.choice(cols, size=min(self.num_cols, cols), replace=False)
+        idx.sort()
+        return x[..., jnp.asarray(idx)]
+
+
+def sample_rows(mat: np.ndarray, num_rows: int, seed: int = 0) -> np.ndarray:
+    """Random row subset (reference ``MatrixUtils.sampleRows``)."""
+    rng = np.random.RandomState(seed)
+    take = min(num_rows, mat.shape[0])
+    idx = rng.choice(mat.shape[0], size=take, replace=False)
+    idx.sort()
+    return np.asarray(mat)[idx]

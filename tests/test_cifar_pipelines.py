@@ -1,0 +1,47 @@
+"""End-to-end CIFAR pipelines on synthetic data."""
+import numpy as np
+
+from keystone_tpu.loaders.csv_loader import LabeledData
+from keystone_tpu.parallel.dataset import ArrayDataset
+from keystone_tpu.pipelines.images.cifar.linear_pixels import (
+    LinearPixelsConfig,
+    run as run_linear,
+)
+from keystone_tpu.pipelines.images.cifar.random_patch_cifar import (
+    RandomCifarConfig,
+    run as run_patch,
+)
+
+CENTERS = np.random.RandomState(7).rand(10, 32, 32, 3).astype(np.float32) * 255
+
+
+def synthetic_cifar(n, seed):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, size=n)
+    imgs = CENTERS[labels] + 20 * rng.randn(n, 32, 32, 3).astype(np.float32)
+    imgs = np.clip(imgs, 0, 255)
+    return LabeledData(
+        data=ArrayDataset.from_numpy(imgs),
+        labels=ArrayDataset.from_numpy(labels.astype(np.int32)),
+    )
+
+
+def test_linear_pixels_end_to_end():
+    train = synthetic_cifar(300, 0)
+    test = synthetic_cifar(80, 1)
+    _, train_eval, test_eval = run_linear(
+        LinearPixelsConfig(lam=10.0), train=train, test=test
+    )
+    assert train_eval.total_error < 0.05
+    assert test_eval.total_error < 0.2
+
+
+def test_random_patch_cifar_end_to_end():
+    train = synthetic_cifar(200, 2)
+    test = synthetic_cifar(60, 3)
+    config = RandomCifarConfig(
+        num_filters=32, lam=100.0, patch_steps=3, seed=0
+    )
+    _, train_eval, test_eval = run_patch(config, train=train, test=test)
+    assert train_eval.total_error < 0.05
+    assert test_eval.total_error < 0.25
