@@ -1,0 +1,84 @@
+"""Benchmark harness: RandomPatchCifar featurization + solve throughput.
+
+Measures end-to-end images/sec/chip for the north-star pipeline
+(Convolver -> SymmetricRectifier -> Pooler -> vectorize -> linear model)
+at a realistic configuration (1024 filters, 6x6 patches, 14/13 pooling) on
+whatever accelerator is attached. Prints ONE JSON line:
+{"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+
+vs_baseline is measured throughput / 10_000 images/sec/chip — the
+BASELINE.json north-star target for v5e.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build_bench(num_filters=1024, patch_size=6, alpha=0.25):
+    from keystone_tpu.ops.image_ops import filter_bank_convolve, pool_image
+
+    rng = np.random.RandomState(0)
+    filters = rng.randn(num_filters, patch_size * patch_size * 3).astype(np.float32)
+    means = rng.randn(patch_size * patch_size * 3).astype(np.float32) * 0.01
+    w = rng.randn(num_filters * 2 * 2 * 2, 10).astype(np.float32) * 0.01
+    b = rng.randn(10).astype(np.float32)
+
+    @jax.jit
+    def featurize_and_predict(imgs):
+        def one(img):
+            conv = filter_bank_convolve(
+                img, jnp.asarray(filters), patch_size, 3, True,
+                jnp.asarray(means), 10.0,
+            )
+            pos = jnp.maximum(0.0, conv - alpha)
+            neg = jnp.maximum(0.0, -conv - alpha)
+            r = jnp.concatenate([pos, neg], axis=-1)
+            pooled = pool_image(r, 13, 14, "identity", "sum")
+            return pooled.reshape(-1)
+
+        feats = jax.vmap(one)(imgs)
+        return jnp.argmax(feats @ w + b, axis=-1)
+
+    return featurize_and_predict
+
+
+def main():
+    n_dev = len(jax.devices())
+    batch = 1024
+    imgs = np.random.RandomState(1).rand(batch, 32, 32, 3).astype(np.float32) * 255
+    imgs = jax.device_put(imgs)
+
+    fn = build_bench()
+    # warmup / compile; np.asarray forces a full host sync (the axon
+    # platform's block_until_ready can return before execution completes)
+    np.asarray(fn(imgs))
+    np.asarray(fn(imgs))
+
+    iters = 10
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = fn(imgs)
+    np.asarray(out)
+    elapsed = time.perf_counter() - start
+
+    images_per_sec = batch * iters / elapsed
+    per_chip = images_per_sec / n_dev
+    print(
+        json.dumps(
+            {
+                "metric": "cifar_randompatch_images_per_sec_per_chip",
+                "value": round(per_chip, 1),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(per_chip / 10000.0, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
