@@ -1,15 +1,67 @@
 """Learning nodes: solvers and models (reference ``nodes/learning``,
 SURVEY.md section 2.3)."""
+from .classifiers import (
+    LinearDiscriminantAnalysis,
+    LocalLeastSquaresEstimator,
+    LogisticRegressionEstimator,
+    LogisticRegressionModel,
+    NaiveBayesEstimator,
+    NaiveBayesModel,
+    SparseLinearMapper,
+)
+from .gmm import (
+    GaussianMixtureModel,
+    GaussianMixtureModelEstimator,
+    KMEANS_PLUS_PLUS_INITIALIZATION,
+    RANDOM_INITIALIZATION,
+)
+from .kmeans import KMeansModel, KMeansPlusPlusEstimator
+from .lbfgs import DenseLBFGSwithL2
 from .linear import (
     BlockLeastSquaresEstimator,
     BlockLinearMapper,
     LinearMapEstimator,
     LinearMapper,
 )
+from .pca import (
+    ApproximatePCAEstimator,
+    BatchPCATransformer,
+    ColumnPCAEstimator,
+    DistributedColumnPCAEstimator,
+    DistributedPCAEstimator,
+    LocalColumnPCAEstimator,
+    PCAEstimator,
+    PCATransformer,
+)
+from .zca import ZCAWhitener, ZCAWhitenerEstimator
 
 __all__ = [
     "BlockLeastSquaresEstimator",
     "BlockLinearMapper",
     "LinearMapEstimator",
     "LinearMapper",
+    "DenseLBFGSwithL2",
+    "KMeansModel",
+    "KMeansPlusPlusEstimator",
+    "GaussianMixtureModel",
+    "GaussianMixtureModelEstimator",
+    "KMEANS_PLUS_PLUS_INITIALIZATION",
+    "RANDOM_INITIALIZATION",
+    "PCAEstimator",
+    "PCATransformer",
+    "BatchPCATransformer",
+    "ColumnPCAEstimator",
+    "LocalColumnPCAEstimator",
+    "DistributedColumnPCAEstimator",
+    "DistributedPCAEstimator",
+    "ApproximatePCAEstimator",
+    "ZCAWhitener",
+    "ZCAWhitenerEstimator",
+    "NaiveBayesEstimator",
+    "NaiveBayesModel",
+    "LogisticRegressionEstimator",
+    "LogisticRegressionModel",
+    "LinearDiscriminantAnalysis",
+    "LocalLeastSquaresEstimator",
+    "SparseLinearMapper",
 ]

@@ -1,0 +1,214 @@
+"""PCA family (reference ``nodes/learning/PCA.scala`` and
+``DistributedPCA.scala``, ``ApproximatePCA.scala``).
+
+The reference's driver-local LAPACK sgesvd becomes a replicated XLA SVD;
+the distributed variant keeps the communication-avoiding TSQR structure
+(per-shard QR + all-gather + QR) with only the small R factor crossing the
+interconnect.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops import linalg
+from ...parallel.dataset import ArrayDataset, Dataset, HostDataset
+from ...workflow.estimator import Estimator
+from ...workflow.transformer import Transformer
+
+
+def enforce_matlab_sign_convention(pca: np.ndarray) -> np.ndarray:
+    """Largest-magnitude element of each column becomes positive
+    (reference PCA.scala:238-247)."""
+    col_max = pca.max(axis=0)
+    abs_max = np.abs(pca).max(axis=0)
+    signs = np.where(col_max == abs_max, 1.0, -1.0).astype(pca.dtype)
+    return pca * signs
+
+
+class PCATransformer(Transformer):
+    """x -> pca_mat^T x (reference PCA.scala:19-30). pca_mat is (d, k)."""
+
+    def __init__(self, pca_mat: np.ndarray):
+        self.pca_mat = np.asarray(pca_mat, dtype=np.float32)
+
+    def apply(self, x):
+        return self.pca_mat.T @ x
+
+
+class BatchPCATransformer(Transformer):
+    """Per-item matrix projection: (d, cols) -> (k, cols)
+    (reference PCA.scala:38-43)."""
+
+    def __init__(self, pca_mat: np.ndarray):
+        self.pca_mat = np.asarray(pca_mat, dtype=np.float32)
+
+    def apply(self, x):
+        return self.pca_mat.T @ x
+
+
+def _svd_pca(data: jnp.ndarray, dims: int) -> np.ndarray:
+    n = data.shape[0]
+
+    @jax.jit
+    def run(X):
+        means = jnp.mean(X, axis=0)
+        _, _, vt = jnp.linalg.svd(X - means, full_matrices=False)
+        return vt
+
+    vt = np.asarray(run(data))
+    pca = enforce_matlab_sign_convention(vt.T)
+    return pca[:, :dims]
+
+
+class PCAEstimator(Estimator):
+    """Local PCA: collect the (sampled) data, center, SVD
+    (reference PCA.scala:163-210)."""
+
+    def __init__(self, dims: int):
+        self.dims = dims
+
+    def _fit(self, ds: Dataset) -> PCATransformer:
+        X = _collect_matrix(ds)
+        return PCATransformer(self.compute_pca(X))
+
+    def compute_pca(self, X: np.ndarray) -> np.ndarray:
+        return _svd_pca(jnp.asarray(X, jnp.float32), self.dims)
+
+    def cost(self, n, d, k, sparsity, num_machines, cpu_w, mem_w, net_w) -> float:
+        """Reference cost model (PCA.scala:~213-226): all data moves to one
+        machine."""
+        flops = n * d * d
+        bytes_scanned = n * d
+        network = n * d
+        return max(cpu_w * flops, mem_w * bytes_scanned) + net_w * network
+
+
+class DistributedPCAEstimator(Estimator):
+    """Distributed PCA via TSQR: center by broadcast means, tree-QR to the
+    small R factor, local SVD of R (reference DistributedPCA.scala:34-57)."""
+
+    def __init__(self, dims: int):
+        self.dims = dims
+
+    def _fit(self, ds: Dataset) -> PCATransformer:
+        assert isinstance(ds, ArrayDataset)
+        n = ds.n
+        X = ds.data
+        means = linalg.distributed_mean(X, n)
+
+        @jax.jit
+        def center(X, means, mask):
+            return (X - means) * mask[:, None].astype(X.dtype)
+
+        Xc = center(X, means, ds.mask)
+        R = linalg.tsqr_r(Xc)
+        _, _, vt = np.linalg.svd(np.asarray(R))
+        pca = enforce_matlab_sign_convention(vt.T.astype(np.float32))
+        return PCATransformer(pca[:, : self.dims])
+
+    def cost(self, n, d, k, sparsity, num_machines, cpu_w, mem_w, net_w) -> float:
+        """Reference cost model (DistributedPCA.scala:59-73)."""
+        log2m = np.log2(max(num_machines, 2))
+        flops = n * d * d / num_machines + d * d * d * log2m
+        bytes_scanned = n * d
+        network = d * d * log2m
+        return max(cpu_w * flops, mem_w * bytes_scanned) + net_w * network
+
+
+class ApproximatePCAEstimator(Estimator):
+    """Randomized-sketch PCA, Halko-Martinsson-Tropp algs 4.4/5.1
+    (reference ApproximatePCA.scala:38-86): Gaussian sketch, q power
+    iterations with intermediate QRs, then SVD of the projected matrix."""
+
+    def __init__(self, dims: int, q: int = 10, p: int = 5, seed: int = 0):
+        self.dims = dims
+        self.q = q
+        self.p = p
+        self.seed = seed
+
+    def _fit(self, ds: Dataset) -> PCATransformer:
+        X = _collect_matrix(ds)
+        return PCATransformer(self.approximate_pca(X))
+
+    def approximate_pca(self, X: np.ndarray) -> np.ndarray:
+        rng = np.random.RandomState(self.seed)
+        ell = self.dims + self.p
+        omega = rng.randn(X.shape[1], ell).astype(np.float32)
+
+        @jax.jit
+        def run(X, omega):
+            means = jnp.mean(X, axis=0)
+            A = X - means
+            Y = A @ omega
+            Q, _ = jnp.linalg.qr(Y)
+            for _ in range(self.q):
+                Q, _ = jnp.linalg.qr(A.T @ Q)
+                Q, _ = jnp.linalg.qr(A @ Q)
+            B = Q.T @ A
+            _, _, vt = jnp.linalg.svd(B, full_matrices=False)
+            return vt
+
+        vt = np.asarray(run(jnp.asarray(X, jnp.float32), jnp.asarray(omega)))
+        pca = enforce_matlab_sign_convention(vt.T)
+        return pca[:, : self.dims]
+
+
+class LocalColumnPCAEstimator(Estimator):
+    """Fits PCA treating each column of per-item matrices as a sample
+    (reference PCA.scala:51-76); emits BatchPCATransformer."""
+
+    def __init__(self, dims: int):
+        self.dims = dims
+
+    def _fit(self, ds: Dataset) -> BatchPCATransformer:
+        cols = _stack_item_columns(ds)
+        pca = PCAEstimator(self.dims).compute_pca(cols)
+        return BatchPCATransformer(pca)
+
+
+class DistributedColumnPCAEstimator(Estimator):
+    """Distributed variant of the column PCA (reference PCA.scala:78-102)."""
+
+    def __init__(self, dims: int):
+        self.dims = dims
+
+    def _fit(self, ds: Dataset) -> BatchPCATransformer:
+        cols = _stack_item_columns(ds)
+        fitted = DistributedPCAEstimator(self.dims).fit(
+            ArrayDataset.from_numpy(cols)
+        )
+        return BatchPCATransformer(fitted.pca_mat)
+
+
+class ColumnPCAEstimator(Estimator):
+    """Cost-model-optimizable column PCA (reference PCA.scala:118-156).
+    Until the node-level optimizer chooses, defaults to the distributed
+    implementation."""
+
+    def __init__(self, dims: int):
+        self.dims = dims
+
+    @property
+    def options(self):
+        return [LocalColumnPCAEstimator(self.dims), DistributedColumnPCAEstimator(self.dims)]
+
+    def _fit(self, ds: Dataset) -> BatchPCATransformer:
+        return DistributedColumnPCAEstimator(self.dims)._fit(ds)
+
+
+def _collect_matrix(ds: Dataset) -> np.ndarray:
+    if isinstance(ds, ArrayDataset):
+        return ds.numpy()
+    return np.stack(ds.collect())
+
+
+def _stack_item_columns(ds: Dataset) -> np.ndarray:
+    """Items are (d, cols) matrices; stack all columns as rows (the
+    reference's matrixToColArray flatMap)."""
+    if isinstance(ds, ArrayDataset):
+        arr = ds.numpy()  # (n, d, cols)
+        return arr.transpose(0, 2, 1).reshape(-1, arr.shape[1])
+    items = ds.collect()
+    return np.concatenate([np.asarray(m).T for m in items], axis=0)

@@ -1,0 +1,144 @@
+"""L-BFGS minimizer as a single jitted XLA program.
+
+Replaces the reference's driver-side Breeze LBFGS over a distributed
+CostFun (``nodes/learning/LBFGS.scala:79-121``). There, every iteration
+broadcasts weights, computes per-partition gradients, and treeReduces;
+here the objective closes over mesh-sharded arrays, so each function
+evaluation is a sharded GEMM + all-reduce and the entire optimization loop
+(two-loop recursion, Armijo backtracking line search, convergence test)
+runs on-device under ``lax.while_loop`` with a fixed-size history buffer —
+no per-iteration host round trip.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LBFGSResult(NamedTuple):
+    x: jax.Array
+    f: jax.Array
+    num_iters: jax.Array
+
+
+def _flat_dot(a, b):
+    return jnp.vdot(a.reshape(-1), b.reshape(-1))
+
+
+def lbfgs(
+    value_and_grad: Callable[[jax.Array], Tuple[jax.Array, jax.Array]],
+    x0: jax.Array,
+    max_iters: int,
+    num_corrections: int = 10,
+    tol: float = 1e-4,
+    ls_max_steps: int = 20,
+    c1: float = 1e-4,
+) -> LBFGSResult:
+    """Minimize with limited-memory BFGS + Armijo backtracking.
+
+    Convergence mirrors Breeze's default: relative improvement of the
+    objective below ``tol`` (checked on consecutive accepted steps), with
+    a curvature-skip guard on history updates.
+    """
+    m = num_corrections
+    dim = x0.size
+    dtype = x0.dtype
+
+    f0, g0 = value_and_grad(x0)
+
+    def line_search(x, f, g, d):
+        gtd = _flat_dot(g, d)
+        # initial step: 1/|g| on the first iteration shape-alike heuristic is
+        # handled by the caller scaling d; here start at t=1
+        def cond(carry):
+            t, steps, fn, _ = carry
+            return (fn > f + c1 * t * gtd) & (steps < ls_max_steps)
+
+        def body(carry):
+            t, steps, _, _ = carry
+            t = t * 0.5
+            fn, gn = value_and_grad(x + t * d)
+            return (t, steps + 1, fn, gn)
+
+        f1, g1 = value_and_grad(x + d)
+        t, steps, fn, gn = jax.lax.while_loop(
+            cond, body, (jnp.asarray(1.0, dtype), 0, f1, g1)
+        )
+        return t, fn, gn
+
+    def direction(g, S, Y, rho, k):
+        """Two-loop recursion over the circular (m, dim) history."""
+        q = g.reshape(-1)
+        count = jnp.minimum(k, m)
+
+        def bwd(i, carry):
+            q, alphas = carry
+            slot = jnp.mod(k - 1 - i, m)
+            valid = i < count
+            alpha = jnp.where(valid, rho[slot] * jnp.dot(S[slot], q), 0.0)
+            q = q - alpha * Y[slot] * valid
+            return q, alphas.at[i].set(alpha)
+
+        q, alphas = jax.lax.fori_loop(
+            0, m, bwd, (q, jnp.zeros((m,), dtype))
+        )
+
+        last = jnp.mod(k - 1, m)
+        ys = jnp.dot(S[last], Y[last])
+        yy = jnp.dot(Y[last], Y[last])
+        gamma = jnp.where(k > 0, ys / jnp.maximum(yy, 1e-30), 1.0)
+        r = gamma * q
+
+        def fwd(i, r):
+            j = m - 1 - i
+            slot = jnp.mod(k - 1 - j, m)
+            valid = j < count
+            beta = jnp.where(valid, rho[slot] * jnp.dot(Y[slot], r), 0.0)
+            return r + (alphas[j] - beta) * S[slot] * valid
+
+        r = jax.lax.fori_loop(0, m, fwd, r)
+        return -r.reshape(g.shape)
+
+    def cond(state):
+        x, f, g, S, Y, rho, k, it, done = state
+        return (~done) & (it < max_iters)
+
+    def body(state):
+        x, f, g, S, Y, rho, k, it, _ = state
+        d = direction(g, S, Y, rho, k)
+        # safeguard: if d is not a descent direction, restart with -g
+        gtd = _flat_dot(g, d)
+        d = jnp.where(gtd < 0, d, -g)
+        # first-iteration step scaling (Breeze-style 1/|g|)
+        scale = jnp.where(
+            k == 0, 1.0 / jnp.maximum(jnp.linalg.norm(g.reshape(-1)), 1.0), 1.0
+        )
+        d = d * scale
+        t, fn, gn = line_search(x, f, g, d)
+        xn = x + t * d
+
+        s = (xn - x).reshape(-1)
+        y = (gn - g).reshape(-1)
+        sy = jnp.dot(s, y)
+        slot = jnp.mod(k, m)
+        do_update = sy > 1e-10
+        S = jnp.where(do_update, S.at[slot].set(s), S)
+        Y = jnp.where(do_update, Y.at[slot].set(y), Y)
+        rho = jnp.where(do_update, rho.at[slot].set(1.0 / sy), rho)
+        k = k + do_update.astype(k.dtype)
+
+        rel_imp = jnp.abs(f - fn) / jnp.maximum(
+            jnp.maximum(jnp.abs(f), jnp.abs(fn)), 1e-12
+        )
+        done = rel_imp < tol
+        return (xn, fn, gn, S, Y, rho, k, it + 1, done)
+
+    S = jnp.zeros((m, dim), dtype)
+    Y = jnp.zeros((m, dim), dtype)
+    rho = jnp.zeros((m,), dtype)
+    init = (x0, f0, g0, S, Y, rho, jnp.int32(0), jnp.int32(0), jnp.bool_(False))
+    x, f, g, S, Y, rho, k, it, done = jax.lax.while_loop(cond, body, init)
+    return LBFGSResult(x=x, f=f, num_iters=it)

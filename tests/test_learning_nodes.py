@@ -1,0 +1,213 @@
+"""Learning node tests vs closed-form / sklearn-style golden checks
+(mirrors the reference's PCA/KMeans/GMM/LBFGS suites)."""
+import numpy as np
+import pytest
+
+from keystone_tpu.nodes.learning import (
+    ApproximatePCAEstimator,
+    DenseLBFGSwithL2,
+    DistributedPCAEstimator,
+    GaussianMixtureModelEstimator,
+    KMeansPlusPlusEstimator,
+    LinearDiscriminantAnalysis,
+    LocalLeastSquaresEstimator,
+    LogisticRegressionEstimator,
+    NaiveBayesEstimator,
+    PCAEstimator,
+)
+from keystone_tpu.parallel.dataset import ArrayDataset
+
+
+# -- PCA -------------------------------------------------------------------
+
+def pca_problem(n=300, d=10, seed=0):
+    rng = np.random.RandomState(seed)
+    base = rng.randn(n, 3).astype(np.float32)
+    mix = rng.randn(3, d).astype(np.float32) * 3
+    return base @ mix + 0.05 * rng.randn(n, d).astype(np.float32)
+
+
+def numpy_pca(X, dims):
+    Xc = X - X.mean(0)
+    _, _, vt = np.linalg.svd(Xc, full_matrices=False)
+    pca = vt.T
+    col_max, abs_max = pca.max(0), np.abs(pca).max(0)
+    return pca * np.where(col_max == abs_max, 1.0, -1.0)[None, :][:, : dims][
+        ..., : dims
+    ] if False else (pca * np.where(col_max == abs_max, 1.0, -1.0))[:, :dims]
+
+
+def test_local_pca_matches_numpy():
+    X = pca_problem()
+    model = PCAEstimator(3).fit(X)
+    expect = numpy_pca(X, 3)
+    np.testing.assert_allclose(np.abs(model.pca_mat), np.abs(expect), rtol=5e-2, atol=5e-2)
+    # sign convention: largest-|.| entry of each column positive
+    for j in range(3):
+        col = model.pca_mat[:, j]
+        assert col[np.argmax(np.abs(col))] > 0
+
+
+def test_distributed_pca_matches_local():
+    X = pca_problem(n=512, d=8, seed=1)
+    local = PCAEstimator(3).fit(X)
+    dist = DistributedPCAEstimator(3).fit(ArrayDataset.from_numpy(X))
+    np.testing.assert_allclose(
+        np.abs(dist.pca_mat), np.abs(local.pca_mat), rtol=5e-2, atol=5e-2
+    )
+
+
+def test_approximate_pca_spans_same_subspace():
+    X = pca_problem(n=400, d=12, seed=2)
+    exact = PCAEstimator(3).fit(X).pca_mat
+    approx = ApproximatePCAEstimator(3, q=5, seed=0).fit(X).pca_mat
+    # subspace angle check: projections should be ~equal
+    P_exact = exact @ exact.T
+    P_approx = approx @ approx.T
+    np.testing.assert_allclose(P_exact, P_approx, atol=0.05)
+
+
+# -- KMeans ----------------------------------------------------------------
+
+def test_kmeans_recovers_separated_clusters():
+    rng = np.random.RandomState(3)
+    centers = np.array([[0, 0], [10, 10], [-10, 10]], np.float32)
+    labels = rng.randint(0, 3, 600)
+    X = centers[labels] + 0.3 * rng.randn(600, 2).astype(np.float32)
+    model = KMeansPlusPlusEstimator(3, 20, seed=0).fit(X)
+    # each found center close to a true center
+    found = model.means
+    for c in centers:
+        assert np.min(np.linalg.norm(found - c, axis=1)) < 0.5
+    # assignment is a one-hot of the nearest center
+    a = model(X[:8]).numpy()
+    assert a.shape == (8, 3)
+    np.testing.assert_allclose(a.sum(axis=1), 1.0)
+
+
+def test_kmeans_one_round_is_kmeanspp_init():
+    rng = np.random.RandomState(4)
+    X = rng.randn(50, 4).astype(np.float32)
+    m1 = KMeansPlusPlusEstimator(5, 1, seed=7).fit(X)
+    m2 = KMeansPlusPlusEstimator(5, 1, seed=7).fit(X)
+    np.testing.assert_array_equal(m1.means, m2.means)  # deterministic
+
+
+# -- GMM -------------------------------------------------------------------
+
+def test_gmm_recovers_two_gaussians():
+    """Reference EncEvalSuite-style synthetic 2-Gaussian recovery."""
+    rng = np.random.RandomState(5)
+    n = 2000
+    comp = rng.rand(n) < 0.4
+    X = np.where(
+        comp[:, None],
+        rng.randn(n, 2) * 0.5 + np.array([5.0, 5.0]),
+        rng.randn(n, 2) * 1.0 + np.array([-3.0, 0.0]),
+    ).astype(np.float32)
+    gmm = GaussianMixtureModelEstimator(2, max_iterations=50, seed=1).fit(X)
+    means = gmm.means.T  # (k, d)
+    # one mean near each true center
+    assert min(np.linalg.norm(means - [5, 5], axis=1).min(),
+               np.linalg.norm(means - [-3, 0], axis=1).min()) < 0.5
+    assert np.linalg.norm(means - [5, 5], axis=1).min() < 0.5
+    assert np.linalg.norm(means - [-3, 0], axis=1).min() < 0.5
+    w = sorted(gmm.weights)
+    assert abs(w[0] - 0.4) < 0.1 and abs(w[1] - 0.6) < 0.1
+    # posteriors are a thresholded distribution
+    q = gmm(X[:5]).numpy()
+    np.testing.assert_allclose(q.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_gmm_load_csv(tmp_path):
+    means = np.array([[1.0, 2.0], [3.0, 4.0]])
+    variances = np.array([[0.1, 0.2], [0.3, 0.4]])
+    weights = np.array([0.5, 0.5])
+    np.savetxt(tmp_path / "m.csv", means, delimiter=",")
+    np.savetxt(tmp_path / "v.csv", variances, delimiter=",")
+    np.savetxt(tmp_path / "w.csv", weights[None], delimiter=",")
+    from keystone_tpu.nodes.learning import GaussianMixtureModel
+
+    gmm = GaussianMixtureModel.load(
+        str(tmp_path / "m.csv"), str(tmp_path / "v.csv"), str(tmp_path / "w.csv")
+    )
+    assert gmm.k == 2 and gmm.dim == 2
+
+
+# -- LBFGS -----------------------------------------------------------------
+
+def test_dense_lbfgs_matches_ridge():
+    rng = np.random.RandomState(6)
+    n, d, k = 300, 20, 3
+    A = rng.randn(n, d).astype(np.float32)
+    W_true = rng.randn(d, k).astype(np.float32)
+    b = rng.randn(k).astype(np.float32)
+    Y = A @ W_true + b + 0.01 * rng.randn(n, k).astype(np.float32)
+    lam = 0.01
+    model = DenseLBFGSwithL2(num_iterations=200, lam=lam, convergence_tol=1e-8).fit(A, Y)
+    # closed form: centered ridge with lambda * n (loss has 1/n on data term)
+    Am, Ym = A.mean(0), Y.mean(0)
+    Ac, Yc = (A - Am).astype(np.float64), (Y - Ym).astype(np.float64)
+    expect = np.linalg.solve(Ac.T @ Ac + lam * n * np.eye(d), Ac.T @ Yc)
+    np.testing.assert_allclose(model.weights, expect, rtol=5e-2, atol=5e-2)
+    pred = model(A).numpy()
+    expect_pred = (A - Am) @ expect + Ym
+    np.testing.assert_allclose(pred, expect_pred, rtol=5e-2, atol=5e-2)
+
+
+# -- Classifiers -----------------------------------------------------------
+
+def test_naive_bayes_matches_manual():
+    rng = np.random.RandomState(7)
+    X = rng.randint(0, 5, size=(100, 6)).astype(np.float32)
+    y = rng.randint(0, 3, size=100).astype(np.int32)
+    model = NaiveBayesEstimator(3, lam=1.0).fit(X, y)
+    # manual multinomial NB
+    for c in range(3):
+        nc = (y == c).sum()
+        pi_c = np.log((nc + 1.0) / (100 + 3 * 1.0))
+        np.testing.assert_allclose(model.pi[c], pi_c, rtol=1e-5)
+        sums = X[y == c].sum(0)
+        theta_c = np.log((sums + 1.0) / (sums.sum() + 6 * 1.0))
+        np.testing.assert_allclose(model.theta[c], theta_c, rtol=1e-4)
+    scores = model(X[:4]).numpy()
+    assert scores.shape == (4, 3)
+
+
+def test_logistic_regression_separable():
+    rng = np.random.RandomState(8)
+    n = 400
+    y = rng.randint(0, 3, n).astype(np.int32)
+    centers = np.array([[2, 0], [-2, 2], [0, -3]], np.float32)
+    X = centers[y] + 0.3 * rng.randn(n, 2).astype(np.float32)
+    model = LogisticRegressionEstimator(3, reg_param=1e-3, num_iters=100).fit(X, y)
+    preds = model(X).numpy()
+    assert (preds == y).mean() > 0.95
+
+
+def test_lda_separates_classes():
+    rng = np.random.RandomState(9)
+    n = 300
+    y = rng.randint(0, 2, n).astype(np.int32)
+    X = np.concatenate(
+        [rng.randn(n, 1).astype(np.float32) + 6 * y[:, None], rng.randn(n, 4).astype(np.float32)],
+        axis=1,
+    )
+    model = LinearDiscriminantAnalysis(1).fit(X, y)
+    proj = X @ model.weights
+    m0, m1 = proj[y == 0].mean(), proj[y == 1].mean()
+    s = 0.5 * (proj[y == 0].std() + proj[y == 1].std())  # within-class spread
+    assert abs(m0 - m1) / s > 3.0  # strong separation along learned axis
+
+
+def test_local_least_squares_dual_matches_primal():
+    rng = np.random.RandomState(10)
+    n, d, k = 40, 200, 2
+    A = rng.randn(n, d).astype(np.float32)
+    Y = rng.randn(n, k).astype(np.float32)
+    lam = 1.0
+    model = LocalLeastSquaresEstimator(lam).fit(A, Y)
+    Am, Ym = A.mean(0), Y.mean(0)
+    Ac, Yc = (A - Am).astype(np.float64), (Y - Ym).astype(np.float64)
+    expect = np.linalg.solve(Ac.T @ Ac + lam * np.eye(d), Ac.T @ Yc)
+    np.testing.assert_allclose(model.weights, expect, rtol=2e-2, atol=2e-2)
