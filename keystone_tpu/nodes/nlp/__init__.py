@@ -1,0 +1,44 @@
+"""NLP nodes (reference ``nodes/nlp``, SURVEY.md section 2.6).
+
+Text processing is host-stage work (ragged, non-numeric); featurization
+hands off to device arrays via sparse vectors (``nodes/util/sparse``).
+The reference's CoreNLP/Epic-backed nodes (CoreNLPFeatureExtractor,
+POSTagger, NER) wrap external JVM model libraries with no TPU analogue;
+they are intentionally out of scope here and their pipeline role
+(lemmatized-ngram extraction) is covered by Tokenizer + NGramsFeaturizer.
+"""
+from .hashing import HashingTF, NGramsHashingTF, java_string_hash, scala_hash
+from .indexers import NaiveBitPackIndexer, NGramIndexer, NGramIndexerImpl
+from .ngrams import (
+    DEFAULT_MODE,
+    NO_ADD_MODE,
+    NGram,
+    NGramsCounts,
+    NGramsFeaturizer,
+)
+from .stupid_backoff import StupidBackoffEstimator, StupidBackoffModel
+from .text import LowerCase, Tokenizer, Trim
+from .word_freq import OOV_INDEX, WordFrequencyEncoder, WordFrequencyTransformer
+
+__all__ = [
+    "HashingTF",
+    "NGramsHashingTF",
+    "java_string_hash",
+    "scala_hash",
+    "NaiveBitPackIndexer",
+    "NGramIndexer",
+    "NGramIndexerImpl",
+    "NGram",
+    "NGramsCounts",
+    "NGramsFeaturizer",
+    "DEFAULT_MODE",
+    "NO_ADD_MODE",
+    "StupidBackoffEstimator",
+    "StupidBackoffModel",
+    "LowerCase",
+    "Tokenizer",
+    "Trim",
+    "WordFrequencyEncoder",
+    "WordFrequencyTransformer",
+    "OOV_INDEX",
+]

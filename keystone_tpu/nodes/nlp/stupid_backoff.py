@@ -1,0 +1,109 @@
+"""Stupid Backoff language model (reference ``nodes/nlp/StupidBackoff.scala``;
+Brants et al., "Large language models in machine translation", 2007).
+
+Scores are relative frequencies with multiplicative ``alpha`` backoff:
+
+    S(w_i | context) = freq(ngram) / freq(context)    if freq(ngram) > 0
+                       alpha * S(w_i | shorter ctx)   otherwise
+    S(w_i)           = freq(w_i) / N
+
+Fit aggregates the (ngram, count) pairs into a hash map and pre-scores
+every seen ngram — the analogue of the reference's
+InitialBigramPartitioner + per-partition scoring
+(``StupidBackoff.scala:152-176``), collapsed to one host pass; the
+grouping-by-initial-bigram is a Spark shuffle artifact with no TPU
+equivalent needed.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, Tuple
+
+from ...parallel.dataset import Dataset, HostDataset
+from ...workflow.estimator import Estimator
+from ...workflow.transformer import HostTransformer
+from .indexers import NGramIndexerImpl
+from .ngrams import NGram
+
+
+class StupidBackoffModel(HostTransformer):
+    """Query with ``score(ngram)`` (reference ``StupidBackoff.scala:98-128``)."""
+
+    def __init__(
+        self,
+        scores: Dict[NGram, float],
+        ngram_counts: Dict[NGram, int],
+        unigram_counts: Dict[object, int],
+        num_tokens: int,
+        alpha: float = 0.4,
+    ):
+        self.scores = scores
+        self.ngram_counts = ngram_counts
+        self.unigram_counts = unigram_counts
+        self.num_tokens = int(num_tokens)
+        self.alpha = float(alpha)
+        self._indexer = NGramIndexerImpl()
+
+    def eq_key(self):
+        return (StupidBackoffModel, id(self.scores))
+
+    def score(self, ngram: NGram) -> float:
+        ngram = NGram(ngram)
+        cached = self.scores.get(ngram)
+        if cached is not None:
+            return cached
+        return self._score(1.0, ngram, self.ngram_counts.get(ngram, 0))
+
+    def _score(self, accum: float, ngram: NGram, freq: int) -> float:
+        """Recursive local scoring (reference ``StupidBackoff.scala:62-92``)."""
+        idx = self._indexer
+        order = idx.ngram_order(ngram)
+        if order == 1:
+            return accum * self.unigram_counts.get(ngram[0], 0) / self.num_tokens
+        if freq != 0:
+            context = idx.remove_current_word(ngram)
+            if order != 2:
+                context_freq = self.ngram_counts.get(context, 0)
+            else:
+                context_freq = self.unigram_counts.get(context[0], 0)
+            if context_freq > 0:
+                return accum * freq / context_freq
+            # context unseen (e.g. counts fitted without order-1 grams):
+            # fall through to backoff instead of dividing by zero
+        backed = idx.remove_farthest_word(ngram)
+        if order != 2:
+            freq2 = self.ngram_counts.get(backed, 0)
+        else:
+            freq2 = self.unigram_counts.get(backed[0], 0)
+        return self._score(self.alpha * accum, backed, freq2)
+
+    def apply(self, pair: Tuple[NGram, int]) -> Tuple[NGram, float]:
+        ngram, _ = pair
+        return NGram(ngram), self.score(NGram(ngram))
+
+
+class StupidBackoffEstimator(Estimator):
+    """Fit from a dataset of (ngram, count) pairs
+    (reference ``StupidBackoff.scala:143-182``)."""
+
+    def __init__(self, unigram_counts: Dict[object, int], alpha: float = 0.4):
+        self.unigram_counts = dict(unigram_counts)
+        self.alpha = float(alpha)
+
+    def eq_key(self):
+        return (StupidBackoffEstimator, id(self.unigram_counts), self.alpha)
+
+    def _fit(self, ds: Dataset) -> StupidBackoffModel:
+        counts: Dict[NGram, int] = {}
+        for ngram, c in ds.collect():
+            key = NGram(ngram)
+            counts[key] = counts.get(key, 0) + int(c)
+        num_tokens = sum(self.unigram_counts.values())
+        model = StupidBackoffModel(
+            {}, counts, self.unigram_counts, num_tokens, self.alpha)
+        scores: Dict[NGram, float] = {}
+        for ngram, freq in counts.items():
+            s = model._score(1.0, ngram, freq)
+            assert 0.0 <= s <= 1.0, f"score {s} not in [0,1] for {ngram}"
+            scores[ngram] = s
+        model.scores = scores
+        return model

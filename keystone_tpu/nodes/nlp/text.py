@@ -1,0 +1,56 @@
+"""String preprocessing nodes (reference ``nodes/nlp/StringUtils.scala``).
+
+These are host-stage nodes: tokenization is ragged, non-numeric work that
+belongs on the host CPU side of the DAG (SURVEY.md section 7 "Host/device
+choreography for NLP"). Downstream featurization (hashing TF, sparse
+vectorization) turns their output into device arrays.
+"""
+from __future__ import annotations
+
+import re
+
+from ...workflow.transformer import HostTransformer
+
+
+class Tokenizer(HostTransformer):
+    """Split a string into tokens on a delimiter regex
+    (reference ``StringUtils.scala:13-15``; default splits on punctuation
+    and whitespace, dropping empty leading fields like Scala's split)."""
+
+    def __init__(self, sep: str = r"[\W_\s]+"):
+        self.sep = sep
+        self._re = re.compile(sep)
+
+    def eq_key(self):
+        return (Tokenizer, self.sep)
+
+    def apply(self, s: str):
+        parts = self._re.split(s)
+        # JVM String.split semantics: trailing empty fields are removed,
+        # leading/interior ones are kept.
+        while parts and parts[-1] == "":
+            parts.pop()
+        return parts
+
+    def __getstate__(self):
+        state = super().__getstate__()
+        state.pop("_re", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._re = re.compile(self.sep)
+
+
+class Trim(HostTransformer):
+    """Strip leading/trailing whitespace (``StringUtils.scala:20-22``)."""
+
+    def apply(self, s: str) -> str:
+        return s.strip()
+
+
+class LowerCase(HostTransformer):
+    """Lower-case a string (``StringUtils.scala:28-30``)."""
+
+    def apply(self, s: str) -> str:
+        return s.lower()

@@ -1,0 +1,53 @@
+"""Word frequency encoding (reference ``nodes/nlp/WordFrequencyEncoder.scala``).
+
+Tokens are mapped to their index in sorted-by-frequency order (most
+frequent word = 0); out-of-vocabulary words map to -1. Fit counts
+unigrams in one host pass (the reference builds them with
+NGramsFeaturizer(1..1) + NGramsCounts and collects to the driver).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from ...parallel.dataset import Dataset
+from ...workflow.estimator import Estimator
+from ...workflow.transformer import HostTransformer
+
+OOV_INDEX = -1
+
+
+class WordFrequencyTransformer(HostTransformer):
+    """token seq -> frequency-rank int seq
+    (reference ``WordFrequencyEncoder.scala:42-62``)."""
+
+    def __init__(self, word_index: Dict[str, int], unigram_counts: Dict[int, int]):
+        self.word_index = dict(word_index)
+        self.unigram_counts = dict(unigram_counts)
+
+    def eq_key(self):
+        return (WordFrequencyTransformer, id(self.word_index))
+
+    def apply(self, words: Sequence[str]) -> List[int]:
+        index = self.word_index
+        return [index.get(w, OOV_INDEX) for w in words]
+
+
+class WordFrequencyEncoder(Estimator):
+    """Fit a WordFrequencyTransformer by counting unigrams
+    (reference ``WordFrequencyEncoder.scala:12-30``); rank order is count
+    descending with ties broken by first appearance."""
+
+    def _fit(self, ds: Dataset) -> WordFrequencyTransformer:
+        counts: Dict[str, int] = {}
+        first: Dict[str, int] = {}
+        i = 0
+        for tokens in ds.collect():
+            for w in tokens:
+                counts[w] = counts.get(w, 0) + 1
+                if w not in first:
+                    first[w] = i
+                i += 1
+        ranked = sorted(counts, key=lambda w: (-counts[w], first[w]))
+        word_index = {w: r for r, w in enumerate(ranked)}
+        unigrams = {word_index[w]: c for w, c in counts.items()}
+        return WordFrequencyTransformer(word_index, unigrams)

@@ -170,3 +170,31 @@ class StandardScaler(Estimator):
 @jax.jit
 def _moments(X):
     return jnp.sum(X, axis=0), jnp.sum(X * X, axis=0)
+
+
+from ...workflow.transformer import HostTransformer  # noqa: E402
+
+
+class TermFrequency(HostTransformer):
+    """Seq of terms -> seq of (unique term, weighting(count)) pairs
+    (reference ``stats/TermFrequency.scala:20-22``). A host-stage node;
+    output order is first appearance, deterministically.
+    """
+
+    def __init__(self, fun=None):
+        self.fun = fun or (lambda x: x)
+
+    def eq_key(self):
+        return (TermFrequency, self.fun)
+
+    def apply(self, terms):
+        counts = {}
+        order = []
+        for t in terms:
+            key = tuple(t) if isinstance(t, list) else t
+            if key not in counts:
+                counts[key] = 0
+                order.append(key)
+            counts[key] += 1
+        return [(k, float(self.fun(counts[k]))) for k in order]
+

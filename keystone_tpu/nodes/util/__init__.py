@@ -117,6 +117,8 @@ class Densify(Transformer):
     ArrayDatasets are already dense; sparse host datasets are stacked."""
 
     def apply(self, x):
+        if hasattr(x, "todense"):
+            return jnp.asarray(x.todense())
         return x
 
     def apply_dataset(self, ds: Dataset) -> Dataset:
@@ -140,3 +142,13 @@ class Cast(Transformer):
 
     def apply(self, x):
         return x.astype(self.dtype)
+
+
+from .sparse import (  # noqa: E402
+    AllSparseFeatures,
+    CommonSparseFeatures,
+    SparseFeatureVectorizer,
+    SparseVector,
+    Sparsify,
+    sparse_batch,
+)

@@ -1,0 +1,171 @@
+"""Sparse feature vectors and sparse featurization nodes.
+
+The reference represents sparse features as Breeze ``SparseVector``s built
+by ``SparseFeatureVectorizer`` from (feature, value) pair lists, with the
+feature space chosen by ``CommonSparseFeatures`` (top-K by frequency) or
+``AllSparseFeatures`` (reference ``nodes/util/CommonSparseFeatures.scala``,
+``AllSparseFeatures.scala``, ``SparseFeatureVectorizer.scala``).
+
+TPU-native layout: a host :class:`SparseVector` (sorted int32 indices +
+f32 values) per item, and :func:`sparse_batch` which packs a batch into
+fixed-width padded COO device arrays — the static-shape form the sparse
+solver kernels (gather/scatter on the MXU-adjacent VPU) consume.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...parallel.dataset import ArrayDataset, Dataset, HostDataset
+from ...workflow.estimator import Estimator
+from ...workflow.transformer import HostTransformer, Transformer
+
+
+class SparseVector:
+    """Host sparse vector: sorted unique indices + values + logical size."""
+
+    __slots__ = ("indices", "values", "size")
+
+    def __init__(self, indices, values, size: int):
+        idx = np.asarray(indices, dtype=np.int32)
+        val = np.asarray(values, dtype=np.float32)
+        order = np.argsort(idx, kind="stable")
+        self.indices = idx[order]
+        self.values = val[order]
+        self.size = int(size)
+
+    @staticmethod
+    def from_dict(tf: Dict[int, float], size: int) -> "SparseVector":
+        if not tf:
+            return SparseVector(np.zeros(0, np.int32), np.zeros(0, np.float32), size)
+        items = sorted(tf.items())
+        idx, val = zip(*items)
+        return SparseVector(np.asarray(idx), np.asarray(val), size)
+
+    @property
+    def nnz(self) -> int:
+        return int(self.indices.shape[0])
+
+    def todense(self) -> np.ndarray:
+        out = np.zeros(self.size, dtype=np.float32)
+        out[self.indices] = self.values
+        return out
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, SparseVector)
+            and self.size == other.size
+            and np.array_equal(self.indices, other.indices)
+            and np.array_equal(self.values, other.values)
+        )
+
+    def __repr__(self):
+        return f"SparseVector(nnz={self.nnz}, size={self.size})"
+
+
+def sparse_batch(items: Sequence[SparseVector], max_nnz: Optional[int] = None,
+                 allow_truncate: bool = False):
+    """Pack SparseVectors into padded COO arrays.
+
+    Returns ``(indices int32[n, m], values f32[n, m], size)`` where padding
+    entries have index 0 and value 0 — linear ops (gathers weighted by
+    value) are exact without a mask. A vector with more than ``max_nnz``
+    entries is an error unless ``allow_truncate`` (lossy) is requested.
+    """
+    n = len(items)
+    size = items[0].size if items else 0
+    m = max_nnz or max((it.nnz for it in items), default=1)
+    m = max(m, 1)
+    indices = np.zeros((n, m), dtype=np.int32)
+    values = np.zeros((n, m), dtype=np.float32)
+    for i, it in enumerate(items):
+        if it.nnz > m and not allow_truncate:
+            raise ValueError(
+                f"item {i} has nnz={it.nnz} > max_nnz={m}; pass "
+                "allow_truncate=True to drop features")
+        k = min(it.nnz, m)
+        indices[i, :k] = it.indices[:k]
+        values[i, :k] = it.values[:k]
+    return indices, values, size
+
+
+class Sparsify(HostTransformer):
+    """Dense vector -> SparseVector (reference ``util/Sparsify.scala``)."""
+
+    def apply(self, x) -> SparseVector:
+        if isinstance(x, SparseVector):
+            return x
+        x = np.asarray(x)
+        idx = np.nonzero(x)[0]
+        return SparseVector(idx, x[idx], x.shape[0])
+
+
+class SparseFeatureVectorizer(HostTransformer):
+    """(feature, value) pairs -> SparseVector over a fixed feature space
+    (reference ``util/SparseFeatureVectorizer.scala:7-18``); features
+    outside the space are dropped."""
+
+    def __init__(self, feature_space: Dict[Any, int]):
+        self.feature_space = dict(feature_space)
+
+    def eq_key(self):
+        return (SparseFeatureVectorizer, id(self.feature_space))
+
+    def apply(self, pairs: Sequence[Tuple[Any, float]]) -> SparseVector:
+        space = self.feature_space
+        tf: Dict[int, float] = {}
+        for feat, value in pairs:
+            j = space.get(_key(feat))
+            if j is not None:
+                tf[j] = tf.get(j, 0.0) + float(value)
+        return SparseVector.from_dict(tf, len(space))
+
+
+def _key(feat: Any) -> Any:
+    # normalize list-like ngram keys to hashable tuples
+    if isinstance(feat, list):
+        return tuple(feat)
+    return feat
+
+
+def _iter_pairs(ds: Dataset):
+    for item in ds.collect():
+        for feat, value in item:
+            yield _key(feat), float(value)
+
+
+class CommonSparseFeatures(Estimator):
+    """Keep the ``num_features`` most frequent features, ordered by
+    decreasing count then earliest appearance (reference
+    ``CommonSparseFeatures.scala:20-64``: count + min unique id,
+    per-partition takeOrdered + treeReduce merge — here one deterministic
+    host pass)."""
+
+    def __init__(self, num_features: int):
+        self.num_features = int(num_features)
+
+    def _fit(self, ds: Dataset) -> SparseFeatureVectorizer:
+        counts: Dict[Any, int] = {}
+        first: Dict[Any, int] = {}
+        i = 0
+        for feat, _ in _iter_pairs(ds):
+            counts[feat] = counts.get(feat, 0) + 1
+            if feat not in first:
+                first[feat] = i
+            i += 1
+        top = sorted(counts, key=lambda f: (-counts[f], first[f]))
+        top = top[: self.num_features]
+        return SparseFeatureVectorizer({f: j for j, f in enumerate(top)})
+
+
+class AllSparseFeatures(Estimator):
+    """Keep every observed feature, ordered by earliest appearance
+    (reference ``AllSparseFeatures.scala:15-27``)."""
+
+    def _fit(self, ds: Dataset) -> SparseFeatureVectorizer:
+        space: Dict[Any, int] = {}
+        for feat, _ in _iter_pairs(ds):
+            if feat not in space:
+                space[feat] = len(space)
+        return SparseFeatureVectorizer(space)

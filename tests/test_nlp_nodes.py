@@ -1,0 +1,169 @@
+"""NLP node tests (mirrors the reference's nlp suites: TokenizerSuite,
+NGramsFeaturizerSuite, NGramsHashingTFSuite, WordFrequencyEncoderSuite,
+StupidBackoffSuite, NaiveBitPackIndexerSuite)."""
+import numpy as np
+import pytest
+
+from keystone_tpu.nodes.nlp import (
+    HashingTF,
+    LowerCase,
+    NaiveBitPackIndexer,
+    NGram,
+    NGramsCounts,
+    NGramsFeaturizer,
+    NGramsHashingTF,
+    StupidBackoffEstimator,
+    Tokenizer,
+    Trim,
+    WordFrequencyEncoder,
+    java_string_hash,
+)
+from keystone_tpu.nodes.stats import TermFrequency
+from keystone_tpu.nodes.util import (
+    AllSparseFeatures,
+    CommonSparseFeatures,
+    SparseVector,
+    Sparsify,
+    sparse_batch,
+)
+from keystone_tpu.parallel.dataset import HostDataset
+
+
+def test_tokenizer_trim_lowercase():
+    assert Trim().apply("  hi there ") == "hi there"
+    assert LowerCase().apply("MiXeD") == "mixed"
+    assert Tokenizer().apply("Hello, world! it's fine") == [
+        "Hello", "world", "it", "s", "fine"]
+    assert Tokenizer(r"\s+").apply("a b  c") == ["a", "b", "c"]
+
+
+def test_java_string_hash():
+    # values verified against JVM String.hashCode
+    assert java_string_hash("Seq") == 83007
+    assert java_string_hash("a") == 97
+    assert java_string_hash("ab") == 3105
+    assert java_string_hash("") == 0
+
+
+def test_ngrams_featurizer_orders():
+    grams = NGramsFeaturizer([1, 2, 3]).apply(["a", "b", "c"])
+    assert grams == [("a",), ("a", "b"), ("a", "b", "c"),
+                     ("b",), ("b", "c"), ("c",)]
+    bigrams = NGramsFeaturizer([2]).apply(["a", "b", "c"])
+    assert bigrams == [("a", "b"), ("b", "c")]
+
+
+def test_ngrams_featurizer_rejects_bad_orders():
+    with pytest.raises(AssertionError):
+        NGramsFeaturizer([1, 3])
+    with pytest.raises(AssertionError):
+        NGramsFeaturizer([0, 1])
+
+
+def test_ngrams_counts_sorted_desc():
+    docs = HostDataset([
+        NGramsFeaturizer([1]).apply("a b a c a b".split()),
+    ])
+    pairs = NGramsCounts().apply_dataset(docs).collect()
+    assert pairs[0] == (NGram(("a",)), 3)
+    assert pairs[1] == (NGram(("b",)), 2)
+    assert pairs[2] == (NGram(("c",)), 1)
+
+
+def test_ngrams_hashing_tf_equals_featurize_then_hash():
+    doc = "the quick brown fox jumps over the lazy dog the quick".split()
+    for orders in ([1, 2], [2, 3], [1, 2, 3, 4]):
+        fused = NGramsHashingTF(orders, 1 << 12).apply(doc)
+        staged = HashingTF(1 << 12).apply(NGramsFeaturizer(orders).apply(doc))
+        assert fused == staged
+
+
+def test_hashing_tf_counts():
+    sv = HashingTF(1000).apply(["x", "y", "x"])
+    assert sv.size == 1000 and sv.values.sum() == 3.0
+
+
+def test_term_frequency_weighting():
+    out = TermFrequency(lambda x: np.log(x) + 1).apply(["a", "a", "b"])
+    assert out[0][0] == "a" and abs(out[0][1] - (np.log(2) + 1)) < 1e-12
+    assert out[1] == ("b", 1.0)
+
+
+def test_word_frequency_encoder():
+    docs = HostDataset(["b a a c a b".split(), "a d".split()])
+    model = WordFrequencyEncoder().fit(docs)
+    # 'a' x4 -> 0, 'b' x2 -> 1, then 'c', 'd' by first appearance
+    assert model.apply(["a", "b", "c", "d", "zzz"]) == [0, 1, 2, 3, -1]
+    assert model.unigram_counts[0] == 4
+    assert model.unigram_counts[1] == 2
+
+
+def test_sparse_vectorizer_and_common_features():
+    data = HostDataset([
+        [("a", 1.0), ("b", 2.0)],
+        [("a", 1.0), ("c", 1.0)],
+        [("a", 1.0), ("b", 1.0)],
+    ])
+    vec = CommonSparseFeatures(2).fit(data)
+    sv = vec.apply([("a", 5.0), ("c", 9.0), ("b", 1.0)])
+    # feature space = {a:0, b:1}; c dropped
+    assert sv.size == 2
+    np.testing.assert_array_equal(sv.indices, [0, 1])
+    np.testing.assert_array_equal(sv.values, [5.0, 1.0])
+
+    vec_all = AllSparseFeatures().fit(data)
+    assert vec_all.apply([("c", 1.0)]).todense().tolist() == [0.0, 0.0, 1.0]
+
+
+def test_sparsify_and_batch():
+    sv = Sparsify().apply(np.array([0.0, 3.0, 0.0, 2.0], np.float32))
+    assert sv.nnz == 2
+    idx, vals, size = sparse_batch([sv, SparseVector([0], [1.0], 4)])
+    assert idx.shape == vals.shape == (2, 2) and size == 4
+    np.testing.assert_array_equal(idx[0], [1, 3])
+    np.testing.assert_array_equal(vals[1], [1.0, 0.0])
+
+
+def test_naive_bitpack_indexer():
+    idx = NaiveBitPackIndexer()
+    for ngram in ([5], [5, 9], [5, 9, 123]):
+        packed = idx.pack(ngram)
+        assert idx.ngram_order(packed) == len(ngram)
+        for pos, w in enumerate(ngram):
+            assert idx.unpack(packed, pos) == w
+    tri = idx.pack([5, 9, 123])
+    assert idx.ngram_order(idx.remove_farthest_word(tri)) == 2
+    assert idx.unpack(idx.remove_farthest_word(tri), 0) == 9
+    assert idx.unpack(idx.remove_current_word(tri), 1) == 9
+
+
+def _fit_backoff(corpus, orders=(2, 3)):
+    tokens = [line.split() for line in corpus]
+    unigrams = {}
+    for line in tokens:
+        for w in line:
+            unigrams[w] = unigrams.get(w, 0) + 1
+    grams = HostDataset([NGramsFeaturizer(list(orders)).apply(t) for t in tokens])
+    counts = NGramsCounts().apply_dataset(grams)
+    return StupidBackoffEstimator(unigrams).fit(counts), unigrams
+
+
+def test_stupid_backoff_seen_trigram():
+    model, unigrams = _fit_backoff(["a b c d", "a b c e"])
+    # S(c | a b) = freq(abc)/freq(ab) = 2/2 = 1
+    assert model.score(NGram(("a", "b", "c"))) == pytest.approx(1.0)
+    # S(d | b c) = freq(bcd)/freq(bc) = 1/2
+    assert model.score(NGram(("b", "c", "d"))) == pytest.approx(0.5)
+
+
+def test_stupid_backoff_backs_off():
+    model, unigrams = _fit_backoff(["a b c d", "a b c e"])
+    n = sum(unigrams.values())
+    # unseen trigram (d, b, c): backoff to (b, c): freq(bc)/freq(b)=2/2
+    assert model.score(NGram(("d", "b", "c"))) == pytest.approx(0.4 * 1.0)
+    # unseen everywhere: alpha^2 * unigram score
+    assert model.score(NGram(("e", "d", "a"))) == pytest.approx(
+        0.4 * 0.4 * unigrams["a"] / n)
+    # scores in [0, 1]
+    for g, s in model.scores.items():
+        assert 0.0 <= s <= 1.0
