@@ -1,0 +1,87 @@
+"""Augmented-example evaluation (reference
+``evaluation/AugmentedExamplesEvaluator.scala``).
+
+Test-time augmentation produces several predictions per source example
+(e.g. center/corner patches); predictions are grouped by example id and
+aggregated — elementwise average, or Borda count (sum of per-patch score
+ranks) — before argmax and multiclass evaluation. Grouping happens on
+host (ids are arbitrary keys); aggregation is vectorized per group.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+from ..parallel.dataset import ArrayDataset, Dataset, to_numpy
+from .multiclass import MulticlassMetrics, evaluate_multiclass
+
+AVERAGE_POLICY = "average"
+BORDA_POLICY = "borda"
+
+
+def average_policy(preds: np.ndarray) -> np.ndarray:
+    """Mean of the per-patch score vectors
+    (reference ``AugmentedExamplesEvaluator.scala:17-19``)."""
+    return preds.mean(axis=0)
+
+
+def borda_policy(preds: np.ndarray) -> np.ndarray:
+    """Sum of per-patch ranks: each patch contributes rank-in-sorted-order
+    per class (reference ``AugmentedExamplesEvaluator.scala:28-35``)."""
+    ranks = np.argsort(np.argsort(preds, axis=1), axis=1).astype(np.float64)
+    return ranks.sum(axis=0)
+
+
+def _collect(x: Any) -> List[Any]:
+    if isinstance(x, Dataset) and not isinstance(x, ArrayDataset):
+        return x.collect()  # ragged host items stay as-is
+    arr = to_numpy(x) if not isinstance(x, list) else x
+    return [arr[i] for i in range(len(arr))]
+
+
+def evaluate_augmented(
+    names: Any,
+    predicted: Any,
+    actual_labels: Any,
+    num_classes: int,
+    policy: str = AVERAGE_POLICY,
+) -> MulticlassMetrics:
+    """Group augmented predictions by example name, aggregate, argmax,
+    then standard multiclass evaluation
+    (reference ``AugmentedExamplesEvaluator.scala:37-69``)."""
+    agg = borda_policy if policy == BORDA_POLICY else average_policy
+    names_l = _collect(names)
+    preds_l = _collect(predicted)
+    labels_l = [int(np.asarray(l)) for l in _collect(actual_labels)]
+    assert len(names_l) == len(preds_l) == len(labels_l)
+
+    groups: Dict[Any, List[int]] = {}
+    order: List[Any] = []
+    for i, name in enumerate(names_l):
+        key = name if np.isscalar(name) or isinstance(name, (str, tuple)) \
+            else np.asarray(name).tobytes()
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(i)
+
+    final_preds, final_actuals = [], []
+    for key in order:
+        idx = groups[key]
+        group_labels = {labels_l[i] for i in idx}
+        assert len(group_labels) == 1, (
+            f"augmented copies of one example disagree on label: {group_labels}")
+        stacked = np.stack([np.asarray(preds_l[i], np.float64) for i in idx])
+        final_preds.append(int(np.argmax(agg(stacked))))
+        final_actuals.append(labels_l[idx[0]])
+
+    return evaluate_multiclass(
+        np.asarray(final_preds), np.asarray(final_actuals), num_classes)
+
+
+class AugmentedExamplesEvaluator:
+    def evaluate(self, names, predicted, actual_labels, num_classes,
+                 policy: str = AVERAGE_POLICY) -> MulticlassMetrics:
+        return evaluate_augmented(
+            names, predicted, actual_labels, num_classes, policy)

@@ -1,0 +1,95 @@
+"""Binary classifier evaluation (reference
+``evaluation/BinaryClassifierEvaluator.scala``).
+
+One pass over the zipped predictions/actuals; on device this is four
+masked sums (a single fused XLA reduction over the sharded batch)
+instead of the reference's RDD zip + reduce of per-item tables.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.dataset import to_numpy
+
+
+def _div(num: float, denom: float) -> float:
+    """JVM Double-division semantics: 0/0 -> nan, never raises."""
+    return num / denom if denom != 0.0 else float("nan")
+
+
+@dataclass
+class BinaryClassificationMetrics:
+    """Contingency table + derived metrics
+    (reference ``BinaryClassifierEvaluator.scala:17-57``)."""
+
+    tp: float
+    fp: float
+    tn: float
+    fn: float
+
+    def merge(self, other: "BinaryClassificationMetrics"):
+        return BinaryClassificationMetrics(
+            self.tp + other.tp, self.fp + other.fp,
+            self.tn + other.tn, self.fn + other.fn)
+
+    @property
+    def accuracy(self) -> float:
+        return _div(self.tp + self.tn, self.tp + self.fp + self.tn + self.fn)
+
+    @property
+    def error(self) -> float:
+        return _div(self.fp + self.fn, self.tp + self.fp + self.tn + self.fn)
+
+    @property
+    def recall(self) -> float:
+        return _div(self.tp, self.tp + self.fn)
+
+    @property
+    def precision(self) -> float:
+        return _div(self.tp, self.tp + self.fp)
+
+    @property
+    def specificity(self) -> float:
+        return _div(self.tn, self.fp + self.tn)
+
+    def f_score(self, beta: float = 1.0) -> float:
+        num = (1.0 + beta * beta) * self.tp
+        denom = (1.0 + beta * beta) * self.tp + beta * beta * self.fn + self.fp
+        return _div(num, denom)
+
+    def summary(self) -> str:
+        return (
+            f" Accuracy:\t{self.accuracy:2.3f}\n"
+            f"Precision:\t{self.precision:2.3f}\n"
+            f"Recall:\t{self.recall:2.3f}\n"
+            f"Specificity:\t{self.specificity:2.3f}\n"
+            f"F1:\t{self.f_score():2.3f}\n"
+        )
+
+
+def _to_bool(x: Any) -> np.ndarray:
+    return to_numpy(x, dtype=bool).ravel()
+
+
+def evaluate_binary(predictions: Any, actuals: Any) -> BinaryClassificationMetrics:
+    """Contingency table from boolean predictions/actuals
+    (reference ``BinaryClassifierEvaluator.scala:70-79``)."""
+    pred = _to_bool(predictions)
+    act = _to_bool(actuals)
+    assert pred.shape == act.shape, "predictions and actuals must align"
+    p = jnp.asarray(pred)
+    a = jnp.asarray(act)
+    tp = float(jnp.sum(p & a))
+    fp = float(jnp.sum(p & ~a))
+    tn = float(jnp.sum(~p & ~a))
+    fn = float(jnp.sum(~p & a))
+    return BinaryClassificationMetrics(tp, fp, tn, fn)
+
+
+class BinaryClassifierEvaluator:
+    def evaluate(self, predictions: Any, actuals: Any) -> BinaryClassificationMetrics:
+        return evaluate_binary(predictions, actuals)

@@ -11,7 +11,7 @@ from typing import Any
 
 import numpy as np
 
-from ..parallel.dataset import ArrayDataset, Dataset
+from ..parallel.dataset import to_numpy
 from ..workflow.pipeline import PipelineDataset
 
 
@@ -96,13 +96,7 @@ class MulticlassMetrics:
 
 
 def _to_int_array(x: Any) -> np.ndarray:
-    if isinstance(x, PipelineDataset):
-        x = x.get()
-    if isinstance(x, ArrayDataset):
-        return np.asarray(x.numpy()).astype(np.int64).ravel()
-    if isinstance(x, Dataset):
-        return np.asarray(x.collect()).astype(np.int64).ravel()
-    return np.asarray(x).astype(np.int64).ravel()
+    return to_numpy(x, dtype=np.int64).ravel()
 
 
 def evaluate_multiclass(predictions: Any, labels: Any, num_classes: int) -> MulticlassMetrics:

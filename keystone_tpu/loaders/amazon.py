@@ -1,0 +1,41 @@
+"""Amazon product reviews loader (reference
+``loaders/AmazonReviewsDataLoader.scala``).
+
+Reviews are JSON objects with at least ``reviewText`` and ``overall``
+fields, one per line (the common release format; the reference reads the
+same via Spark SQL ``jsonFile``). ``overall >= threshold`` is the
+positive class.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import List
+
+import numpy as np
+
+from ..parallel.dataset import ArrayDataset, HostDataset
+from .csv_loader import LabeledData
+
+
+def amazon_reviews_loader(data_path: str, threshold: float = 3.5) -> LabeledData:
+    if os.path.isdir(data_path):
+        files = sorted(glob.glob(os.path.join(data_path, "*.json")))
+    else:
+        files = sorted(glob.glob(data_path)) or [data_path]
+    texts: List[str] = []
+    labels: List[int] = []
+    for path in files:
+        with open(path, "r", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                obj = json.loads(line)
+                texts.append(obj["reviewText"])
+                labels.append(1 if float(obj["overall"]) >= threshold else 0)
+    return LabeledData(
+        data=HostDataset(texts),
+        labels=ArrayDataset.from_numpy(np.asarray(labels, np.int32)),
+    )

@@ -1,0 +1,98 @@
+"""Tar-archive image loading (reference ``loaders/ImageLoaderUtils.scala``).
+
+Streams tar archives of images, decodes with PIL (the reference uses
+ImageIO), and yields labeled image items. Images keep the reference's
+convention: float32 (H, W, C) arrays with values in [0, 255].
+
+Ragged image sizes stay host-side (HostDataset); pipelines resize/crop
+or extract fixed-size features before moving to device arrays.
+"""
+from __future__ import annotations
+
+import io
+import os
+import tarfile
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..parallel.dataset import HostDataset
+
+
+@dataclass
+class LabeledImage:
+    """Image + single int label (reference ``utils/images/Image.scala:371-380``)."""
+
+    image: np.ndarray
+    label: int
+    filename: Optional[str] = None
+
+
+@dataclass
+class MultiLabeledImage:
+    """Image + multiple labels (reference ``Image.scala:383-394``)."""
+
+    image: np.ndarray
+    labels: List[int] = field(default_factory=list)
+    filename: Optional[str] = None
+
+
+def decode_image(data: bytes) -> Optional[np.ndarray]:
+    """JPEG/PNG bytes -> float32 (H, W, C) in [0, 255]; None if undecodable
+    (the reference's loadImage returns Option)."""
+    try:
+        from PIL import Image as PILImage
+
+        img = PILImage.open(io.BytesIO(data))
+        img = img.convert("RGB")
+        return np.asarray(img, dtype=np.float32)
+    except Exception:
+        return None
+
+
+def list_archive_paths(data_path: str) -> List[str]:
+    """All non-directory files under a path (reference
+    ``ImageLoaderUtils.getFilePathsRDD``)."""
+    if os.path.isfile(data_path):
+        return [data_path]
+    return sorted(
+        os.path.join(data_path, f)
+        for f in os.listdir(data_path)
+        if os.path.isfile(os.path.join(data_path, f))
+    )
+
+
+def iter_tar_images(
+    tar_path: str, name_prefix: Optional[str] = None
+) -> Iterator[tuple]:
+    """Yield (entry_name, decoded_image) for each image file in a tar
+    (reference ``ImageLoaderUtils.loadFile``)."""
+    mode = "r:gz" if tar_path.endswith(".gz") else "r"
+    with tarfile.open(tar_path, mode) as tf:
+        for entry in tf:
+            if not entry.isfile():
+                continue
+            if name_prefix and not entry.name.startswith(name_prefix):
+                continue
+            fobj = tf.extractfile(entry)
+            if fobj is None:
+                continue
+            img = decode_image(fobj.read())
+            if img is not None:
+                yield entry.name, img
+
+
+def load_tar_files(
+    archive_paths: Sequence[str],
+    labels_map: Callable[[str], object],
+    image_builder: Callable[[np.ndarray, object, str], object],
+    name_prefix: Optional[str] = None,
+) -> HostDataset:
+    """Load every image from every archive, applying the label mapping
+    (reference ``ImageLoaderUtils.loadFiles``)."""
+    items = []
+    for path in archive_paths:
+        for name, img in iter_tar_images(path, name_prefix):
+            items.append(image_builder(img, labels_map(name), name))
+    return HostDataset(items)

@@ -1,0 +1,43 @@
+"""ImageNet loader (reference ``loaders/ImageNetLoader.scala``).
+
+``data_path`` holds tar files whose entries live under a directory per
+class (``class_name/img.jpeg``); ``labels_path`` maps class names to
+numeric labels, one ``class_name label`` pair per line.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from ..parallel.dataset import HostDataset
+from .image_loader_utils import (
+    LabeledImage,
+    list_archive_paths,
+    load_tar_files,
+)
+
+NUM_CLASSES = 1000
+
+
+def parse_imagenet_labels(labels_path: str) -> Dict[str, int]:
+    labels: Dict[str, int] = {}
+    with open(labels_path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) >= 2:
+                labels[parts[0]] = int(parts[1])
+    return labels
+
+
+def imagenet_loader(data_path: str, labels_path: str) -> HostDataset:
+    """RDD[LabeledImage] analogue (reference ``ImageNetLoader.scala:27-39``):
+    the entry's top-level directory is its class name."""
+    labels_map = parse_imagenet_labels(labels_path)
+
+    def lookup(entry_name: str) -> int:
+        return labels_map[entry_name.split("/")[0]]
+
+    return load_tar_files(
+        list_archive_paths(data_path),
+        lookup,
+        lambda img, label, name: LabeledImage(img, label, name),
+    )

@@ -1,0 +1,60 @@
+"""TIMIT pre-featurized data loader (reference
+``loaders/TimitFeaturesDataLoader.scala``).
+
+Features are a CSV of numbers (440-dim); labels files hold ``row# label``
+pairs with 1-based row numbers and 1-based labels (147 classes).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..parallel.dataset import ArrayDataset
+from .csv_loader import LabeledData, load_csv
+
+TIMIT_DIMENSION = 440
+NUM_CLASSES = 147
+
+
+def _parse_sparse_labels(path: str, n: int) -> np.ndarray:
+    """'row label' lines, both 1-based (reference
+    ``TimitFeaturesDataLoader.scala:22-33,36-44``: stored label minus 1)."""
+    labels = np.zeros(n, dtype=np.int32)
+    seen = np.zeros(n, dtype=bool)
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if not parts:
+                continue
+            row = int(parts[0]) - 1
+            labels[row] = int(parts[1]) - 1
+            seen[row] = True
+    assert seen.all(), f"labels file {path} is missing rows"
+    return labels
+
+
+@dataclass
+class TimitFeaturesData:
+    train: LabeledData
+    test: LabeledData
+
+
+def timit_features_loader(
+    train_data_path: str,
+    train_labels_path: str,
+    test_data_path: str,
+    test_labels_path: str,
+) -> TimitFeaturesData:
+    def split(data_path, labels_path):
+        feats = load_csv(data_path)
+        labels = _parse_sparse_labels(labels_path, feats.shape[0])
+        return LabeledData(
+            data=ArrayDataset.from_numpy(feats),
+            labels=ArrayDataset.from_numpy(labels),
+        )
+
+    return TimitFeaturesData(
+        train=split(train_data_path, train_labels_path),
+        test=split(test_data_path, test_labels_path),
+    )

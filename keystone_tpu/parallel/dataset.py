@@ -214,3 +214,17 @@ def _repad(x: jax.Array, rows: int, mesh: Mesh) -> jax.Array:
 def _masked_vmap(fn, data, n: int, padded_n: int, mesh: Mesh):
     out = jax.jit(jax.vmap(fn))(data)
     return _apply_mask(out, n, mesh) if n < padded_n else out
+
+
+def to_numpy(x: Any, dtype=None) -> np.ndarray:
+    """Materialize datasets / lazy pipeline results / arrays as one numpy
+    array (the shared coercion for evaluators and host-side fits)."""
+    if hasattr(x, "get") and not isinstance(x, Dataset):  # PipelineResult
+        x = x.get()
+    if isinstance(x, ArrayDataset):
+        out = np.asarray(x.numpy())
+    elif isinstance(x, Dataset):
+        out = np.asarray(x.collect())
+    else:
+        out = np.asarray(x)
+    return out.astype(dtype) if dtype is not None else out

@@ -1,0 +1,65 @@
+"""StupidBackoffPipeline (reference
+``pipelines/nlp/StupidBackoffPipeline.scala:10-58``): tokenize a text
+corpus, frequency-encode the vocabulary, count ngrams of orders 2..n,
+fit the Stupid Backoff language model, and report corpus statistics.
+"""
+from __future__ import annotations
+
+import argparse
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from ...nodes.nlp import (
+    NGramsCounts,
+    NGramsFeaturizer,
+    NO_ADD_MODE,
+    StupidBackoffEstimator,
+    Tokenizer,
+    WordFrequencyEncoder,
+)
+from ...parallel.dataset import Dataset, HostDataset
+
+
+@dataclass
+class StupidBackoffConfig:
+    train_data: str = ""
+    n: int = 3
+
+
+def run(config: StupidBackoffConfig, text: Optional[Dataset] = None):
+    """Returns the fitted StupidBackoffModel."""
+    start = time.time()
+    if text is None:
+        with open(config.train_data, errors="replace") as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        text = HostDataset(lines)
+
+    tokens = Tokenizer().apply_dataset(text)
+    frequency_encode = WordFrequencyEncoder().fit(tokens)
+    unigram_counts = frequency_encode.unigram_counts
+
+    make_ngrams = frequency_encode >> NGramsFeaturizer(
+        list(range(2, config.n + 1)))
+    ngram_counts = NGramsCounts(NO_ADD_MODE).apply_dataset(
+        make_ngrams(tokens).get())
+
+    language_model = StupidBackoffEstimator(unigram_counts).fit(ngram_counts)
+
+    print(f"number of tokens: {language_model.num_tokens}")
+    print(f"size of vocabulary: {len(language_model.unigram_counts)}")
+    print(f"number of ngrams: {len(language_model.scores)}")
+    print(f"Pipeline took {time.time() - start:.1f} s")
+    return language_model
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("StupidBackoffPipeline")
+    p.add_argument("--trainData", required=True)
+    p.add_argument("--n", type=int, default=3)
+    a = p.parse_args(argv)
+    run(StupidBackoffConfig(a.trainData, a.n))
+
+
+if __name__ == "__main__":
+    main()

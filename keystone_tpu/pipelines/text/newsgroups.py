@@ -1,0 +1,72 @@
+"""NewsgroupsPipeline (reference
+``pipelines/text/NewsgroupsPipeline.scala:15-77``):
+Trim -> LowerCase -> Tokenizer -> NGrams(1..n) -> TermFrequency(binary) ->
+CommonSparseFeatures(100k) -> NaiveBayes -> MaxClassifier.
+"""
+from __future__ import annotations
+
+import argparse
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from ...evaluation.multiclass import evaluate_multiclass
+from ...loaders.csv_loader import LabeledData
+from ...loaders.newsgroups import CLASSES, newsgroups_loader
+from ...nodes.learning import NaiveBayesEstimator
+from ...nodes.nlp import LowerCase, NGramsFeaturizer, Tokenizer, Trim
+from ...nodes.stats import TermFrequency
+from ...nodes.util import CommonSparseFeatures, Densify, MaxClassifier
+
+
+@dataclass
+class NewsgroupsConfig:
+    train_location: str = ""
+    test_location: str = ""
+    n_grams: int = 2
+    common_features: int = 100000
+
+
+def run(config: NewsgroupsConfig, train: Optional[LabeledData] = None,
+        test: Optional[LabeledData] = None, num_classes: Optional[int] = None):
+    """Returns (pipeline, test_metrics)."""
+    start = time.time()
+    if train is None:
+        train = newsgroups_loader(config.train_location)
+    if test is None:
+        test = newsgroups_loader(config.test_location)
+    num_classes = num_classes or len(CLASSES)
+
+    predictor = (
+        Trim()
+        >> LowerCase()
+        >> Tokenizer()
+        >> NGramsFeaturizer(list(range(1, config.n_grams + 1)))
+        >> TermFrequency(lambda x: 1)
+    ).and_then(
+        CommonSparseFeatures(config.common_features), train.data
+    ) >> Densify()
+    predictor = predictor.and_then(
+        NaiveBayesEstimator(num_classes), train.data, train.labels
+    ) >> MaxClassifier()
+
+    test_results = predictor(test.data)
+    eval_ = evaluate_multiclass(test_results, test.labels, num_classes)
+    print(eval_.summary())
+    print(f"Pipeline took {time.time() - start:.1f} s")
+    return predictor, eval_
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("NewsgroupsPipeline")
+    p.add_argument("--trainLocation", required=True)
+    p.add_argument("--testLocation", required=True)
+    p.add_argument("--nGrams", type=int, default=2)
+    p.add_argument("--commonFeatures", type=int, default=100000)
+    a = p.parse_args(argv)
+    run(NewsgroupsConfig(a.trainLocation, a.testLocation, a.nGrams,
+                         a.commonFeatures))
+
+
+if __name__ == "__main__":
+    main()

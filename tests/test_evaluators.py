@@ -1,0 +1,118 @@
+"""Evaluator tests with exact values (mirrors the reference's
+BinaryClassifierEvaluatorSuite, MeanAveragePrecisionSuite,
+AugmentedExamplesEvaluatorSuite)."""
+import numpy as np
+import pytest
+
+from keystone_tpu.evaluation import (
+    AVERAGE_POLICY,
+    BORDA_POLICY,
+    evaluate_augmented,
+    evaluate_binary,
+    evaluate_mean_average_precision,
+)
+
+
+def test_binary_contingency_table():
+    preds = [True, True, False, False, True]
+    actual = [True, False, False, True, True]
+    m = evaluate_binary(preds, actual)
+    assert (m.tp, m.fp, m.tn, m.fn) == (2.0, 1.0, 1.0, 1.0)
+    assert m.accuracy == pytest.approx(3 / 5)
+    assert m.error == pytest.approx(2 / 5)
+    assert m.precision == pytest.approx(2 / 3)
+    assert m.recall == pytest.approx(2 / 3)
+    assert m.specificity == pytest.approx(1 / 2)
+    assert m.f_score() == pytest.approx(2 / 3)
+    # beta=2 weighs recall higher
+    assert m.f_score(2.0) == pytest.approx(5 * 2.0 / (5 * 2.0 + 4 * 1 + 1))
+
+
+def test_binary_merge():
+    a = evaluate_binary([True], [True])
+    b = evaluate_binary([False], [True])
+    m = a.merge(b)
+    assert (m.tp, m.fn) == (1.0, 1.0)
+
+
+def test_map_perfect_ranking():
+    # 2 classes, 3 items; scores rank the true item first for each class
+    actual = [[0], [1], [1]]
+    scores = np.array([
+        [0.9, 0.1],
+        [0.2, 0.8],
+        [0.3, 0.7],
+    ])
+    ap = evaluate_mean_average_precision(actual, scores, 2)
+    np.testing.assert_allclose(ap, [1.0, 1.0])
+
+
+def test_map_known_value():
+    # class 0: gt = [1, 0, 1], scores [0.9, 0.8, 0.1] -> ranking: item0(tp),
+    # item1(fp), item2(tp). precisions at hits: 1/1, 2/3; recalls: .5, 1.
+    actual = [[0], [1], [0]]
+    scores = np.array([
+        [0.9, 0.1],
+        [0.8, 0.2],
+        [0.1, 0.9],
+    ])
+    ap = evaluate_mean_average_precision(actual, scores, 2)
+    # 11-point: for t in 0..0.5 -> max precision with recall>=t is 1.0
+    # (6 levels); t in 0.6..1.0 -> 2/3 (5 levels)
+    expected0 = (6 * 1.0 + 5 * (2 / 3)) / 11
+    # class 1: gt=[0,0,1] wait: actual[1]=[1] so gt=[0,1,0]... scores col1 =
+    # [.1,.2,.9] -> order item2(fp),item1(tp),item0(fp): precisions [0,.5,.33],
+    # recalls [0,1,1] -> all levels max precision 0.5
+    np.testing.assert_allclose(ap, [expected0, 0.5], rtol=1e-12)
+
+
+def test_map_multilabel():
+    actual = [[0, 1], [1]]
+    scores = np.array([[0.9, 0.9], [0.1, 0.8]])
+    ap = evaluate_mean_average_precision(actual, scores, 2)
+    np.testing.assert_allclose(ap, [1.0, 1.0])
+
+
+def test_augmented_average_policy():
+    # two source images, two patches each
+    names = ["a", "a", "b", "b"]
+    preds = [
+        np.array([0.6, 0.4]), np.array([0.2, 0.3]),  # avg [0.4, 0.35] -> 0
+        np.array([0.1, 0.9]), np.array([0.3, 0.2]),  # avg [0.2, 0.55] -> 1
+    ]
+    labels = [0, 0, 1, 1]
+    m = evaluate_augmented(names, preds, labels, 2, AVERAGE_POLICY)
+    assert m.total_error == 0.0
+
+
+def test_augmented_borda_policy():
+    names = ["a", "a"]
+    # ranks: patch1 [1, 0], patch2 [1, 0] -> borda [2, 0] -> class 0
+    preds = [np.array([0.9, 0.1]), np.array([0.6, 0.5])]
+    m = evaluate_augmented(names, preds, [0, 0], 2, BORDA_POLICY)
+    assert m.total_error == 0.0
+
+
+def test_augmented_label_mismatch_raises():
+    with pytest.raises(AssertionError):
+        evaluate_augmented(
+            ["a", "a"], [np.zeros(2), np.zeros(2)], [0, 1], 2)
+
+
+def test_binary_degenerate_table_never_raises():
+    # all-negative predictions: precision is 0/0 -> nan, like JVM doubles
+    m = evaluate_binary([False, False], [False, True])
+    assert np.isnan(m.precision)
+    assert m.recall == 0.0
+    assert isinstance(m.summary(), str)  # must not raise
+
+
+def test_map_boundary_recall_thresholds():
+    # recall hits exactly 0.5 with precision 1.0 at the first hit; the
+    # t=0.5 level must include it (guards float-threshold drift)
+    actual = [[0], [0], [1], [1]]
+    scores = np.array([[0.9, 0.0], [0.1, 0.5], [0.4, 0.8], [0.2, 0.6]])
+    ap = evaluate_mean_average_precision(actual, scores, 2)
+    # class 0 ranking: item0(tp, p=1, r=.5), item2(fp), item3(fp), item1(tp)
+    expected0 = (6 * 1.0 + 5 * 0.5) / 11
+    assert ap[0] == pytest.approx(expected0)

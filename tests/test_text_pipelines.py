@@ -1,0 +1,120 @@
+"""End-to-end text pipeline + loader tests (mirrors the reference's
+NewsgroupsPipeline/AmazonReviewsPipeline usage and loader suites)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from keystone_tpu.loaders import (
+    LabeledData,
+    amazon_reviews_loader,
+    newsgroups_loader,
+    timit_features_loader,
+)
+from keystone_tpu.parallel.dataset import ArrayDataset, HostDataset
+from keystone_tpu.pipelines.nlp.stupid_backoff_pipeline import (
+    StupidBackoffConfig,
+    run as run_backoff,
+)
+from keystone_tpu.pipelines.text.amazon_reviews import (
+    AmazonReviewsConfig,
+    run as run_amazon,
+)
+from keystone_tpu.pipelines.text.newsgroups import (
+    NewsgroupsConfig,
+    run as run_newsgroups,
+)
+
+SPORTS = [
+    "the home team won the hockey game last night",
+    "a great baseball game with two home runs",
+    "the playoffs start tonight with a big hockey match",
+    "our team scored twice and won the baseball series",
+    "the goalie made many saves in the hockey final",
+]
+TECH = [
+    "the new graphics card renders the screen quickly",
+    "install the driver to fix the windows graphics issue",
+    "my computer monitor has a high screen resolution",
+    "the software update broke the graphics driver again",
+    "upgrade your computer memory for faster software",
+]
+
+
+def _mini_newsgroups(tmp_path, split):
+    root = tmp_path / split
+    for cls, docs in [("rec.sport.hockey", SPORTS), ("comp.graphics", TECH)]:
+        d = root / cls
+        d.mkdir(parents=True)
+        for i, doc in enumerate(docs):
+            (d / f"{i}.txt").write_text(doc)
+    return str(root)
+
+
+def test_newsgroups_loader_and_pipeline(tmp_path, mesh8):
+    train_dir = _mini_newsgroups(tmp_path, "train")
+    classes = ["rec.sport.hockey", "comp.graphics"]
+    train = newsgroups_loader(train_dir, classes)
+    assert len(train.data) == 10
+    labels = np.asarray(train.labels.numpy())
+    assert (labels == 0).sum() == 5 and (labels == 1).sum() == 5
+
+    _, metrics = run_newsgroups(
+        NewsgroupsConfig(n_grams=2, common_features=500),
+        train=train, test=train, num_classes=2)
+    assert metrics.total_error == 0.0  # separable toy corpus
+
+
+def test_amazon_loader_and_pipeline(tmp_path, mesh8):
+    reviews = [
+        ("great product works perfectly love it", 5.0),
+        ("excellent quality very happy recommend", 5.0),
+        ("terrible broke immediately waste of money", 1.0),
+        ("awful quality very disappointed bad", 1.0),
+        ("great value excellent love the quality", 4.0),
+        ("bad product terrible experience broke", 2.0),
+    ] * 2
+    path = tmp_path / "reviews.json"
+    with open(path, "w") as f:
+        for text, score in reviews:
+            f.write(json.dumps({"reviewText": text, "overall": score}) + "\n")
+
+    data = amazon_reviews_loader(str(path), threshold=3.5)
+    labels = np.asarray(data.labels.numpy())
+    assert labels.sum() == 6  # 6 positives
+    _, metrics = run_amazon(
+        AmazonReviewsConfig(common_features=200, num_iters=50),
+        train=data, test=data)
+    assert metrics.accuracy == 1.0
+
+
+def test_timit_loader(tmp_path):
+    feats = np.arange(12, dtype=np.float32).reshape(4, 3)
+    np.savetxt(tmp_path / "train.csv", feats, delimiter=",")
+    np.savetxt(tmp_path / "test.csv", feats[:2], delimiter=",")
+    with open(tmp_path / "train.lab", "w") as f:
+        for i, lab in enumerate([3, 1, 2, 147]):
+            f.write(f"{i + 1} {lab}\n")
+    with open(tmp_path / "test.lab", "w") as f:
+        f.write("1 5\n2 6\n")
+    data = timit_features_loader(
+        str(tmp_path / "train.csv"), str(tmp_path / "train.lab"),
+        str(tmp_path / "test.csv"), str(tmp_path / "test.lab"))
+    np.testing.assert_array_equal(
+        np.asarray(data.train.labels.numpy()), [2, 0, 1, 146])
+    np.testing.assert_array_equal(
+        np.asarray(data.test.labels.numpy()), [4, 5])
+    np.testing.assert_allclose(np.asarray(data.train.data.numpy()), feats)
+
+
+def test_stupid_backoff_pipeline(tmp_path):
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text(
+        "the cat sat on the mat\nthe cat ran\nthe dog sat on the rug\n")
+    model = run_backoff(StupidBackoffConfig(str(corpus), n=3))
+    assert model.num_tokens == 15
+    assert len(model.unigram_counts) == 8
+    # every pre-scored ngram is a valid relative frequency
+    for s in model.scores.values():
+        assert 0.0 <= s <= 1.0
