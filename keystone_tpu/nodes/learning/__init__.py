@@ -65,3 +65,8 @@ __all__ = [
     "LocalLeastSquaresEstimator",
     "SparseLinearMapper",
 ]
+
+from .lbfgs import SparseLBFGSwithL2  # noqa: E402
+from .least_squares import LeastSquaresEstimator  # noqa: E402
+
+__all__ += ["SparseLBFGSwithL2", "LeastSquaresEstimator"]

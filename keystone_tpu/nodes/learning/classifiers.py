@@ -190,17 +190,52 @@ class LocalLeastSquaresEstimator(LabelEstimator):
         )
 
 
+@jax.jit
+def _sparse_apply(indices, values, W, intercept):
+    # jit lets XLA fuse the gather into the contraction instead of
+    # materializing the (n, slots, k) gathered-weights tensor
+    out = jnp.einsum("rs,rsk->rk", values, W[indices])
+    return out if intercept is None else out + intercept
+
+
 class SparseLinearMapper(Transformer):
     """Linear model over sparse inputs (reference
-    ``SparseLinearMapper.scala:22-48``). On TPU the batch path densifies
-    CSR blocks into the GEMM; per-item apply takes a dense vector."""
+    ``SparseLinearMapper.scala:22-48``). Per-item apply takes a dense
+    vector or a SparseVector (gather of the active weight rows); a batch
+    of SparseVectors packs to padded COO and runs one device einsum."""
 
     def __init__(self, weights: np.ndarray, intercept: Optional[np.ndarray] = None):
         self.weights = np.asarray(weights, dtype=np.float32)
         self.intercept = None if intercept is None else np.asarray(intercept)
 
     def apply(self, x):
-        out = x @ self.weights
+        from ..util.sparse import SparseVector
+
+        if isinstance(x, SparseVector):
+            assert x.size == self.weights.shape[0], (
+                f"sparse input size {x.size} != model dim "
+                f"{self.weights.shape[0]}")
+            out = x.values @ self.weights[x.indices]
+        else:
+            out = x @ self.weights
         if self.intercept is not None:
             out = out + self.intercept
         return out
+
+    def apply_dataset(self, ds: Dataset) -> Dataset:
+        from ...parallel.dataset import HostDataset
+        from ..util.sparse import SparseVector, sparse_batch
+
+        if isinstance(ds, HostDataset) and ds.items and isinstance(
+                ds.items[0], SparseVector):
+            indices, values, size = sparse_batch(ds.items)
+            assert size == self.weights.shape[0], (
+                f"sparse input size {size} != model dim "
+                f"{self.weights.shape[0]}")
+            out = _sparse_apply(
+                jnp.asarray(indices), jnp.asarray(values),
+                jnp.asarray(self.weights),
+                None if self.intercept is None
+                else jnp.asarray(self.intercept))
+            return ArrayDataset.from_numpy(np.asarray(out))
+        return super().apply_dataset(ds)

@@ -1,0 +1,124 @@
+"""Cost-model-driven least squares auto-solver (reference
+``nodes/learning/LeastSquaresEstimator.scala``).
+
+The flagship node-level optimization: choose among DenseLBFGS,
+Sparsify -> SparseLBFGS, Densify -> BlockLeastSquares(1000, 3), and
+Densify -> exact normal equations by evaluating each solver's cost model
+at the observed workload shape (n, d, k, sparsity, num_machines).
+
+The default weights are the reference's empirical calibration on
+16x r3.4xlarge (``LeastSquaresEstimator.scala:17,26-31``); on TPU the
+cost terms are reinterpreted as MXU-flops / HBM-bytes / ICI-bytes per
+chip, and the constructor accepts recalibrated weights.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...parallel.dataset import ArrayDataset, Dataset
+from ...workflow.optimizable import NodeChoice, OptimizableLabelEstimator
+from ..util import Densify
+from ..util.sparse import SparseVector, Sparsify
+from .lbfgs import DenseLBFGSwithL2, SparseLBFGSwithL2
+from .linear import BlockLeastSquaresEstimator, LinearMapEstimator
+
+DEFAULT_CPU_WEIGHT = 3.8e-4
+DEFAULT_MEM_WEIGHT = 2.9e-1
+DEFAULT_NETWORK_WEIGHT = 1.32
+
+
+def estimate_sparsity(sample: Dataset) -> float:
+    """Mean fraction of active entries per item
+    (reference ``LeastSquaresEstimator.scala:68``)."""
+    items = sample.collect() if not isinstance(sample, ArrayDataset) else None
+    if items is not None:
+        fracs = []
+        for it in items:
+            if isinstance(it, SparseVector):
+                fracs.append(it.nnz / max(it.size, 1))
+            else:
+                arr = np.asarray(it)
+                fracs.append(np.count_nonzero(arr) / max(arr.size, 1))
+        return float(np.mean(fracs)) if fracs else 1.0
+    arr = np.asarray(sample.numpy())
+    return float(np.count_nonzero(arr) / max(arr.size, 1))
+
+
+def _item_dim(sample: Dataset) -> int:
+    if isinstance(sample, ArrayDataset):
+        return int(np.asarray(
+            __import__("jax").tree_util.tree_leaves(sample.data)[0]
+        ).shape[-1])
+    first = sample.collect()[0]
+    return first.size if isinstance(first, SparseVector) else int(
+        np.asarray(first).shape[-1])
+
+
+class LeastSquaresEstimator(OptimizableLabelEstimator):
+    """Auto-selecting least-squares solver
+    (reference ``LeastSquaresEstimator.scala:27-86``)."""
+
+    def __init__(
+        self,
+        lam: float = 0.0,
+        num_machines: Optional[int] = None,
+        cpu_weight: float = DEFAULT_CPU_WEIGHT,
+        mem_weight: float = DEFAULT_MEM_WEIGHT,
+        network_weight: float = DEFAULT_NETWORK_WEIGHT,
+        num_iterations: int = 20,
+    ):
+        self.lam = lam
+        self.num_machines = num_machines
+        self.cpu_weight = cpu_weight
+        self.mem_weight = mem_weight
+        self.network_weight = network_weight
+        self.num_iterations = num_iterations
+
+    @property
+    def options(self) -> Sequence[Tuple[object, NodeChoice]]:
+        """(cost-model solver, choice) pairs
+        (reference ``LeastSquaresEstimator.scala:36-53``)."""
+        dense = DenseLBFGSwithL2(
+            lam=self.lam, num_iterations=self.num_iterations)
+        sparse = SparseLBFGSwithL2(
+            lam=self.lam, num_iterations=self.num_iterations)
+        block = BlockLeastSquaresEstimator(1000, 3, lam=self.lam)
+        exact = LinearMapEstimator(lam=self.lam)
+        return [
+            (dense, NodeChoice(dense, (Densify(),))),
+            (sparse, NodeChoice(sparse, (Sparsify(),))),
+            (block, NodeChoice(block, (Densify(),))),
+            (exact, NodeChoice(exact, (Densify(),))),
+        ]
+
+    @property
+    def default(self):
+        return DenseLBFGSwithL2(
+            lam=self.lam, num_iterations=self.num_iterations)
+
+    @property
+    def weight(self) -> int:
+        return self.default.weight
+
+    def _fit(self, ds: Dataset, labels: Dataset):
+        # fallback path when the node-level optimizer has not sampled:
+        # densify host sparse data for the dense default
+        if not isinstance(ds, ArrayDataset):
+            ds = Densify().apply_dataset(ds)
+        return self.default._fit(ds, labels)
+
+    def optimize(self, sample: Dataset, sample_labels: Dataset, n: int,
+                 num_machines: int) -> NodeChoice:
+        d = _item_dim(sample)
+        k = _item_dim(sample_labels)
+        sparsity = estimate_sparsity(sample)
+        machines = self.num_machines or num_machines
+        costs = [
+            (solver.cost(n, d, k, sparsity, machines, self.cpu_weight,
+                         self.mem_weight, self.network_weight), i)
+            for i, (solver, _) in enumerate(self.options)
+        ]
+        _, best = min(costs)
+        return self.options[best][1]

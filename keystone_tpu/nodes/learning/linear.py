@@ -176,11 +176,12 @@ class BlockLeastSquaresEstimator(LabelEstimator):
 
     def cost(self, n, d, k, sparsity, num_machines, cpu_w, mem_w, net_w) -> float:
         """Reference cost model (BlockLinearMapper.scala:268-282)."""
-        i = float(self.num_iter)
-        flops = i * n * d * k / num_machines
-        bytes_scanned = i * n * d
-        network = i * (d * k + num_machines * self.block_size * k)
-        return max(cpu_w * flops, mem_w * bytes_scanned) + net_w * network
+        flops = n * d * (self.block_size + k) / num_machines
+        bytes_scanned = n * d / num_machines + d * k
+        network = 2.0 * (d * (self.block_size + k)) * np.log2(max(num_machines, 2))
+        return self.num_iter * (
+            max(cpu_w * flops, mem_w * bytes_scanned) + net_w * network
+        )
 
 
 @functools.partial(jax.jit, static_argnames=("bounds", "num_iter"))

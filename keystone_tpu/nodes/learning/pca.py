@@ -15,6 +15,7 @@ import numpy as np
 from ...ops import linalg
 from ...parallel.dataset import ArrayDataset, Dataset, HostDataset
 from ...workflow.estimator import Estimator
+from ...workflow.optimizable import NodeChoice, OptimizableEstimator
 from ...workflow.transformer import Transformer
 
 
@@ -182,20 +183,50 @@ class DistributedColumnPCAEstimator(Estimator):
         return BatchPCATransformer(fitted.pca_mat)
 
 
-class ColumnPCAEstimator(Estimator):
-    """Cost-model-optimizable column PCA (reference PCA.scala:118-156).
-    Until the node-level optimizer chooses, defaults to the distributed
-    implementation."""
+class ColumnPCAEstimator(OptimizableEstimator):
+    """Cost-model-optimizable column PCA (reference PCA.scala:118-156):
+    the node-level optimizer picks local vs distributed by the reference's
+    calibrated cost models; until then it runs distributed."""
 
-    def __init__(self, dims: int):
+    def __init__(self, dims: int, cpu_weight: float = None,
+                 mem_weight: float = None, network_weight: float = None):
+        from .least_squares import (
+            DEFAULT_CPU_WEIGHT, DEFAULT_MEM_WEIGHT, DEFAULT_NETWORK_WEIGHT)
+        cpu_weight = DEFAULT_CPU_WEIGHT if cpu_weight is None else cpu_weight
+        mem_weight = DEFAULT_MEM_WEIGHT if mem_weight is None else mem_weight
+        network_weight = (DEFAULT_NETWORK_WEIGHT if network_weight is None
+                          else network_weight)
         self.dims = dims
+        self.cpu_weight = cpu_weight
+        self.mem_weight = mem_weight
+        self.network_weight = network_weight
 
     @property
     def options(self):
-        return [LocalColumnPCAEstimator(self.dims), DistributedColumnPCAEstimator(self.dims)]
+        return [LocalColumnPCAEstimator(self.dims),
+                DistributedColumnPCAEstimator(self.dims)]
 
-    def _fit(self, ds: Dataset) -> BatchPCATransformer:
-        return DistributedColumnPCAEstimator(self.dims)._fit(ds)
+    @property
+    def default(self):
+        return DistributedColumnPCAEstimator(self.dims)
+
+    def optimize(self, sample: Dataset, n: int, num_machines: int) -> NodeChoice:
+        # the column PCA's sample unit is a (d, cols) matrix; the cost
+        # models see total column count as n (reference PCA.scala:134-151)
+        items = sample.collect()
+        cols_per_item = int(np.asarray(items[0]).shape[-1]) if items else 1
+        d = int(np.asarray(items[0]).shape[0]) if items else 1
+        total_cols = n * cols_per_item
+        local = PCAEstimator(self.dims)
+        dist = DistributedPCAEstimator(self.dims)
+        costs = [
+            (local.cost(total_cols, d, self.dims, 1.0, num_machines,
+                        self.cpu_weight, self.mem_weight, self.network_weight), 0),
+            (dist.cost(total_cols, d, self.dims, 1.0, num_machines,
+                       self.cpu_weight, self.mem_weight, self.network_weight), 1),
+        ]
+        _, best = min(costs)
+        return NodeChoice(self.options[best])
 
 
 def _collect_matrix(ds: Dataset) -> np.ndarray:

@@ -1,0 +1,82 @@
+"""Node-level optimizable operators (reference
+``workflow/OptimizableNodes.scala``).
+
+An optimizable node carries a ``default`` implementation (used when the
+optimizer never runs) and an ``optimize(sample..., n, num_machines)``
+hook that inspects a data sample plus workload shape and returns a
+:class:`NodeChoice` — the implementation the cost model prefers, plus an
+optional transformer prefix that must be applied both to the training
+data and to the runtime input path (e.g. ``Sparsify`` before a sparse
+solver, reference ``LeastSquaresEstimator.scala:36-53``).
+
+``NodeOptimizationRule`` (``optimizer/node_rule.py``) splices choices
+into the DAG before execution.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence, Tuple
+
+from ..parallel.dataset import Dataset
+from .estimator import Estimator
+from .label_estimator import LabelEstimator
+from .transformer import Transformer
+
+
+@dataclass
+class NodeChoice:
+    """The sub-pipeline an optimizable node resolves to: ``prefix``
+    transformers feed both the fit path and the runtime path, then
+    ``node`` replaces the optimizable operator."""
+
+    node: object
+    prefix: Tuple[Transformer, ...] = ()
+
+
+class OptimizableTransformer(Transformer):
+    """A transformer with implementation choices
+    (reference ``OptimizableNodes.scala:10-16``)."""
+
+    @property
+    def default(self) -> Transformer:
+        raise NotImplementedError
+
+    def apply(self, x):
+        return self.default.apply(x)
+
+    def apply_dataset(self, ds: Dataset) -> Dataset:
+        return self.default.apply_dataset(ds)
+
+    def optimize(self, sample: Dataset, n: int, num_machines: int) -> NodeChoice:
+        raise NotImplementedError
+
+
+class OptimizableEstimator(Estimator):
+    """An estimator with implementation choices
+    (reference ``OptimizableNodes.scala:21-33``)."""
+
+    @property
+    def default(self) -> Estimator:
+        raise NotImplementedError
+
+    def _fit(self, ds: Dataset) -> Transformer:
+        return self.default._fit(ds)
+
+    def optimize(self, sample: Dataset, n: int, num_machines: int) -> NodeChoice:
+        raise NotImplementedError
+
+
+class OptimizableLabelEstimator(LabelEstimator):
+    """A label estimator with implementation choices
+    (reference ``OptimizableNodes.scala:38-46``)."""
+
+    @property
+    def default(self) -> LabelEstimator:
+        raise NotImplementedError
+
+    def _fit(self, ds: Dataset, labels: Dataset) -> Transformer:
+        return self.default._fit(ds, labels)
+
+    def optimize(self, sample: Dataset, sample_labels: Dataset, n: int,
+                 num_machines: int) -> NodeChoice:
+        raise NotImplementedError

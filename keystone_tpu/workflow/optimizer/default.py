@@ -1,14 +1,17 @@
 """Default optimizer.
 
-Mirrors ``workflow/graph/DefaultOptimizer.scala:5-10``: one Once batch of
-[SavedStateLoad, UnusedBranchRemoval] followed by CSE to fixpoint. (The
-reference's ExtractSaveablePrefixes step is subsumed by the executor's
-``is_saveable`` check — see ``executor.py``.)
+Mirrors ``workflow/graph/DefaultOptimizer.scala:5-10`` plus the v1
+``workflow/DefaultOptimizer.scala:8-14`` node-level pass: saved-state +
+pruning, CSE to fixpoint, cost-model node-level optimization, CSE again.
+(The reference's ExtractSaveablePrefixes step is subsumed by the
+executor's ``is_saveable`` check — see ``executor.py``.)
 """
 from __future__ import annotations
 
 from typing import Sequence
 
+from .auto_cache import AutoCacheRule
+from .node_rule import NodeOptimizationRule
 from .rule import Batch, FixedPoint, Once, Optimizer
 from .rules import (
     EquivalentNodeMergeRule,
@@ -27,6 +30,26 @@ class DefaultOptimizer(Optimizer):
                 [SavedStateLoadRule(), UnusedBranchRemovalRule()],
             ),
             Batch("CSE", FixedPoint(100), [EquivalentNodeMergeRule()]),
+            Batch("node-level optimization", Once(), [NodeOptimizationRule()]),
+            Batch("post-splice CSE", FixedPoint(100),
+                  [EquivalentNodeMergeRule()]),
+        ]
+
+
+class AutoCachingOptimizer(Optimizer):
+    """DefaultOptimizer plus profile-driven caching (reference
+    ``workflow/DefaultOptimizer.scala:19-26``)."""
+
+    def __init__(self, strategy: str = AutoCacheRule.GREEDY,
+                 max_mem=None):
+        self.strategy = strategy
+        self.max_mem = max_mem
+
+    @property
+    def batches(self) -> Sequence[Batch]:
+        return list(DefaultOptimizer().batches) + [
+            Batch("auto-cache", Once(),
+                  [AutoCacheRule(self.strategy, self.max_mem)]),
         ]
 
 

@@ -1,0 +1,162 @@
+"""Node-level optimization rule (reference
+``workflow/NodeOptimizationRule.scala``).
+
+For every optimizable operator that is not downstream of the pipeline's
+runtime source, execute its dependency prefix on *sampled* source
+datasets (the analogue of the reference's per-partition sample execution,
+``NodeOptimizationRule.scala:337-350``), call the node's ``optimize``
+hook with the sample and workload shape, and splice the returned choice
+into the graph:
+
+* the chosen operator replaces the optimizable one;
+* the choice's prefix transformers are inserted on the fit-path data
+  dependency AND on the runtime input of every delegating child — the
+  same two-endpoint splice the reference performs on its instruction
+  list (``NodeOptimizationRule.scala:82-299``).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ...parallel.dataset import ArrayDataset, Dataset, HostDataset
+from ...parallel.mesh import get_mesh, num_data_shards
+from ..graph import Graph
+from ..graph_ids import GraphId, NodeId
+from ..operators import DatasetOperator, DelegatingOperator
+from ..optimizable import (
+    NodeChoice,
+    OptimizableEstimator,
+    OptimizableLabelEstimator,
+    OptimizableTransformer,
+)
+from .rule import Rule
+
+DEFAULT_SAMPLE_SIZE = 96  # reference: samplesPerPartition=3 over many partitions
+
+
+def _sample_dataset(ds: Dataset, size: int) -> Dataset:
+    """Evenly-spread deterministic sample — the analogue of the
+    reference's per-partition sampling (samplesPerPartition across all
+    partitions), avoiding head bias on ordered datasets."""
+    import numpy as np
+
+    n = len(ds)
+    take = min(size, n)
+    idx = np.unique(np.linspace(0, n - 1, take).astype(np.int64))
+    if isinstance(ds, ArrayDataset):
+        import jax
+
+        data = jax.tree_util.tree_map(
+            lambda x: np.asarray(x)[idx], ds.data)
+        return ArrayDataset(data, len(idx), ds.mesh)
+    items = ds.collect()
+    return HostDataset([items[i] for i in idx])
+
+
+class NodeOptimizationRule(Rule):
+    def __init__(self, sample_size: int = DEFAULT_SAMPLE_SIZE,
+                 num_machines: Optional[int] = None):
+        self.sample_size = sample_size
+        self.num_machines = num_machines
+
+    # -- sampling ---------------------------------------------------------
+    def _execute_sampled(self, graph: Graph, deps: Tuple[GraphId, ...]):
+        """Execute dependency ids against a copy of the graph whose source
+        datasets are truncated to the sample size. Returns (samples, n)
+        where n is the full size of the feeding dataset (node transforms
+        are 1:1 per item, as in the reference's numPerPartition count)."""
+        from ..executor import GraphExecutor
+
+        relevant: set = set()
+        for d in deps:
+            relevant.add(d)
+            relevant |= graph.get_ancestors(d)
+        sampled = graph
+        n = 0
+        for node in graph.nodes:
+            op = graph.get_operator(node)
+            if isinstance(op, DatasetOperator):
+                if node in relevant:
+                    n = max(n, len(op.dataset))
+                sampled = sampled.set_operator(
+                    node, DatasetOperator(
+                        _sample_dataset(op.dataset, self.sample_size)))
+        executor = GraphExecutor(sampled, optimize=False)
+        return [executor.execute(d).get() for d in deps], n
+
+    # -- splicing ---------------------------------------------------------
+    @staticmethod
+    def _insert_prefix(graph: Graph, dep: GraphId,
+                       prefix) -> Tuple[Graph, GraphId]:
+        cur = dep
+        for t in prefix:
+            graph, cur = graph.add_node(t, (cur,))
+        return graph, cur
+
+    def _splice_estimator(self, graph: Graph, node: NodeId,
+                          choice: NodeChoice) -> Graph:
+        deps = graph.get_dependencies(node)
+        data_dep, rest = deps[0], deps[1:]
+        graph, new_data = self._insert_prefix(graph, data_dep, choice.prefix)
+        graph = graph.set_operator(node, choice.node)
+        graph = graph.set_dependencies(node, (new_data,) + tuple(rest))
+        if not choice.prefix:
+            return graph
+        # runtime endpoint: delegating children apply the fitted model to
+        # live input; that input must pass through the same prefix
+        for child in list(graph.get_children(node)):
+            if not isinstance(child, NodeId):
+                continue
+            op = graph.get_operator(child)
+            if not isinstance(op, DelegatingOperator):
+                continue
+            cdeps = graph.get_dependencies(child)
+            new_cdeps: List[GraphId] = [cdeps[0]]
+            for rt_in in cdeps[1:]:
+                graph, wrapped = self._insert_prefix(
+                    graph, rt_in, choice.prefix)
+                new_cdeps.append(wrapped)
+            graph = graph.set_dependencies(child, tuple(new_cdeps))
+        return graph
+
+    def _splice_transformer(self, graph: Graph, node: NodeId,
+                            choice: NodeChoice) -> Graph:
+        deps = graph.get_dependencies(node)
+        new_deps = []
+        for dep in deps:
+            graph, wrapped = self._insert_prefix(graph, dep, choice.prefix)
+            new_deps.append(wrapped)
+        graph = graph.set_operator(node, choice.node)
+        return graph.set_dependencies(node, tuple(new_deps))
+
+    # -- rule entry -------------------------------------------------------
+    def apply(self, graph: Graph) -> Graph:
+        # ids reachable from unconnected (runtime) sources can't be sampled
+        downstream: set = set()
+        for s in graph.sources:
+            downstream.add(s)
+            downstream |= graph.get_descendants(s)
+
+        machines = self.num_machines or num_data_shards(get_mesh())
+        for node in graph.linearize():
+            if not isinstance(node, NodeId) or node not in graph.nodes:
+                continue
+            op = graph.get_operator(node)
+            if node in downstream:
+                continue
+            if isinstance(op, OptimizableLabelEstimator):
+                (sample, sample_labels), n = self._execute_sampled(
+                    graph, graph.get_dependencies(node)[:2])
+                choice = op.optimize(sample, sample_labels, n, machines)
+                graph = self._splice_estimator(graph, node, choice)
+            elif isinstance(op, OptimizableEstimator):
+                (sample,), n = self._execute_sampled(
+                    graph, graph.get_dependencies(node)[:1])
+                choice = op.optimize(sample, n, machines)
+                graph = self._splice_estimator(graph, node, choice)
+            elif isinstance(op, OptimizableTransformer):
+                (sample,), n = self._execute_sampled(
+                    graph, graph.get_dependencies(node)[:1])
+                choice = op.optimize(sample, n, machines)
+                graph = self._splice_transformer(graph, node, choice)
+        return graph

@@ -1,0 +1,116 @@
+"""NodeOptimizationRule + cost-model auto-solver tests (mirrors the
+reference's NodeOptimizationRuleSuite and LeastSquaresEstimatorSuite:
+"Big n small d dense" etc. check the cost-model choice itself)."""
+import numpy as np
+import pytest
+
+from keystone_tpu.nodes.learning import (
+    BlockLeastSquaresEstimator,
+    DenseLBFGSwithL2,
+    LeastSquaresEstimator,
+    LinearMapEstimator,
+    SparseLBFGSwithL2,
+)
+from keystone_tpu.nodes.learning.least_squares import estimate_sparsity
+from keystone_tpu.nodes.learning.pca import (
+    ColumnPCAEstimator,
+    DistributedColumnPCAEstimator,
+    LocalColumnPCAEstimator,
+)
+from keystone_tpu.nodes.util import MaxClassifier
+from keystone_tpu.nodes.util.sparse import Sparsify, SparseVector
+from keystone_tpu.parallel.dataset import ArrayDataset, HostDataset
+from keystone_tpu.workflow.optimizable import NodeChoice
+from keystone_tpu.workflow.transformer import transformer
+
+
+def _dense_sample(n=8, d=4, k=2, seed=0):
+    rng = np.random.RandomState(seed)
+    return (ArrayDataset.from_numpy(rng.rand(n, d).astype(np.float32)),
+            ArrayDataset.from_numpy(rng.rand(n, k).astype(np.float32)))
+
+
+def test_cost_choice_big_n_small_d_dense(mesh8):
+    # n=1M, d=1000, k=1000, 16 machines -> exact distributed solve
+    # (reference LeastSquaresEstimatorSuite "Big n small d dense")
+    est = LeastSquaresEstimator()
+    sample, labels = _dense_sample(d=1000, k=1000)
+    choice = est.optimize(sample, labels, n=1_000_000, num_machines=16)
+    assert isinstance(choice.node, LinearMapEstimator)
+
+
+def test_cost_choice_big_n_big_d_dense(mesh8):
+    # n=1M, d=10000, k=1000 -> block solver (reference "big n big d dense")
+    est = LeastSquaresEstimator()
+    sample, labels = _dense_sample(d=10_000, k=1000, n=4)
+    choice = est.optimize(sample, labels, n=1_000_000, num_machines=16)
+    assert isinstance(choice.node, BlockLeastSquaresEstimator)
+
+
+def test_cost_choice_big_n_big_d_sparse(mesh8):
+    # n=1M, d=10000, k=2, sparsity=0.01 -> sparse LBFGS
+    # (reference "big n big d sparse")
+    est = LeastSquaresEstimator()
+    rng = np.random.RandomState(0)
+    items = [SparseVector(np.arange(100), np.ones(100, np.float32), 10_000)
+             for _ in range(8)]
+    labels = ArrayDataset.from_numpy(rng.randn(8, 2).astype(np.float32))
+    choice = est.optimize(HostDataset(items), labels,
+                          n=1_000_000, num_machines=16)
+    assert isinstance(choice.node, SparseLBFGSwithL2)
+    assert any(isinstance(t, Sparsify) for t in choice.prefix)
+
+
+def test_cost_choice_small_n_big_d_exact(mesh8):
+    # small n, moderate d, dense -> exact normal equations or block solve
+    est = LeastSquaresEstimator()
+    sample, labels = _dense_sample(d=4)
+    choice = est.optimize(sample, labels, n=100, num_machines=1)
+    assert isinstance(choice.node,
+                      (LinearMapEstimator, BlockLeastSquaresEstimator,
+                       DenseLBFGSwithL2))
+
+
+def test_estimate_sparsity():
+    items = [SparseVector([0], [1.0], 10), SparseVector([0, 1, 2], [1.] * 3, 10)]
+    assert estimate_sparsity(HostDataset(items)) == pytest.approx(0.2)
+
+
+def test_column_pca_optimize_small_prefers_local(mesh8):
+    items = [np.random.RandomState(i).rand(8, 4).astype(np.float32)
+             for i in range(3)]
+    est = ColumnPCAEstimator(dims=2)
+    choice = est.optimize(HostDataset(items), n=3, num_machines=8)
+    assert isinstance(choice.node, (LocalColumnPCAEstimator,
+                                    DistributedColumnPCAEstimator))
+
+
+def test_node_optimization_rule_splices_in_pipeline(mesh8):
+    """End-to-end: a pipeline holding a LeastSquaresEstimator is optimized
+    so the fitted pipeline uses the cost-chosen solver + prefix on both
+    the fit path and the runtime path."""
+    rng = np.random.RandomState(0)
+    n, d, k = 32, 6, 3
+    X = rng.randn(n, d).astype(np.float32)
+    W = rng.randn(d, k).astype(np.float32)
+    Y = (X @ W).astype(np.float32)
+    train = ArrayDataset.from_numpy(X)
+    labels = ArrayDataset.from_numpy(Y)
+
+    ident = transformer(lambda x: x * 1.0)
+    pipe = ident.and_then(
+        LeastSquaresEstimator(num_iterations=100), train, labels)
+    preds = pipe(train).get().numpy()
+    np.testing.assert_allclose(preds, Y, atol=5e-2)
+
+
+def test_optimizable_default_without_rule(mesh8):
+    # calling .fit directly (no DAG, no rule) uses the default solver
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 6).astype(np.float32)
+    Y = (X @ rng.randn(6, 2)).astype(np.float32)
+    model = LeastSquaresEstimator(num_iterations=100).fit(
+        ArrayDataset.from_numpy(X), ArrayDataset.from_numpy(Y))
+    np.testing.assert_allclose(
+        np.asarray(model.apply_dataset(ArrayDataset.from_numpy(X)).numpy()),
+        Y, atol=5e-2)
