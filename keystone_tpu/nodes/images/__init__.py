@@ -1,0 +1,14 @@
+
+from .extractors import (  # noqa: E402
+    BatchSIFTExtractor,
+    LCSExtractor,
+    SIFTExtractor,
+)
+from .fisher_vector import (  # noqa: E402
+    EncEvalGMMFisherVectorEstimator,
+    FisherVector,
+    GMMFisherVectorEstimator,
+    ScalaGMMFisherVectorEstimator,
+)
+from .daisy import DaisyExtractor  # noqa: E402
+from .hog import HogExtractor  # noqa: E402

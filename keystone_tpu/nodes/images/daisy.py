@@ -1,0 +1,145 @@
+"""DAISY dense descriptors (reference
+``nodes/images/DaisyExtractor.scala``; Tola, Lepetit, Fua, PAMI 2010).
+
+Pipeline: oriented gradient maps (H rectified directional derivatives),
+stacked Gaussian blur layers (each level blurs the previous, so level l
+carries cumulative sigma), then per-keypoint histograms sampled at the
+center plus T ring points per level, each L2-normalized. All convolution
+work is separable 'same' convs (one jitted program); histogram sampling
+is a static gather at precomputed integer offsets.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...workflow.transformer import Transformer
+
+FEATURE_THRESHOLD = 1e-8
+CONV_THRESHOLD = 1e-6
+
+
+def conv2d_same(img: jax.Array, fx: np.ndarray, fy: np.ndarray) -> jax.Array:
+    """Zero-padded separable 'same' true convolution of (H, W), matching
+    ``ImageUtils.conv2D`` (reference ImageUtils.scala:226-344): pad low =
+    floor((L-1)/2), kernels flipped."""
+    kx = jnp.asarray(np.asarray(fx, np.float32)[::-1].copy())
+    ky = jnp.asarray(np.asarray(fy, np.float32)[::-1].copy())
+    lx, ly = len(fx), len(fy)
+    plx, phx = (lx - 1) // 2, lx - 1 - (lx - 1) // 2
+    ply, phy = (ly - 1) // 2, ly - 1 - (ly - 1) // 2
+    x = jnp.pad(img, ((plx, phx), (ply, phy)))[None, None]
+    x = jax.lax.conv_general_dilated(x, kx.reshape(1, 1, -1, 1), (1, 1), "VALID")
+    x = jax.lax.conv_general_dilated(x, ky.reshape(1, 1, 1, -1), (1, 1), "VALID")
+    return x[0, 0]
+
+
+def _daisy_kernels(daisy_q: int, daisy_r: int) -> List[np.ndarray]:
+    """Incremental Gaussian kernels (reference DaisyExtractor.scala:50-64):
+    sigma^2 ladder (R*n / 2Q)^2, each kernel covering the difference."""
+    sigma_sq = [(daisy_r * n / (2.0 * daisy_q)) ** 2
+                for n in range(daisy_q + 1)]
+    diffs = [b - a for a, b in zip(sigma_sq, sigma_sq[1:])]
+    kernels = []
+    for t in diffs:
+        radius = int(math.ceil(math.sqrt(
+            -2 * t * math.log(CONV_THRESHOLD) - t * math.log(2 * math.pi * t))))
+        n = np.arange(-radius, radius + 1, dtype=np.float64)
+        k = np.exp(-(n ** 2) / (2 * t)) / math.sqrt(2 * math.pi * t)
+        kernels.append(k)
+    return kernels
+
+
+class DaisyExtractor(Transformer):
+    """DAISY on a regular grid; output (H*(T*Q+1), numKeypoints) float
+    (reference ``DaisyExtractor.scala:28-201``)."""
+
+    def __init__(self, daisy_t: int = 8, daisy_q: int = 3, daisy_r: int = 7,
+                 daisy_h: int = 8, pixel_border: int = 16, stride: int = 4,
+                 patch_size: int = 24):
+        self.daisy_t = daisy_t
+        self.daisy_q = daisy_q
+        self.daisy_r = daisy_r
+        self.daisy_h = daisy_h
+        self.pixel_border = pixel_border
+        self.stride = stride
+        self.patch_size = patch_size
+
+    @property
+    def feature_size(self) -> int:
+        return self.daisy_h * (self.daisy_t * self.daisy_q + 1)
+
+    def apply(self, img):
+        if img.ndim == 3:
+            img = img[..., 0]
+        return _daisy(
+            img.astype(jnp.float32), int(img.shape[0]), int(img.shape[1]),
+            self.daisy_t, self.daisy_q, self.daisy_r, self.daisy_h,
+            self.pixel_border, self.stride)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "height", "width", "T", "Q", "R", "H", "border", "stride"))
+def _daisy(img, height, width, T, Q, R, H, border, stride):
+    # oriented gradient maps (reference :108-136)
+    f1 = np.array([1.0, 0.0, -1.0])
+    f2 = np.array([1.0, 2.0, 1.0])
+    ix = conv2d_same(img, f1, f2)
+    iy = conv2d_same(img, f2, f1)
+    kernels = _daisy_kernels(Q, R)
+
+    layers = []  # (Q, H) images
+    for h in range(H):
+        angle = 2.0 * np.pi * h / H
+        g0 = jnp.maximum(np.cos(angle) * ix + np.sin(angle) * iy, 0.0)
+        level = conv2d_same(g0, kernels[0], kernels[0])
+        per_level = [level]
+        for l in range(1, Q):
+            level = conv2d_same(level, kernels[l], kernels[l])
+            per_level.append(level)
+        layers.append(per_level)
+    # stack to (Q, H, height, width)
+    stack = jnp.stack(
+        [jnp.stack([layers[h][l] for h in range(H)]) for l in range(Q)])
+
+    xs = np.arange(border, height - border, stride)
+    ys = np.arange(border, width - border, stride)
+    xx, yy = np.meshgrid(xs, ys, indexing="ij")
+    xx, yy = xx.ravel(), yy.ravel()  # keypoints, x-major like the reference
+
+    def norm_hist(h):  # (N, H) -> L2 normalized, zeroed when tiny
+        n = jnp.linalg.norm(h, axis=1, keepdims=True)
+        return jnp.where(n > FEATURE_THRESHOLD, h / jnp.maximum(n, 1e-30), 0.0)
+
+    feats = []
+    # center histogram: layer 0 at the keypoint (reference getCenterHist)
+    center = stack[0][:, xx, yy].T  # (N, H)
+    feats.append(norm_hist(center))
+
+    ring = np.zeros((Q, T, 2), np.int64)
+    for l in range(Q):
+        rad = R * (1.0 + l) / Q
+        for t in range(T):
+            theta = 2.0 * np.pi * (t - 1) / T
+            ring[l, t, 0] = int(round(rad * math.sin(theta)))
+            ring[l, t, 1] = int(round(rad * math.cos(theta)))
+
+    # feature layout (reference :160-186): center at [0:H], then ring
+    # histogram for angle t, level l at H + t*Q*H + l*H
+    ring_feats = {}
+    for l in range(Q):
+        for t in range(T):
+            px = np.clip(xx + ring[l, t, 0], 0, height - 1)
+            py = np.clip(yy + ring[l, t, 1], 0, width - 1)
+            ring_feats[(t, l)] = norm_hist(stack[l][:, px, py].T)
+    for t in range(T):
+        for l in range(Q):
+            feats.append(ring_feats[(t, l)])
+
+    out = jnp.concatenate(feats, axis=1)  # (N, H*(T*Q+1))
+    return out.T.astype(jnp.float32)

@@ -1,0 +1,127 @@
+"""Fisher vector encoding (reference ``nodes/images/FisherVector.scala``
+and the enceval JNI variant ``nodes/images/external/FisherVector.scala`` /
+``cpp/EncEval.cxx``).
+
+The FV of a descriptor matrix under a diagonal GMM, in the s0/s1/s2
+moment form of the Sanchez et al. survey (``FisherVector.scala:33-52``):
+
+    q  = GMM posteriors               (nDesc, K)
+    s0 = mean(q)                      (K,)
+    s1 = X q / nDesc                  (D, K)
+    s2 = (X*X) q / nDesc              (D, K)
+    fv1 = (s1 - means s0) / (sqrt(vars) sqrt(w))
+    fv2 = (s2 - 2 means s1 + (means^2 - vars) s0) / (vars sqrt(2 w))
+
+One jitted program: the q/s1/s2 GEMMs are the hot path and map straight
+onto the MXU — this *is* the TPU-native "native" implementation, so the
+reference's scala-vs-enceval split becomes jit-per-item vs batched-vmap.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...parallel.dataset import Dataset
+from ...workflow.estimator import Estimator
+from ...workflow.optimizable import NodeChoice, OptimizableEstimator
+from ...workflow.transformer import Transformer
+from ..learning.gmm import (
+    GaussianMixtureModel,
+    GaussianMixtureModelEstimator,
+    _posteriors,
+)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _fisher_vector(X, means, variances, weights, weight_threshold):
+    """X is (D, nDesc); means/variances (D, K); weights (K,)."""
+    n_desc = X.shape[1]
+    q = _posteriors(
+        X.T, means.T, variances.T, weights, weight_threshold
+    )  # (nDesc, K)
+    s0 = jnp.mean(q, axis=0)                      # (K,)
+    s1 = (X @ q) / n_desc                         # (D, K)
+    s2 = ((X * X) @ q) / n_desc                   # (D, K)
+    sqrt_w = jnp.sqrt(weights)
+    fv1 = (s1 - means * s0[None, :]) / (jnp.sqrt(variances) * sqrt_w[None, :])
+    fv2 = (s2 - 2.0 * means * s1 + (means * means - variances) * s0[None, :]) \
+        / (variances * jnp.sqrt(2.0 * weights)[None, :])
+    return jnp.concatenate([fv1, fv2], axis=1)    # (D, 2K)
+
+
+class FisherVector(Transformer):
+    """FV transformer: (D, nDesc) descriptor matrix -> (D, 2K) matrix
+    (reference ``FisherVector.scala:22-54``)."""
+
+    def __init__(self, gmm: GaussianMixtureModel):
+        self.gmm = gmm
+
+    def eq_key(self):
+        return (FisherVector, id(self.gmm))
+
+    def apply(self, x):
+        return _fisher_vector(
+            x.astype(jnp.float32),
+            jnp.asarray(self.gmm.means),
+            jnp.asarray(self.gmm.variances),
+            jnp.asarray(self.gmm.weights),
+            self.gmm.weight_threshold,
+        )
+
+
+def _gmm_from_columns(ds: Dataset, k: int,
+                      seed: Optional[int] = None) -> GaussianMixtureModel:
+    """Fit the GMM treating every column of every item as a sample
+    (reference ``ScalaGMMFisherVectorEstimator``,
+    ``FisherVector.scala:67-73``)."""
+    from ...parallel.dataset import ArrayDataset
+
+    items = ds.collect()
+    cols = np.concatenate(
+        [np.asarray(m, np.float32).T for m in items], axis=0)
+    est = GaussianMixtureModelEstimator(k, seed=seed or 0)
+    return est.fit(ArrayDataset.from_numpy(cols))
+
+
+class ScalaGMMFisherVectorEstimator(Estimator):
+    """Per-item-jit FV estimator (reference ``FisherVector.scala:67-73``;
+    the name mirrors the reference's scala implementation)."""
+
+    def __init__(self, k: int):
+        self.k = k
+
+    def _fit(self, ds: Dataset) -> FisherVector:
+        return FisherVector(_gmm_from_columns(ds, self.k))
+
+
+class EncEvalGMMFisherVectorEstimator(Estimator):
+    """Counterpart of the reference's native enceval estimator
+    (``external/FisherVector.scala:17-55``): same GMM fit, same FV math —
+    on TPU the jitted GEMM formulation IS the fast native path."""
+
+    def __init__(self, k: int):
+        self.k = k
+
+    def _fit(self, ds: Dataset) -> FisherVector:
+        return FisherVector(_gmm_from_columns(ds, self.k))
+
+
+class GMMFisherVectorEstimator(OptimizableEstimator):
+    """Auto-choosing FV estimator (reference ``FisherVector.scala:85-94``:
+    picks the native implementation when k >= 32)."""
+
+    def __init__(self, k: int):
+        self.k = k
+
+    @property
+    def default(self) -> Estimator:
+        return ScalaGMMFisherVectorEstimator(self.k)
+
+    def optimize(self, sample: Dataset, n: int, num_machines: int) -> NodeChoice:
+        if self.k >= 32:
+            return NodeChoice(EncEvalGMMFisherVectorEstimator(self.k))
+        return NodeChoice(ScalaGMMFisherVectorEstimator(self.k))

@@ -107,6 +107,8 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         # densify host sparse data for the dense default
         if not isinstance(ds, ArrayDataset):
             ds = Densify().apply_dataset(ds)
+        if not isinstance(labels, ArrayDataset):
+            labels = Densify().apply_dataset(labels)
         return self.default._fit(ds, labels)
 
     def optimize(self, sample: Dataset, sample_labels: Dataset, n: int,

@@ -28,10 +28,9 @@ from ...parallel.dataset import ArrayDataset, Dataset
 from ...parallel.mesh import get_mesh, num_data_shards
 from ..common import Cacher
 from ..graph import Graph
-from ..graph_ids import GraphId, NodeId, SinkId
+from ..graph_ids import NodeId
 from ..operators import (
     DatasetOperator,
-    DelegatingOperator,
     EstimatorOperator,
     ExpressionOperator,
     Operator,
@@ -303,6 +302,10 @@ class AutoCacheRule(Rule):
         children = _children_with_multiplicity(graph)
         weights = {n: node_weight(graph.get_operator(n)) for n in graph.nodes}
         cached = set(init_cache_set(graph))
+        # per-input runtime nodes can never be reused across inputs
+        downstream_of_source: set = set()
+        for s_ in graph.sources:
+            downstream_of_source |= graph.get_descendants(s_)
         budget = self.max_mem if self.max_mem is not None else _device_mem_budget()
 
         def used() -> float:
@@ -314,6 +317,7 @@ class AutoCacheRule(Rule):
             return [
                 n for n in graph.nodes
                 if n not in cached and runs[n] > 1
+                and n not in downstream_of_source
                 and profiles.get(n, Profile()).mem < space_left
                 and _data_outputting(graph, n)
             ]

@@ -16,7 +16,7 @@ into the graph:
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 from ...parallel.dataset import ArrayDataset, Dataset, HostDataset
 from ...parallel.mesh import get_mesh, num_data_shards

@@ -1,0 +1,322 @@
+"""Feature extractor tests: SIFT statistical/structural properties, LCS
+vs direct numpy computation, FisherVector vs a literal numpy port of the
+reference formula (mirrors ConvolverSuite-style golden testing and the
+EncEvalSuite FV check)."""
+import numpy as np
+import pytest
+
+from keystone_tpu.nodes.images import (
+    FisherVector,
+    GMMFisherVectorEstimator,
+    LCSExtractor,
+    ScalaGMMFisherVectorEstimator,
+    SIFTExtractor,
+)
+from keystone_tpu.nodes.images.fisher_vector import (
+    EncEvalGMMFisherVectorEstimator,
+)
+from keystone_tpu.nodes.learning.gmm import GaussianMixtureModel
+from keystone_tpu.ops.sift import dense_sift, sift_descriptor_count
+from keystone_tpu.parallel.dataset import HostDataset
+
+
+def _test_image(h=64, w=64, seed=0):
+    rng = np.random.RandomState(seed)
+    # smooth random image with some structure
+    img = rng.rand(h, w).astype(np.float32)
+    from scipy.ndimage import gaussian_filter
+
+    return gaussian_filter(img, 2.0).astype(np.float32)
+
+
+def test_sift_shape_and_count():
+    img = _test_image()
+    ext = SIFTExtractor(step=4, bin_size=6, num_scales=2)
+    out = np.asarray(ext.apply(img))
+    assert out.shape[0] == 128
+    assert out.shape[1] == sift_descriptor_count(64, 64, 4, 6, 2)
+    assert out.shape[1] > 0
+
+
+def test_sift_range_and_nonzero():
+    img = _test_image()
+    out = np.asarray(dense_sift(img, step=8, bin_size=4, num_scales=1))
+    assert out.min() >= 0.0 and out.max() <= 255.0
+    assert np.count_nonzero(out) > 0
+
+
+def test_sift_low_contrast_zeroed():
+    # a constant image has zero gradients everywhere -> all descriptors 0
+    img = np.full((48, 48), 0.5, np.float32)
+    out = np.asarray(dense_sift(img, step=4, bin_size=4, num_scales=1))
+    np.testing.assert_array_equal(out, 0.0)
+
+
+def test_sift_rotation_moves_orientations():
+    # rotating the image 90 degrees must permute orientation energy, not
+    # destroy it: total descriptor mass is approximately preserved
+    img = _test_image()
+    out1 = np.asarray(dense_sift(img, step=8, bin_size=4, num_scales=1))
+    out2 = np.asarray(dense_sift(np.rot90(img).copy(), step=8, bin_size=4,
+                                 num_scales=1))
+    assert out2.sum() == pytest.approx(out1.sum(), rel=0.15)
+
+
+def test_lcs_shape_and_values():
+    rng = np.random.RandomState(0)
+    img = rng.rand(64, 64, 3).astype(np.float32)
+    ext = LCSExtractor(stride=8, stride_start=20, sub_patch_size=6)
+    out = np.asarray(ext.apply(img))
+    xs = np.arange(20, 64 - 20, 8)
+    assert out.shape == (96, len(xs) * len(xs))
+
+    # check one mean value directly: keypoint (20,20), first sub-patch
+    # offset start = -2*6+3-1 = -10 -> position (10, 10); box mean over
+    # the window centred there (separable uniform filter, zero padded)
+    from scipy.ndimage import uniform_filter
+
+    m0 = uniform_filter(img[:, :, 0], size=6, mode="constant")
+    # scipy centers even windows differently (offset by one for even
+    # sizes); accept either centering convention
+    got = out[0, 0]
+    cands = [m0[10, 10], m0[9, 9], m0[10, 9], m0[9, 10]]
+    assert min(abs(got - c) for c in cands) < 2e-3
+
+
+def _np_fisher_vector(X, means, variances, weights, thr=1e-4):
+    """Literal numpy port of FisherVector.scala:33-52."""
+    D, n = X.shape
+    k = weights.shape[0]
+    # posteriors
+    q = np.zeros((n, k))
+    for i in range(n):
+        x = X[:, i]
+        llh = np.array([
+            -0.5 * D * np.log(2 * np.pi)
+            - 0.5 * np.sum(np.log(variances[:, j]))
+            + np.log(weights[j])
+            - 0.5 * np.sum((x - means[:, j]) ** 2 / variances[:, j])
+            for j in range(k)
+        ])
+        e = np.exp(llh - llh.max())
+        p = e / e.sum()
+        p[p <= thr] = 0.0
+        q[i] = p / p.sum()
+    s0 = q.mean(axis=0)
+    s1 = X @ q / n
+    s2 = (X * X) @ q / n
+    fv1 = (s1 - means * s0) / (np.sqrt(variances) * np.sqrt(weights))
+    fv2 = (s2 - 2 * means * s1 + (means ** 2 - variances) * s0) / (
+        variances * np.sqrt(2 * weights))
+    return np.concatenate([fv1, fv2], axis=1)
+
+
+def test_fisher_vector_matches_numpy_golden():
+    rng = np.random.RandomState(3)
+    D, n, k = 6, 40, 4
+    means = rng.randn(D, k).astype(np.float64)
+    variances = (0.5 + rng.rand(D, k)).astype(np.float64)
+    weights = np.full(k, 1.0 / k)
+    X = rng.randn(D, n).astype(np.float32)
+
+    gmm = GaussianMixtureModel(means, variances, weights)
+    fv = np.asarray(FisherVector(gmm).apply(X))
+    golden = _np_fisher_vector(
+        X.astype(np.float64), means, variances, weights)
+    assert fv.shape == (D, 2 * k)
+    np.testing.assert_allclose(fv, golden, rtol=2e-3, atol=2e-3)
+
+
+def test_gmm_fisher_vector_estimator(mesh8):
+    rng = np.random.RandomState(0)
+    # two clusters of descriptor columns
+    items = []
+    for i in range(4):
+        a = rng.randn(5, 30) * 0.1 + 2.0
+        b = rng.randn(5, 30) * 0.1 - 2.0
+        items.append(np.concatenate([a, b], axis=1).astype(np.float32))
+    fitted = ScalaGMMFisherVectorEstimator(2).fit(HostDataset(items))
+    out = np.asarray(fitted.apply(items[0]))
+    assert out.shape == (5, 4)
+    assert np.isfinite(out).all()
+
+
+def test_gmm_fv_estimator_choice():
+    est = GMMFisherVectorEstimator(64)
+    choice = est.optimize(HostDataset([np.zeros((4, 4), np.float32)]), 1, 8)
+    assert isinstance(choice.node, EncEvalGMMFisherVectorEstimator)
+    est2 = GMMFisherVectorEstimator(16)
+    choice2 = est2.optimize(HostDataset([np.zeros((4, 4), np.float32)]), 1, 8)
+    assert isinstance(choice2.node, ScalaGMMFisherVectorEstimator)
+
+
+def _np_hog(img, bin_size):
+    """Literal numpy port of HogExtractor.scala for golden comparison."""
+    H, W, C = img.shape
+    nx = int(round(H / bin_size))
+    ny = int(round(W / bin_size))
+    uu = np.array([1.0, 0.9397, 0.7660, 0.5, 0.1736,
+                   -0.1736, -0.5, -0.7660, -0.9397])
+    vv = np.array([0.0, 0.3420, 0.6428, 0.8660, 0.9848,
+                   0.9848, 0.8660, 0.6428, 0.3420])
+    hist = np.zeros(nx * ny * 18)
+    for x in range(1, nx * bin_size - 1):
+        for y in range(1, ny * bin_size - 1):
+            best = (-np.inf, None, None)
+            for c in (2, 1, 0):
+                dx = img[x + 1, y, c] - img[x - 1, y, c]
+                dy = img[x, y + 1, c] - img[x, y - 1, c]
+                m2 = dx * dx + dy * dy
+                if m2 > best[0]:
+                    best = (m2, dx, dy)
+            m2, dx, dy = best
+            mag = np.sqrt(m2)
+            bo, bd = 0, 0.0
+            for o in range(9):
+                dot = uu[o] * dy + vv[o] * dx
+                if dot > bd:
+                    bo, bd = o, dot
+                elif -dot > bd:
+                    bo, bd = o + 9, -dot
+            yp = (y + 0.5) / bin_size - 0.5
+            xp = (x + 0.5) / bin_size - 0.5
+            iyp, ixp = int(np.floor(yp)), int(np.floor(xp))
+            vy0, vx0 = yp - iyp, xp - ixp
+            vy1, vx1 = 1 - vy0, 1 - vx0
+            for (cx, cy, w) in [(ixp, iyp, vy1 * vx1), (ixp, iyp + 1, vy0 * vx1),
+                                (ixp + 1, iyp, vy1 * vx0),
+                                (ixp + 1, iyp + 1, vy0 * vx0)]:
+                if 0 <= cx < nx and 0 <= cy < ny:
+                    hist[cx + cy * nx + bo * nx * ny] += w * mag
+    norm = np.zeros(nx * ny)
+    for o in range(9):
+        for y in range(ny):
+            for x in range(nx):
+                v = hist[x + y * nx + o * nx * ny] + \
+                    hist[x + y * nx + (o + 9) * nx * ny]
+                norm[x + y * nx] += v * v
+    nxf, nyf = max(nx - 2, 0), max(ny - 2, 0)
+    feats = np.zeros((nxf * nyf, 32))
+    eps = 1e-4
+    for x in range(nxf):
+        for y in range(nyf):
+            row = y + x * nyf
+            def blocksum(bx, by):
+                return (norm[bx + by * nx] + norm[bx + 1 + by * nx]
+                        + norm[bx + (by + 1) * nx] + norm[bx + 1 + (by + 1) * nx])
+            n1 = 1 / np.sqrt(blocksum(x + 1, y + 1) + eps)
+            n2 = 1 / np.sqrt(blocksum(x, y + 1) + eps)
+            n3 = 1 / np.sqrt(blocksum(x + 1, y) + eps)
+            n4 = 1 / np.sqrt(blocksum(x, y) + eps)
+            t = np.zeros(4)
+            for o in range(18):
+                hv = hist[(x + 1) + (y + 1) * nx + o * nx * ny]
+                hs = [min(hv * n, 0.2) for n in (n1, n2, n3, n4)]
+                feats[row, o] = 0.5 * sum(hs)
+                t += hs
+            for o in range(9):
+                hv = hist[(x + 1) + (y + 1) * nx + o * nx * ny] + \
+                    hist[(x + 1) + (y + 1) * nx + (o + 9) * nx * ny]
+                feats[row, 18 + o] = 0.5 * sum(min(hv * n, 0.2)
+                                               for n in (n1, n2, n3, n4))
+            feats[row, 27:31] = [0.2357 * ti for ti in t]
+            feats[row, 31] = 0.0
+    return feats
+
+
+def test_hog_matches_numpy_golden():
+    from keystone_tpu.nodes.images import HogExtractor
+
+    rng = np.random.RandomState(0)
+    img = rng.rand(24, 24, 3).astype(np.float32)
+    got = np.asarray(HogExtractor(bin_size=8).apply(img))
+    want = _np_hog(img.astype(np.float64), 8)
+    assert got.shape == want.shape == (1, 32)
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+def test_hog_larger_grid_matches():
+    from keystone_tpu.nodes.images import HogExtractor
+
+    rng = np.random.RandomState(7)
+    img = rng.rand(32, 40, 3).astype(np.float32)
+    got = np.asarray(HogExtractor(bin_size=8).apply(img))
+    want = _np_hog(img.astype(np.float64), 8)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+def test_daisy_shape_and_normalization():
+    from keystone_tpu.nodes.images import DaisyExtractor
+
+    rng = np.random.RandomState(0)
+    img = rng.rand(64, 64).astype(np.float32)
+    ext = DaisyExtractor()
+    out = np.asarray(ext.apply(img))
+    xs = np.arange(16, 64 - 16, 4)
+    assert out.shape == (ext.feature_size, len(xs) * len(xs))
+    # every 8-bin histogram is L2-normalized (or zero)
+    hists = out.reshape(8, -1, out.shape[1], order="F")
+    norms = np.linalg.norm(out.T.reshape(-1, ext.feature_size // 8, 8), axis=2)
+    assert np.all((np.abs(norms - 1.0) < 1e-4) | (norms < 1e-6))
+
+
+def _np_daisy(img, T=8, Q=3, R=7, H=8, border=16, stride=4):
+    """Direct numpy DAISY via scipy convolve2d (true convolution, zero
+    padded 'same' like ImageUtils.conv2D for odd kernels)."""
+    from scipy.signal import convolve2d
+
+    from keystone_tpu.nodes.images.daisy import _daisy_kernels
+
+    def conv(a, fx, fy):
+        return convolve2d(
+            convolve2d(a, np.asarray(fx)[:, None], mode="same"),
+            np.asarray(fy)[None, :], mode="same")
+
+    f1, f2 = [1.0, 0.0, -1.0], [1.0, 2.0, 1.0]
+    ix = conv(img, f1, f2)
+    iy = conv(img, f2, f1)
+    kernels = _daisy_kernels(Q, R)
+    layers = {}
+    for h in range(H):
+        ang = 2 * np.pi * h / H
+        g = np.maximum(np.cos(ang) * ix + np.sin(ang) * iy, 0.0)
+        lvl = conv(g, kernels[0], kernels[0])
+        layers[(0, h)] = lvl
+        for l in range(1, Q):
+            lvl = conv(lvl, kernels[l], kernels[l])
+            layers[(l, h)] = lvl
+
+    def norm(v):
+        n = np.linalg.norm(v)
+        return v / n if n > 1e-8 else np.zeros_like(v)
+
+    xs = range(border, img.shape[0] - border, stride)
+    ys = range(border, img.shape[1] - border, stride)
+    cols = []
+    for x in xs:
+        for y in ys:
+            feat = np.zeros(H * (T * Q + 1))
+            feat[:H] = norm(np.array([layers[(0, h)][x, y] for h in range(H)]))
+            for t in range(T):
+                theta = 2 * np.pi * (t - 1) / T
+                for l in range(Q):
+                    rad = R * (1.0 + l) / Q
+                    px = x + int(round(rad * np.sin(theta)))
+                    py = y + int(round(rad * np.cos(theta)))
+                    v = norm(np.array(
+                        [layers[(l, h)][px, py] for h in range(H)]))
+                    feat[H + t * Q * H + l * H: H + t * Q * H + (l + 1) * H] = v
+            cols.append(feat)
+    return np.stack(cols, axis=1)
+
+
+def test_daisy_matches_numpy_golden():
+    from keystone_tpu.nodes.images import DaisyExtractor
+
+    rng = np.random.RandomState(0)
+    img = rng.rand(48, 48).astype(np.float32)
+    got = np.asarray(DaisyExtractor(pixel_border=16, stride=8).apply(img))
+    want = _np_daisy(img.astype(np.float64), border=16, stride=8)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=2e-4)
