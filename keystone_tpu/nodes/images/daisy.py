@@ -60,15 +60,13 @@ class DaisyExtractor(Transformer):
     (reference ``DaisyExtractor.scala:28-201``)."""
 
     def __init__(self, daisy_t: int = 8, daisy_q: int = 3, daisy_r: int = 7,
-                 daisy_h: int = 8, pixel_border: int = 16, stride: int = 4,
-                 patch_size: int = 24):
+                 daisy_h: int = 8, pixel_border: int = 16, stride: int = 4):
         self.daisy_t = daisy_t
         self.daisy_q = daisy_q
         self.daisy_r = daisy_r
         self.daisy_h = daisy_h
         self.pixel_border = pixel_border
         self.stride = stride
-        self.patch_size = patch_size
 
     @property
     def feature_size(self) -> int:

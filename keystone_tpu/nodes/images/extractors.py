@@ -9,7 +9,6 @@ instead of JNI calls.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

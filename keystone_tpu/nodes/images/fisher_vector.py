@@ -98,16 +98,11 @@ class ScalaGMMFisherVectorEstimator(Estimator):
         return FisherVector(_gmm_from_columns(ds, self.k))
 
 
-class EncEvalGMMFisherVectorEstimator(Estimator):
+class EncEvalGMMFisherVectorEstimator(ScalaGMMFisherVectorEstimator):
     """Counterpart of the reference's native enceval estimator
     (``external/FisherVector.scala:17-55``): same GMM fit, same FV math —
-    on TPU the jitted GEMM formulation IS the fast native path."""
-
-    def __init__(self, k: int):
-        self.k = k
-
-    def _fit(self, ds: Dataset) -> FisherVector:
-        return FisherVector(_gmm_from_columns(ds, self.k))
+    on TPU the jitted GEMM formulation IS the fast native path, so this
+    is the scala variant under the reference's native name."""
 
 
 class GMMFisherVectorEstimator(OptimizableEstimator):

@@ -17,7 +17,7 @@ import numpy as np
 
 from ...ops import linalg
 from ...ops.lbfgs import lbfgs
-from ...parallel.dataset import ArrayDataset, Dataset
+from ...parallel.dataset import ensure_array, ArrayDataset, Dataset
 from ...workflow.label_estimator import LabelEstimator
 from ..stats import StandardScalerModel
 from .linear import LinearMapper
@@ -47,7 +47,7 @@ class DenseLBFGSwithL2(LabelEstimator):
         return self.num_iterations + 1
 
     def _fit(self, ds: Dataset, labels: Dataset) -> LinearMapper:
-        assert isinstance(ds, ArrayDataset) and isinstance(labels, ArrayDataset)
+        ds, labels = ensure_array(ds), ensure_array(labels)
         n = ds.n
         X, Y = ds.data, labels.data
         mask = ds.mask

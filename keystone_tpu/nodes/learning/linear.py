@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...ops import linalg
-from ...parallel.dataset import ArrayDataset, Dataset
+from ...parallel.dataset import ensure_array, ArrayDataset, Dataset
 from ...workflow.label_estimator import LabelEstimator
 from ...workflow.transformer import Transformer
 from ..stats import StandardScalerModel
@@ -54,7 +54,7 @@ class LinearMapEstimator(LabelEstimator):
         self.lam = lam
 
     def _fit(self, ds: Dataset, labels: Dataset) -> LinearMapper:
-        assert isinstance(ds, ArrayDataset) and isinstance(labels, ArrayDataset)
+        ds, labels = ensure_array(ds), ensure_array(labels)
         n = ds.n
         X, Y = ds.data, labels.data
         x_mean = np.asarray(linalg.distributed_mean(X, n))
@@ -148,7 +148,7 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         return 3 * self.num_iter + 1
 
     def _fit(self, ds: Dataset, labels: Dataset) -> BlockLinearMapper:
-        assert isinstance(ds, ArrayDataset) and isinstance(labels, ArrayDataset)
+        ds, labels = ensure_array(ds), ensure_array(labels)
         n, d = ds.n, ds.data.shape[1]
         k = labels.data.shape[1]
         bs = self.block_size

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...parallel.dataset import ArrayDataset, Dataset
+from ...parallel.dataset import ensure_array, ArrayDataset, Dataset
 from ...workflow.label_estimator import LabelEstimator
 from .linear import BlockLinearMapper
 
@@ -43,7 +43,7 @@ class PerClassWeightedLeastSquaresEstimator(LabelEstimator):
         self.num_features = num_features
 
     def _fit(self, ds: Dataset, labels: Dataset) -> BlockLinearMapper:
-        assert isinstance(ds, ArrayDataset) and isinstance(labels, ArrayDataset)
+        ds, labels = ensure_array(ds), ensure_array(labels)
         X = np.asarray(ds.numpy(), np.float32)
         L = np.asarray(labels.numpy(), np.float32)
         return self.fit_arrays(X, L)

@@ -152,3 +152,26 @@ from .sparse import (  # noqa: E402
     Sparsify,
     sparse_batch,
 )
+
+
+class LabelAugmenter(Transformer):
+    """Repeat each item ``mult`` times, item-major — aligns labels/ids
+    with patch-augmented data (reference
+    ``RandomPatchCifarAugmented.LabelAugmenter``)."""
+
+    def __init__(self, mult: int):
+        self.mult = mult
+
+    def apply(self, x):
+        return x
+
+    def apply_dataset(self, ds: Dataset) -> Dataset:
+        if isinstance(ds, ArrayDataset):
+            arr = ds.numpy()
+            rep = jax.tree_util.tree_map(
+                lambda x: np.repeat(x, self.mult, axis=0), arr)
+            return ArrayDataset.from_numpy(rep)
+        from ...parallel.dataset import HostDataset
+
+        return HostDataset(
+            [it for it in ds.collect() for _ in range(self.mult)])

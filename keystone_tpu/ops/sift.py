@@ -167,6 +167,15 @@ def _normalize_quantize(desc: jax.Array) -> jax.Array:
     return jnp.minimum(512.0 * d, 255.0)
 
 
+def _scale_params(scale: int, step: int, bin_size: int, num_scales: int,
+                  scale_step: int) -> Tuple[int, int, int]:
+    """(step, bin size, lower bound) at one scale — the per-scale setup of
+    ``getMultiScaleDSIFTs_f`` (VLFeat.cxx)."""
+    scale_value = bin_size + 2 * scale
+    lo = max((1 + num_scales * 2) - scale * 3, 0)
+    return step + scale * scale_step, scale_value, lo
+
+
 def dense_sift(
     img_gray: jax.Array,
     step: int = 4,
@@ -183,11 +192,10 @@ def dense_sift(
     height, width = int(img_gray.shape[0]), int(img_gray.shape[1])
     outs: List[jax.Array] = []
     for scale in range(num_scales):
-        scale_value = bin_size + 2 * scale
-        lo = max((1 + num_scales * 2) - scale * 3, 0)
+        s, scale_value, lo = _scale_params(
+            scale, step, bin_size, num_scales, scale_step)
         desc = _dsift_one_scale(
-            img_gray, height, width,
-            step + scale * scale_step, scale_value, lo)
+            img_gray, height, width, s, scale_value, lo)
         outs.append(_normalize_quantize(desc))
     return jnp.concatenate(outs, axis=0).T  # (128, N)
 
@@ -200,10 +208,9 @@ def sift_descriptor_count(
     """Static descriptor count for shape planning (padding/bucketing)."""
     total = 0
     for scale in range(num_scales):
-        scale_value = bin_size + 2 * scale
-        lo = max((1 + num_scales * 2) - scale * 3, 0)
+        s, scale_value, lo = _scale_params(
+            scale, step, bin_size, num_scales, scale_step)
         extent = scale_value * NBP
-        s = step + scale * scale_step
         ys = _keypoint_grid(height, lo, height - 1, s, extent)
         xs = _keypoint_grid(width, lo, width - 1, s, extent)
         total += len(ys) * len(xs)

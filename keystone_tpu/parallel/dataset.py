@@ -228,3 +228,13 @@ def to_numpy(x: Any, dtype=None) -> np.ndarray:
     else:
         out = np.asarray(x)
     return out.astype(dtype) if dtype is not None else out
+
+
+def ensure_array(ds: "Dataset", mesh: Optional[Mesh] = None) -> "ArrayDataset":
+    """Promote a host dataset of fixed-shape items to a mesh-sharded
+    ArrayDataset (no-op if already one). The implicit host->device
+    boundary hit by solvers fed from ragged host pipelines."""
+    if isinstance(ds, ArrayDataset):
+        return ds
+    assert isinstance(ds, HostDataset), type(ds)
+    return ds.to_device(mesh)

@@ -1,0 +1,110 @@
+"""RandomCifar (reference
+``pipelines/images/cifar/RandomCifar.scala:21-110``): unwhitened Gaussian
+random conv filters -> SymmetricRectifier -> Pooler(sum) -> vectorize ->
+StandardScaler -> exact least squares (LinearMapEstimator) ->
+MaxClassifier.
+"""
+from __future__ import annotations
+
+import argparse
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ....evaluation.multiclass import evaluate_multiclass
+from ....loaders.cifar_loader import cifar_loader
+from ....loaders.csv_loader import LabeledData
+from ....nodes.images.core import (
+    Convolver,
+    ImageVectorizer,
+    Pooler,
+    SymmetricRectifier,
+)
+from ....nodes.learning import LinearMapEstimator
+from ....nodes.stats import StandardScaler
+from ....nodes.util import ClassLabelIndicatorsFromIntLabels, MaxClassifier
+from ....workflow.common import Cacher
+
+NUM_CLASSES = 10
+IMAGE_SIZE = 32
+NUM_CHANNELS = 3
+
+
+@dataclass
+class RandomCifarConfig:
+    train_location: str = ""
+    test_location: str = ""
+    num_filters: int = 100
+    patch_size: int = 6
+    pool_size: int = 14
+    pool_stride: int = 13
+    alpha: float = 0.25
+    lam: Optional[float] = None
+    seed: int = 0
+
+
+def run(config: RandomCifarConfig, train: Optional[LabeledData] = None,
+        test: Optional[LabeledData] = None):
+    """Returns (pipeline, train_metrics, test_metrics)."""
+    start = time.time()
+    if train is None:
+        train = cifar_loader(config.train_location)
+    if test is None:
+        test = cifar_loader(config.test_location)
+
+    train_labels = (
+        ClassLabelIndicatorsFromIntLabels(NUM_CLASSES) >> Cacher("labels")
+    )(train.labels)
+
+    rng = np.random.RandomState(config.seed)
+    filters = rng.randn(
+        config.num_filters,
+        config.patch_size * config.patch_size * NUM_CHANNELS,
+    ).astype(np.float32)
+
+    featurizer = (
+        Convolver(filters, IMAGE_SIZE, IMAGE_SIZE, NUM_CHANNELS,
+                  whitener=None, normalize_patches=True)
+        >> SymmetricRectifier(alpha=config.alpha)
+        >> Pooler(config.pool_stride, config.pool_size, "identity", "sum")
+        >> ImageVectorizer()
+        >> Cacher()
+    )
+    pipeline = (
+        featurizer.and_then(StandardScaler(), train.data)
+        >> Cacher()
+    ).and_then(
+        LinearMapEstimator(config.lam), train.data, train_labels
+    ) >> MaxClassifier()
+
+    train_eval = evaluate_multiclass(
+        pipeline(train.data), train.labels, NUM_CLASSES)
+    test_eval = evaluate_multiclass(
+        pipeline(test.data), test.labels, NUM_CLASSES)
+    print(f"Training error is: {train_eval.total_error:.4f}")
+    print(f"Test error is: {test_eval.total_error:.4f}")
+    print(f"Pipeline took {time.time() - start:.1f} s")
+    return pipeline, train_eval, test_eval
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("RandomCifar")
+    p.add_argument("--trainLocation", required=True)
+    p.add_argument("--testLocation", required=True)
+    p.add_argument("--numFilters", type=int, default=100)
+    p.add_argument("--patchSize", type=int, default=6)
+    p.add_argument("--poolSize", type=int, default=14)
+    p.add_argument("--poolStride", type=int, default=13)
+    p.add_argument("--alpha", type=float, default=0.25)
+    p.add_argument("--lambda", dest="lam", type=float, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    a = p.parse_args(argv)
+    run(RandomCifarConfig(
+        a.trainLocation, a.testLocation, a.numFilters, a.patchSize,
+        a.poolSize, a.poolStride, a.alpha, a.lam, a.seed))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,133 @@
+"""RandomPatchCifarAugmented (reference
+``pipelines/images/cifar/RandomPatchCifarAugmented.scala:25-154``):
+RandomPatchCifar plus train-time augmentation (random 24x24 crops +
+random horizontal flips, labels repeated to match) and test-time
+augmentation (center/corner crops with flips, predictions grouped per
+source image by the AugmentedExamplesEvaluator).
+"""
+from __future__ import annotations
+
+import argparse
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ....evaluation.augmented import AVERAGE_POLICY, evaluate_augmented
+from ....loaders.cifar_loader import cifar_loader
+from ....loaders.csv_loader import LabeledData
+from ....nodes.images.core import (
+    CenterCornerPatcher,
+    Convolver,
+    ImageVectorizer,
+    Pooler,
+    RandomFlipper,
+    RandomPatcher,
+    SymmetricRectifier,
+)
+from ....nodes.learning import BlockLeastSquaresEstimator
+from ....nodes.stats import StandardScaler
+from ....nodes.util import (
+    ClassLabelIndicatorsFromIntLabels,
+    LabelAugmenter,
+)
+from ....workflow.common import Cacher
+from .random_patch_cifar import RandomCifarConfig, learn_filters
+
+NUM_CLASSES = 10
+NUM_CHANNELS = 3
+AUGMENT_IMG_SIZE = 24
+FLIP_CHANCE = 0.5
+
+
+@dataclass
+class AugmentedConfig(RandomCifarConfig):
+    num_random_patches_augment: int = 10
+    pool_size: int = 14
+    pool_stride: int = 13
+
+
+def run(config: AugmentedConfig, train: Optional[LabeledData] = None,
+        test: Optional[LabeledData] = None):
+    """Returns (pipeline, test_metrics)."""
+    start = time.time()
+    if train is None:
+        train = cifar_loader(config.train_location)
+    if test is None:
+        test = cifar_loader(config.test_location)
+
+    filters, whitener = learn_filters(train.data, config)
+
+    # train-time augmentation (reference :65-77)
+    augment = RandomPatcher(
+        config.num_random_patches_augment, AUGMENT_IMG_SIZE,
+        AUGMENT_IMG_SIZE, seed=config.seed)
+    train_images_aug = RandomFlipper(
+        FLIP_CHANCE, seed=config.seed).apply_dataset(
+            augment.apply_dataset(train.data))
+    train_labels_aug = (
+        ClassLabelIndicatorsFromIntLabels(NUM_CLASSES)
+        >> LabelAugmenter(config.num_random_patches_augment)
+    )(train.labels)
+
+    featurizer = (
+        Convolver(filters, AUGMENT_IMG_SIZE, AUGMENT_IMG_SIZE, NUM_CHANNELS,
+                  whitener=whitener, normalize_patches=True)
+        >> SymmetricRectifier(alpha=config.alpha)
+        >> Pooler(config.pool_stride, config.pool_size, "identity", "sum")
+        >> ImageVectorizer()
+        >> Cacher("features")
+    )
+    pipeline = featurizer.and_then(
+        StandardScaler(), train_images_aug
+    ).and_then(
+        BlockLeastSquaresEstimator(4096, 1, config.lam),
+        train_images_aug,
+        train_labels_aug,
+    ) >> Cacher()
+
+    # test-time augmentation: 4 corners + center, with flips (reference
+    # :105-125); group per source image and average
+    patcher = CenterCornerPatcher(
+        AUGMENT_IMG_SIZE, AUGMENT_IMG_SIZE, horizontal_flips=True)
+    n_aug = patcher.patches_per_image
+    test_images_aug = patcher.apply_dataset(test.data)
+    test_ids_aug = np.repeat(np.arange(len(test.data)), n_aug)
+    test_labels_aug = np.repeat(
+        np.asarray(test.labels.numpy()).ravel(), n_aug)
+
+    preds = pipeline(test_images_aug).get()
+    test_eval = evaluate_augmented(
+        test_ids_aug, preds, test_labels_aug, NUM_CLASSES, AVERAGE_POLICY)
+    print(f"Test error is: {test_eval.total_error:.4f}")
+    print(f"Pipeline took {time.time() - start:.1f} s")
+    return pipeline, test_eval
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("RandomPatchCifarAugmented")
+    p.add_argument("--trainLocation", required=True)
+    p.add_argument("--testLocation", required=True)
+    p.add_argument("--numFilters", type=int, default=100)
+    p.add_argument("--whiteningEpsilon", type=float, default=0.1)
+    p.add_argument("--patchSize", type=int, default=6)
+    p.add_argument("--patchSteps", type=int, default=1)
+    p.add_argument("--poolSize", type=int, default=14)
+    p.add_argument("--poolStride", type=int, default=13)
+    p.add_argument("--alpha", type=float, default=0.25)
+    p.add_argument("--lambda", dest="lam", type=float, default=0.0)
+    p.add_argument("--numRandomPatchesAugment", type=int, default=10)
+    p.add_argument("--seed", type=int, default=0)
+    a = p.parse_args(argv)
+    run(AugmentedConfig(
+        train_location=a.trainLocation, test_location=a.testLocation,
+        num_filters=a.numFilters, whitening_epsilon=a.whiteningEpsilon,
+        patch_size=a.patchSize, patch_steps=a.patchSteps,
+        pool_size=a.poolSize, pool_stride=a.poolStride, alpha=a.alpha,
+        lam=a.lam, num_random_patches_augment=a.numRandomPatchesAugment,
+        seed=a.seed))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,151 @@
+"""ImageNetSiftLcsFV (reference
+``pipelines/images/imagenet/ImageNetSiftLcsFV.scala:29-228``):
+two feature branches — SIFT (PixelScaler -> GrayScaler -> SIFT ->
+BatchSignedHellinger) and LCS — each: ColumnSampler -> ColumnPCA ->
+GMM Fisher vector -> FloatToDouble -> MatrixVectorizer -> NormalizeRows
+-> SignedHellinger -> NormalizeRows; gathered, combined, solved with
+BlockWeightedLeastSquares(4096, 1, lambda=6e-5, mixtureWeight=0.25) and
+evaluated with top-5 error over 1000 classes.
+"""
+from __future__ import annotations
+
+import argparse
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ....loaders.imagenet import NUM_CLASSES, imagenet_loader
+from ....nodes.images.core import GrayScaler, PixelScaler
+from ....nodes.images.extractors import LCSExtractor, SIFTExtractor
+from ....nodes.images.fisher_vector import GMMFisherVectorEstimator
+from ....nodes.learning import ColumnPCAEstimator
+from ....nodes.learning.block_weighted import (
+    BlockWeightedLeastSquaresEstimator,
+)
+from ....nodes.stats import (
+    BatchSignedHellingerMapper,
+    NormalizeRows,
+    SignedHellingerMapper,
+)
+from ....nodes.stats.sampling import ColumnSampler
+from ....nodes.util import (
+    ClassLabelIndicatorsFromIntLabels,
+    FloatToDouble,
+    MatrixVectorizer,
+    TopKClassifier,
+    VectorCombiner,
+)
+from ....parallel.dataset import ArrayDataset, Dataset, HostDataset, to_numpy
+from ....workflow.common import Cacher
+from ....workflow.pipeline import Pipeline
+
+
+@dataclass
+class ImageNetSiftLcsFVConfig:
+    train_location: str = ""
+    test_location: str = ""
+    label_path: str = ""
+    lam: float = 6e-5
+    mixture_weight: float = 0.25
+    desc_dim: int = 64
+    vocab_size: int = 16
+    sift_scale_step: int = 1
+    lcs_stride: int = 4
+    lcs_border: int = 16
+    lcs_patch: int = 6
+    num_pca_samples: int = 10_000_000
+    num_gmm_samples: int = 10_000_000
+    block_size: int = 4096
+
+
+def compute_pca_fisher_branch(prefix: Pipeline, training_data: Dataset,
+                              config: ImageNetSiftLcsFVConfig,
+                              pca_samples: int, gmm_samples: int) -> Pipeline:
+    """The shared per-branch featurization suffix (reference
+    ``ImageNetSiftLcsFV.scala:29-80``)."""
+    pca_sample = (prefix >> ColumnSampler(pca_samples) >> Cacher())(
+        training_data)
+    pca_branch = prefix.and_then(
+        ColumnPCAEstimator(config.desc_dim).with_data(pca_sample))
+
+    gmm_sample = (pca_branch >> ColumnSampler(gmm_samples))(training_data)
+    return pca_branch.and_then(
+        GMMFisherVectorEstimator(config.vocab_size).with_data(gmm_sample)
+    ) >> FloatToDouble() >> MatrixVectorizer() >> NormalizeRows() \
+        >> SignedHellingerMapper() >> NormalizeRows()
+
+
+def run(config: ImageNetSiftLcsFVConfig, train=None, test=None,
+        num_classes: int = NUM_CLASSES, top_k: int = 5,
+        sift_kwargs: Optional[dict] = None):
+    """Returns (pipeline, test top-k error)."""
+    start = time.time()
+    if train is None:
+        train = imagenet_loader(config.train_location, config.label_path)
+    if test is None:
+        test = imagenet_loader(config.test_location, config.label_path)
+
+    train_items = train.collect()
+    training_data = HostDataset([it.image for it in train_items])
+    train_labels = np.asarray([it.label for it in train_items], np.int32)
+    n_train = max(len(training_data), 1)
+    pca_per_img = max(config.num_pca_samples // n_train, 1)
+    gmm_per_img = max(config.num_gmm_samples // n_train, 1)
+
+    labels = ClassLabelIndicatorsFromIntLabels(num_classes).apply_dataset(
+        ArrayDataset.from_numpy(train_labels))
+
+    sift_prefix = (
+        PixelScaler() >> GrayScaler()
+        >> SIFTExtractor(scale_step=config.sift_scale_step,
+                         **(sift_kwargs or {}))
+        >> BatchSignedHellingerMapper()
+    )
+    lcs_prefix = Pipeline.identity() >> LCSExtractor(
+        config.lcs_stride, config.lcs_border, config.lcs_patch)
+
+    sift_branch = compute_pca_fisher_branch(
+        sift_prefix, training_data, config, pca_per_img, gmm_per_img)
+    lcs_branch = compute_pca_fisher_branch(
+        lcs_prefix, training_data, config, pca_per_img, gmm_per_img)
+
+    featurizer = Pipeline.gather([sift_branch, lcs_branch]) \
+        >> VectorCombiner() >> Cacher()
+
+    predictor = featurizer.and_then(
+        BlockWeightedLeastSquaresEstimator(
+            config.block_size, 1, config.lam, config.mixture_weight),
+        training_data,
+        labels,
+    ) >> TopKClassifier(top_k)
+
+    test_items = test.collect()
+    test_data = HostDataset([it.image for it in test_items])
+    test_labels = np.asarray([it.label for it in test_items], np.int64)
+    topk = to_numpy(predictor(test_data))
+    hits = np.any(topk == test_labels[:, None], axis=1)
+    err = 100.0 * (1.0 - hits.mean())
+    print(f"TEST top-{top_k} error is {err:.2f}%")
+    print(f"Pipeline took {time.time() - start:.1f} s")
+    return predictor, err
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("ImageNetSiftLcsFV")
+    p.add_argument("--trainLocation", required=True)
+    p.add_argument("--testLocation", required=True)
+    p.add_argument("--labelPath", required=True)
+    p.add_argument("--lambda", dest="lam", type=float, default=6e-5)
+    p.add_argument("--mixtureWeight", type=float, default=0.25)
+    p.add_argument("--descDim", type=int, default=64)
+    p.add_argument("--vocabSize", type=int, default=16)
+    a = p.parse_args(argv)
+    run(ImageNetSiftLcsFVConfig(
+        a.trainLocation, a.testLocation, a.labelPath, a.lam,
+        a.mixtureWeight, a.descDim, a.vocabSize))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,134 @@
+"""VOCSIFTFisher (reference
+``pipelines/images/voc/VOCSIFTFisher.scala:29-159``):
+PixelScaler -> GrayScaler -> SIFT -> [sampled ColumnPCA] -> [sampled GMM
+Fisher vector] -> FloatToDouble -> MatrixVectorizer -> NormalizeRows ->
+SignedHellinger -> NormalizeRows -> BlockLeastSquares(4096, 1, lambda) ->
+mean-average-precision evaluation over the 20 VOC classes.
+"""
+from __future__ import annotations
+
+import argparse
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ....evaluation.mean_average_precision import (
+    evaluate_mean_average_precision,
+)
+from ....loaders.voc import NUM_CLASSES, VOCDataPath, VOCLabelPath, voc_loader
+from ....nodes.images.core import GrayScaler, PixelScaler
+from ....nodes.images.extractors import SIFTExtractor
+from ....nodes.images.fisher_vector import GMMFisherVectorEstimator
+from ....nodes.images.multilabel import (
+    MultiLabeledImageExtractor,
+    MultiLabelExtractor,
+)
+from ....nodes.learning import BlockLeastSquaresEstimator, ColumnPCAEstimator
+from ....nodes.stats import NormalizeRows, SignedHellingerMapper
+from ....nodes.stats.sampling import ColumnSampler
+from ....nodes.util import (
+    ClassLabelIndicatorsFromIntArrayLabels,
+    FloatToDouble,
+    MatrixVectorizer,
+)
+from ....parallel.dataset import Dataset
+from ....workflow.common import Cacher
+
+
+@dataclass
+class SIFTFisherConfig:
+    train_location: str = ""
+    test_location: str = ""
+    label_path: str = ""
+    lam: float = 0.5
+    desc_dim: int = 80
+    vocab_size: int = 256
+    scale_step: int = 0
+    num_pca_samples: int = 1_000_000
+    num_gmm_samples: int = 1_000_000
+    block_size: int = 4096
+
+
+def run(config: SIFTFisherConfig, train: Optional[Dataset] = None,
+        test: Optional[Dataset] = None,
+        sift_kwargs: Optional[dict] = None):
+    """Returns (pipeline, per-class AP array)."""
+    start = time.time()
+    if train is None:
+        train = voc_loader(
+            VOCDataPath(config.train_location, "VOCdevkit/VOC2007/JPEGImages/"),
+            VOCLabelPath(config.label_path))
+    if test is None:
+        test = voc_loader(
+            VOCDataPath(config.test_location, "VOCdevkit/VOC2007/JPEGImages/"),
+            VOCLabelPath(config.label_path))
+
+    label_grabber = (
+        MultiLabelExtractor()
+        >> ClassLabelIndicatorsFromIntArrayLabels(NUM_CLASSES)
+        >> Cacher()
+    )
+    training_labels = label_grabber(train).get()
+    training_data = MultiLabeledImageExtractor().apply_dataset(train)
+    n_train = len(training_data)
+    pca_samples_per_image = max(config.num_pca_samples // max(n_train, 1), 1)
+    gmm_samples_per_image = max(config.num_gmm_samples // max(n_train, 1), 1)
+
+    sift = SIFTExtractor(scale_step=config.scale_step,
+                         **(sift_kwargs or {}))
+    sift_extractor = PixelScaler() >> GrayScaler() >> Cacher() >> sift
+
+    # fit PCA/GMM on sampled branches; the with_data pipeline applies the
+    # fitted transformer to the runtime path (the reference's
+    # ``pca.fittedTransformer`` composition, VOCSIFTFisher.scala:48-76)
+    pca_sample = (sift_extractor >> ColumnSampler(pca_samples_per_image))(
+        training_data)
+    pca_featurizer = sift_extractor.and_then(
+        ColumnPCAEstimator(config.desc_dim).with_data(pca_sample)
+    ) >> Cacher()
+
+    gmm_sample = (pca_featurizer >> ColumnSampler(gmm_samples_per_image))(
+        training_data)
+    fisher_featurizer = pca_featurizer.and_then(
+        GMMFisherVectorEstimator(config.vocab_size).with_data(gmm_sample)
+    ) >> FloatToDouble() >> MatrixVectorizer() >> NormalizeRows() \
+        >> SignedHellingerMapper() >> NormalizeRows() >> Cacher()
+
+    predictor = fisher_featurizer.and_then(
+        BlockLeastSquaresEstimator(config.block_size, 1, config.lam),
+        training_data,
+        training_labels,
+    )
+
+    test_data = MultiLabeledImageExtractor().apply_dataset(test)
+    test_actuals = [it.labels for it in test.collect()]
+    predictions = predictor(test_data).get()
+    ap = evaluate_mean_average_precision(
+        test_actuals, predictions, NUM_CLASSES)
+    print(f"TEST APs are: {','.join(str(a) for a in ap)}")
+    print(f"TEST MAP is: {float(np.mean(ap))}")
+    print(f"Pipeline took {time.time() - start:.1f} s")
+    return predictor, ap
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("VOCSIFTFisher")
+    p.add_argument("--trainLocation", required=True)
+    p.add_argument("--testLocation", required=True)
+    p.add_argument("--labelPath", required=True)
+    p.add_argument("--lambda", dest="lam", type=float, default=0.5)
+    p.add_argument("--descDim", type=int, default=80)
+    p.add_argument("--vocabSize", type=int, default=256)
+    p.add_argument("--scaleStep", type=int, default=0)
+    p.add_argument("--numPcaSamples", type=int, default=1_000_000)
+    p.add_argument("--numGmmSamples", type=int, default=1_000_000)
+    a = p.parse_args(argv)
+    run(SIFTFisherConfig(
+        a.trainLocation, a.testLocation, a.labelPath, a.lam, a.descDim,
+        a.vocabSize, a.scaleStep, a.numPcaSamples, a.numGmmSamples))
+
+
+if __name__ == "__main__":
+    main()

@@ -234,6 +234,14 @@ class Graph:
         return tuple(order)
 
     # -- export (Graph.scala:436-455) -------------------------------------
+    def source_descendants(self) -> FrozenSet[GraphId]:
+        """Every id reachable from any (unconnected/runtime) source."""
+        out: set = set()
+        for s in self.sources:
+            out.add(s)
+            out |= self.get_descendants(s)
+        return frozenset(out)
+
     def to_dot(self, title: str = "pipeline") -> str:
         lines = [f'digraph "{title}" {{', "  rankdir=LR;"]
         for s in sorted(self.sources, key=lambda g: g.id):

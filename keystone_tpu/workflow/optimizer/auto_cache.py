@@ -171,10 +171,7 @@ def profile_graph(
 
     shards = num_data_shards(get_mesh())
     samples_by_node: Dict[NodeId, List[SampleProfile]] = {}
-    unexec: set = set()
-    for s in graph.sources:
-        unexec.add(s)
-        unexec |= graph.get_descendants(s)
+    unexec = graph.source_descendants()
 
     for scale in scales:
         items = int(scale) * shards
@@ -287,9 +284,7 @@ class AutoCacheRule(Rule):
     def _aggressive(self, graph: Graph) -> Graph:
         children = _children_with_multiplicity(graph)
         weights = {n: node_weight(graph.get_operator(n)) for n in graph.nodes}
-        downstream_of_source: set = set()
-        for s in graph.sources:
-            downstream_of_source |= graph.get_descendants(s)
+        downstream_of_source = graph.source_descendants()
         to_cache = frozenset(
             n for n in graph.nodes
             if sum(weights[c] for c in children[n]
@@ -303,9 +298,7 @@ class AutoCacheRule(Rule):
         weights = {n: node_weight(graph.get_operator(n)) for n in graph.nodes}
         cached = set(init_cache_set(graph))
         # per-input runtime nodes can never be reused across inputs
-        downstream_of_source: set = set()
-        for s_ in graph.sources:
-            downstream_of_source |= graph.get_descendants(s_)
+        downstream_of_source = graph.source_descendants()
         budget = self.max_mem if self.max_mem is not None else _device_mem_budget()
 
         def used() -> float:

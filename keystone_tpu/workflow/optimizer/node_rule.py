@@ -132,10 +132,7 @@ class NodeOptimizationRule(Rule):
     # -- rule entry -------------------------------------------------------
     def apply(self, graph: Graph) -> Graph:
         # ids reachable from unconnected (runtime) sources can't be sampled
-        downstream: set = set()
-        for s in graph.sources:
-            downstream.add(s)
-            downstream |= graph.get_descendants(s)
+        downstream = graph.source_descendants()
 
         machines = self.num_machines or num_data_shards(get_mesh())
         for node in graph.linearize():

@@ -1,0 +1,119 @@
+"""End-to-end application pipeline tests on tiny synthetic datasets
+(the reference's apps are its integration tests; these are scaled-down
+versions exercising every pipeline's full DAG)."""
+import numpy as np
+
+from keystone_tpu.loaders.csv_loader import LabeledData
+from keystone_tpu.loaders.image_loader_utils import (
+    LabeledImage,
+    MultiLabeledImage,
+)
+from keystone_tpu.loaders.timit import TimitFeaturesData
+from keystone_tpu.parallel.dataset import ArrayDataset, HostDataset
+
+
+def _cifar_like(n=48, size=32, seed=0):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n).astype(np.int32)
+    imgs = rng.rand(n, size, size, 3).astype(np.float32) * 50
+    # make classes separable: add label-dependent mean shift
+    imgs += labels[:, None, None, None] * 12.0
+    return LabeledData(
+        data=ArrayDataset.from_numpy(imgs),
+        labels=ArrayDataset.from_numpy(labels),
+    )
+
+
+def test_timit_pipeline(mesh8):
+    from keystone_tpu.pipelines.speech.timit import TimitConfig, run
+
+    rng = np.random.RandomState(0)
+    n, d, k = 64, 20, 4
+    X = rng.randn(n, d).astype(np.float32)
+    y = rng.randint(0, k, n).astype(np.int32)
+    X += y[:, None] * 2.0  # separable
+    data = TimitFeaturesData(
+        train=LabeledData(ArrayDataset.from_numpy(X),
+                          ArrayDataset.from_numpy(y)),
+        test=LabeledData(ArrayDataset.from_numpy(X),
+                         ArrayDataset.from_numpy(y)),
+    )
+    cfg = TimitConfig(num_cosines=3, num_epochs=2, lam=0.01)
+    cfg.num_cosine_features = 64
+    _, metrics = run(cfg, data=data, num_classes=k, input_dim=d)
+    assert metrics.total_error < 0.2
+
+
+def test_random_cifar_pipeline(mesh8):
+    from keystone_tpu.pipelines.images.cifar.random_cifar import (
+        RandomCifarConfig,
+        run,
+    )
+
+    data = _cifar_like(n=40)
+    cfg = RandomCifarConfig(num_filters=8, lam=0.01)
+    _, train_eval, test_eval = run(cfg, train=data, test=data)
+    assert train_eval.total_error <= 0.2
+
+
+def test_random_patch_cifar_augmented(mesh8):
+    from keystone_tpu.pipelines.images.cifar.random_patch_cifar_augmented import (
+        AugmentedConfig,
+        run,
+    )
+
+    data = _cifar_like(n=24)
+    cfg = AugmentedConfig(
+        num_filters=8, lam=0.01, num_random_patches_augment=2)
+    _, test_eval = run(cfg, train=data, test=data)
+    assert test_eval.total_error <= 0.7  # well below the 0.9 random baseline
+
+
+def _toy_images(n, seed=0, size=56):
+    rng = np.random.RandomState(seed)
+    imgs = []
+    for i in range(n):
+        img = rng.rand(size, size, 3).astype(np.float32) * 255
+        imgs.append(img)
+    return imgs
+
+
+def test_voc_sift_fisher_pipeline(mesh8):
+    from keystone_tpu.pipelines.images.voc.voc_sift_fisher import (
+        SIFTFisherConfig,
+        run,
+    )
+
+    rng = np.random.RandomState(0)
+    imgs = _toy_images(8)
+    train = HostDataset([
+        MultiLabeledImage(img, [int(i % 3)], f"im{i}.jpg")
+        for i, img in enumerate(imgs)
+    ])
+    cfg = SIFTFisherConfig(
+        lam=0.5, desc_dim=8, vocab_size=2,
+        num_pca_samples=400, num_gmm_samples=400, block_size=256)
+    _, ap = run(cfg, train=train, test=train,
+                sift_kwargs=dict(step=12, num_scales=2))
+    assert ap.shape == (20,)
+    assert np.all(np.isfinite(ap))
+
+
+def test_imagenet_sift_lcs_fv_pipeline(mesh8):
+    from keystone_tpu.pipelines.images.imagenet.sift_lcs_fv import (
+        ImageNetSiftLcsFVConfig,
+        run,
+    )
+
+    imgs = _toy_images(8, size=56)
+    train = HostDataset([
+        LabeledImage(img, int(i % 2), f"c{i%2}/im{i}.jpg")
+        for i, img in enumerate(imgs)
+    ])
+    cfg = ImageNetSiftLcsFVConfig(
+        lam=1e-3, mixture_weight=0.25, desc_dim=8, vocab_size=2,
+        lcs_stride=12, lcs_border=20,
+        num_pca_samples=400, num_gmm_samples=400, block_size=128)
+    _, err = run(cfg, train=train, test=train, num_classes=2, top_k=1,
+                 sift_kwargs=dict(step=12, num_scales=2))
+    assert np.isfinite(err)
