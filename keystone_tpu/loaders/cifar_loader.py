@@ -24,14 +24,16 @@ def load_cifar_numpy(path: str):
         files = sorted(glob.glob(os.path.join(path, "*.bin")))
     else:
         files = sorted(glob.glob(path)) or [path]
+    from ..native import cifar_decode
+
     imgs, labels = [], []
     for f in files:
-        raw = np.fromfile(f, dtype=np.uint8)
-        assert raw.size % RECORD == 0, f"corrupt CIFAR file {f}"
-        rec = raw.reshape(-1, RECORD)
-        labels.append(rec[:, 0].astype(np.int32))
-        planes = rec[:, 1:].reshape(-1, NCHAN, NROW, NCOL)
-        imgs.append(planes.transpose(0, 2, 3, 1).astype(np.float32))
+        with open(f, "rb") as fh:
+            raw = fh.read()
+        assert len(raw) % RECORD == 0, f"corrupt CIFAR file {f}"
+        i, l = cifar_decode(raw, NROW, NCOL, NCHAN)  # native when built
+        imgs.append(i)
+        labels.append(l)
     return np.concatenate(imgs), np.concatenate(labels)
 
 

@@ -125,6 +125,14 @@ class NGramsHashingTF(HostTransformer):
         return (NGramsHashingTF, self.orders, self.num_features)
 
     def apply(self, line: Sequence[str]) -> SparseVector:
+        from ...native import available, ngram_hash_features
+
+        if available():
+            feats = ngram_hash_features(
+                list(line), self.orders, self.num_features)
+            idx, counts = np.unique(feats, return_counts=True)
+            return SparseVector(idx, counts.astype(np.float32),
+                                self.num_features)
         lo, hi = min(self.orders), max(self.orders)
         hashes = [scala_hash(t) & _MASK for t in line]
         n = len(line)

@@ -73,13 +73,39 @@ def _normal_equations_jit(A, Y, lam):
     return ridge_cho_solve(gram(A), cross(A, Y), lam)
 
 
+@functools.partial(jax.jit, static_argnames=())
+def _normal_equations_pallas_jit(A, Y, lam):
+    from .pallas_kernels import gram_cross_pallas
+
+    G, C = gram_cross_pallas(A, Y)  # one fused pass over A
+    return ridge_cho_solve(G, C, lam)
+
+
+def _single_device_f32(*arrays) -> bool:
+    for a in arrays:
+        sharding = getattr(a, "sharding", None)
+        if sharding is not None and len(sharding.device_set) > 1:
+            return False  # row-sharded: keep the GEMM+psum einsum path
+        if getattr(a, "dtype", None) != jnp.float32:
+            return False  # pallas kernel computes in f32 only
+    return True
+
+
 def normal_equations(A: jax.Array, Y: jax.Array, lam: float = 0.0) -> jax.Array:
     """Least-squares / ridge via normal equations: W = (A^T A + lam I)^-1 A^T Y.
 
     Reference: mlmatrix ``NormalEquations`` used by
-    ``LinearMapEstimator`` (LinearMapper.scala:80-98).
+    ``LinearMapEstimator`` (LinearMapper.scala:80-98). On a single TPU
+    chip with f32 inputs the fused Pallas gram/cross kernel is used; a
+    mesh-sharded input keeps the local-GEMM + all-reduce einsum path
+    (pallas_call has no partitioning rule).
     """
-    return _normal_equations_jit(A, Y, jnp.asarray(lam, A.dtype))
+    from .pallas_kernels import use_pallas
+
+    lam_arr = jnp.asarray(lam, A.dtype)
+    if use_pallas() and _single_device_f32(A, Y):
+        return _normal_equations_pallas_jit(A, Y, lam_arr)
+    return _normal_equations_jit(A, Y, lam_arr)
 
 
 def local_least_squares_dual(A: jax.Array, Y: jax.Array, lam: float) -> jax.Array:

@@ -1,0 +1,113 @@
+"""Pallas TPU kernels for the solver hot path.
+
+The flagship solvers (normal equations, BCD) spend their FLOPs on two
+GEMMs over the same data: the Gram matrix X^T X and the cross-product
+X^T Y (SURVEY.md section 3.2 — the reference's per-partition Gram +
+treeReduce). As separate XLA ops each reads X from HBM once; the fused
+kernel streams each row-tile of X through VMEM exactly once and
+accumulates both products on the MXU — an HBM-bandwidth win when n is
+large (the usual case: n >> d).
+
+Grid: one dimension over row tiles; both outputs map to the same block
+every step, so the kernel zeroes them on the first step and accumulates
+(the standard Pallas reduction pattern). Row padding is zero-filled by
+the wrapper, so padded rows contribute nothing.
+
+Used automatically on TPU via :func:`gram_cross`; other backends fall
+back to two jnp matmuls (tests exercise the kernel in interpreter mode).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:  # pallas ships with jax; guard anyway for minimal builds
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    HAS_PALLAS = False
+
+ROW_TILE = 512
+_LANE = 128
+_SUBLANE = 8
+
+
+def _gram_cross_kernel(x_ref, y_ref, gram_ref, cross_ref):
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        gram_ref[:] = jnp.zeros_like(gram_ref)
+        cross_ref[:] = jnp.zeros_like(cross_ref)
+
+    x = x_ref[:]
+    gram_ref[:] += jax.lax.dot_general(
+        x, x, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    cross_ref[:] += jax.lax.dot_general(
+        x, y_ref[:], dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _pad_to(x: jax.Array, rows: int, cols: int) -> jax.Array:
+    pr, pc = rows - x.shape[0], cols - x.shape[1]
+    if pr == 0 and pc == 0:
+        return x
+    return jnp.pad(x, ((0, pr), (0, pc)))
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def gram_cross_pallas(X: jax.Array, Y: jax.Array,
+                      interpret: bool = False) -> Tuple[jax.Array, jax.Array]:
+    """(X^T X, X^T Y) in one pass over X. Pads to tile alignment
+    (lane = 128, sublane = 8 for f32) and slices back."""
+    n, d = X.shape
+    k = Y.shape[1]
+    dp = _round_up(max(d, _LANE), _LANE)
+    kp = _round_up(max(k, _LANE), _LANE)
+    tile = min(ROW_TILE, _round_up(max(n, _SUBLANE), _SUBLANE))
+    np_rows = _round_up(n, tile)
+    Xp = _pad_to(X.astype(jnp.float32), np_rows, dp)
+    Yp = _pad_to(Y.astype(jnp.float32), np_rows, kp)
+
+    grid = (np_rows // tile,)
+    gram, cross = pl.pallas_call(
+        _gram_cross_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile, dp), lambda i: (i, 0)),
+            pl.BlockSpec((tile, kp), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((dp, dp), lambda i: (0, 0)),
+            pl.BlockSpec((dp, kp), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((dp, dp), jnp.float32),
+            jax.ShapeDtypeStruct((dp, kp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(Xp, Yp)
+    return gram[:d, :d], cross[:d, :k]
+
+
+def use_pallas() -> bool:
+    return HAS_PALLAS and jax.default_backend() == "tpu"
+
+
+def gram_cross(X: jax.Array, Y: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Fused (X^T X, X^T Y): Pallas on TPU, two matmuls elsewhere."""
+    if use_pallas():
+        return gram_cross_pallas(X, Y)
+    Xt = X.T
+    return Xt @ X, Xt @ Y

@@ -1,0 +1,27 @@
+"""Pallas kernel tests (interpreter mode on CPU; the real-TPU path is
+exercised by bench.py and the driver's compile check)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from keystone_tpu.ops.pallas_kernels import gram_cross, gram_cross_pallas
+
+
+@pytest.mark.parametrize("n,d,k", [(100, 37, 5), (513, 128, 16), (7, 3, 2)])
+def test_gram_cross_pallas_interpret(n, d, k):
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, d).astype(np.float32)
+    Y = rng.randn(n, k).astype(np.float32)
+    g, c = gram_cross_pallas(jnp.asarray(X), jnp.asarray(Y), interpret=True)
+    np.testing.assert_allclose(np.asarray(g), X.T @ X, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(c), X.T @ Y, rtol=2e-4, atol=2e-4)
+
+
+def test_gram_cross_fallback_matches():
+    rng = np.random.RandomState(1)
+    X = rng.randn(64, 10).astype(np.float32)
+    Y = rng.randn(64, 3).astype(np.float32)
+    g, c = gram_cross(jnp.asarray(X), jnp.asarray(Y))  # cpu fallback path
+    np.testing.assert_allclose(np.asarray(g), X.T @ X, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(c), X.T @ Y, rtol=1e-4, atol=1e-4)
