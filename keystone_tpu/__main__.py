@@ -1,0 +1,48 @@
+"""Unified CLI entry (the analogue of the reference's
+``bin/run-pipeline.sh <class> --flags``, SURVEY.md section 2.13):
+
+    python -m keystone_tpu <app> [--flags]
+
+Run with no arguments to list the available applications.
+"""
+from __future__ import annotations
+
+import sys
+
+APPS = {
+    "mnist.random_fft": "keystone_tpu.pipelines.images.mnist.random_fft",
+    "cifar.linear_pixels": "keystone_tpu.pipelines.images.cifar.linear_pixels",
+    "cifar.random_cifar": "keystone_tpu.pipelines.images.cifar.random_cifar",
+    "cifar.random_patch": "keystone_tpu.pipelines.images.cifar.random_patch_cifar",
+    "cifar.random_patch_augmented":
+        "keystone_tpu.pipelines.images.cifar.random_patch_cifar_augmented",
+    "imagenet.sift_lcs_fv": "keystone_tpu.pipelines.images.imagenet.sift_lcs_fv",
+    "voc.sift_fisher": "keystone_tpu.pipelines.images.voc.voc_sift_fisher",
+    "speech.timit": "keystone_tpu.pipelines.speech.timit",
+    "text.newsgroups": "keystone_tpu.pipelines.text.newsgroups",
+    "text.amazon_reviews": "keystone_tpu.pipelines.text.amazon_reviews",
+    "nlp.stupid_backoff": "keystone_tpu.pipelines.nlp.stupid_backoff_pipeline",
+}
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help", "help"):
+        print("usage: python -m keystone_tpu <app> [--flags]\n\napps:")
+        for name in sorted(APPS):
+            print(f"  {name}")
+        return 0
+    app, rest = argv[0], argv[1:]
+    module = APPS.get(app)
+    if module is None:
+        print(f"unknown app '{app}'; run with no arguments to list apps",
+              file=sys.stderr)
+        return 2
+    import importlib
+
+    importlib.import_module(module).main(rest)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

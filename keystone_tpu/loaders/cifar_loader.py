@@ -40,6 +40,6 @@ def load_cifar_numpy(path: str):
 def cifar_loader(path: str) -> LabeledData:
     images, labels = load_cifar_numpy(path)
     return LabeledData(
-        data=ArrayDataset.from_numpy(images),
-        labels=ArrayDataset.from_numpy(labels),
+        data=ArrayDataset.from_numpy(images, tag=f"cifar:{path}:data"),
+        labels=ArrayDataset.from_numpy(labels, tag=f"cifar:{path}:labels"),
     )

@@ -63,9 +63,10 @@ class ArrayDataset(Dataset):
     """
 
     def __init__(self, data: Any, n: int, mesh: Optional[Mesh] = None,
-                 _already_sharded: bool = False):
+                 _already_sharded: bool = False, tag: Optional[str] = None):
         self.mesh = mesh or get_mesh()
         self.n = int(n)
+        self.tag = tag  # stable identity for cross-session prefix reuse
         if _already_sharded:
             self.data = data
         else:
@@ -73,12 +74,13 @@ class ArrayDataset(Dataset):
 
     # -- construction -----------------------------------------------------
     @staticmethod
-    def from_numpy(array: Any, mesh: Optional[Mesh] = None) -> "ArrayDataset":
+    def from_numpy(array: Any, mesh: Optional[Mesh] = None,
+                   tag: Optional[str] = None) -> "ArrayDataset":
         leaves = jax.tree_util.tree_leaves(array)
         if not leaves:
             raise ValueError("empty pytree")
         n = leaves[0].shape[0]
-        return ArrayDataset(array, n, mesh)
+        return ArrayDataset(array, n, mesh, tag=tag)
 
     @staticmethod
     def from_items(items: Sequence[Any], mesh: Optional[Mesh] = None) -> "ArrayDataset":
@@ -135,8 +137,9 @@ class ArrayDataset(Dataset):
 class HostDataset(Dataset):
     """Host-resident list-backed dataset for ragged / non-numeric stages."""
 
-    def __init__(self, items: Iterable[Any]):
+    def __init__(self, items: Iterable[Any], tag: Optional[str] = None):
         self.items = list(items)
+        self.tag = tag
 
     def map(self, fn: Callable[[Any], Any]) -> "HostDataset":
         return HostDataset([fn(x) for x in self.items])

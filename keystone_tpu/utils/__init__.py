@@ -1,0 +1,13 @@
+"""Utilities: checkpointing, profiling (reference ``utils/`` + SURVEY.md
+section 5 auxiliary subsystems)."""
+from .checkpoint import load_pipeline, load_state, save_pipeline, save_state
+from .profiling import StepTimer, trace
+
+__all__ = [
+    "load_pipeline",
+    "load_state",
+    "save_pipeline",
+    "save_state",
+    "StepTimer",
+    "trace",
+]

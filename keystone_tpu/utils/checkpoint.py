@@ -1,0 +1,67 @@
+"""Checkpoint / resume (reference SURVEY.md section 5):
+
+1. Fitted-pipeline export — the reference serializes ``FittedPipeline``
+   to disk (``graph/FittedPipeline.scala:10,22``); here
+   :func:`save_pipeline` / :func:`load_pipeline` pickle the transformer
+   graph (operators hold numpy parameters).
+2. Prefix-state export — the reference reuses computed estimator state
+   across pipelines in a session via the ``Prefix`` table
+   (``graph/PipelineEnv.scala:13``); :func:`save_state` /
+   :func:`load_state` persist the *fitted transformer* entries of that
+   table so a new session can warm-start. Cross-session hits require the
+   training datasets to carry stable ``tag``s (loaders tag by source
+   path); untagged datasets key on object identity and only hit within
+   the saving session.
+3. Model artifact CSVs — apps load precomputed PCA/GMM from CSV instead
+   of refitting (``GaussianMixtureModel.load``); those live on the model
+   classes themselves.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict
+
+from ..workflow.env import PipelineEnv
+from ..workflow.expression import TransformerExpression
+from ..workflow.pipeline import FittedPipeline
+
+
+def save_pipeline(pipeline: FittedPipeline, path: str) -> None:
+    with open(path, "wb") as f:
+        pickle.dump(pipeline, f)
+
+
+def load_pipeline(path: str) -> FittedPipeline:
+    with open(path, "rb") as f:
+        out = pickle.load(f)
+    assert isinstance(out, FittedPipeline), type(out)
+    return out
+
+
+def save_state(path: str) -> int:
+    """Persist the fitted-transformer entries of the global prefix table;
+    returns the number of entries saved. (Dataset-valued entries are
+    session-local device arrays and are not persisted.)"""
+    state = PipelineEnv.get_or_create().state
+    out: Dict[Any, Any] = {}
+    for prefix, expr in state.items():
+        if isinstance(expr, TransformerExpression) and expr.computed:
+            out[prefix] = expr.get()
+    with open(path, "wb") as f:
+        pickle.dump(out, f)
+    return len(out)
+
+
+def load_state(path: str) -> int:
+    """Merge persisted fitted transformers into the prefix table; returns
+    the number of entries loaded. Pipelines whose prefixes match skip
+    refitting (via SavedStateLoadRule)."""
+    with open(path, "rb") as f:
+        saved = pickle.load(f)
+    env = PipelineEnv.get_or_create()
+    for prefix, transformer in saved.items():
+        # wrap in a thunk: fitted transformers are themselves callable, so
+        # passing them directly would make Expression invoke them
+        env.state[prefix] = TransformerExpression(
+            lambda t=transformer: t)
+    return len(saved)

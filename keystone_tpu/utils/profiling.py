@@ -1,0 +1,53 @@
+"""Profiling hooks (reference SURVEY.md section 5: the reference relies on
+its AutoCacheRule profiler + Spark UI; the TPU analogues are the XLA
+profiler (xplane traces viewable in TensorBoard/XProf) and simple wall
+timing of jitted steps)."""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture an XLA profiler trace (xplane) for everything in scope."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Wall-clock step timing. ``timed(name, fn, ...)`` blocks on the
+    device result before reading the clock — the honest way to time
+    jitted programs. ``step(name)`` times the enclosed block as-is
+    (callers must block_until_ready inside if the block dispatches
+    async device work)."""
+
+    def __init__(self) -> None:
+        self.times: Dict[str, list] = {}
+
+    @contextlib.contextmanager
+    def step(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        yield
+        self.times.setdefault(name, []).append(time.perf_counter() - t0)
+
+    def timed(self, name: str, fn, *args, **kwargs):
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        out = jax.block_until_ready(out)
+        self.times.setdefault(name, []).append(time.perf_counter() - t0)
+        return out
+
+    def summary(self) -> str:
+        lines = []
+        for name, ts in self.times.items():
+            lines.append(
+                f"{name}: n={len(ts)} mean={sum(ts)/len(ts)*1e3:.2f}ms "
+                f"min={min(ts)*1e3:.2f}ms max={max(ts)*1e3:.2f}ms")
+        return "\n".join(lines)

@@ -88,6 +88,13 @@ class DatasetOperator(Operator):
         self.dataset = dataset
 
     def eq_key(self) -> Tuple:
+        # a loader-provided tag (e.g. the source path) gives the dataset a
+        # stable identity, so prefixes — and therefore saved fitted state —
+        # survive across sessions; untagged data falls back to object
+        # identity (session-local reuse only, like the reference's RDDs)
+        tag = getattr(self.dataset, "tag", None)
+        if tag is not None:
+            return (DatasetOperator, "tag", tag)
         return (DatasetOperator, id(self.dataset))
 
     def execute(self, deps: Sequence[Expression]) -> Expression:
