@@ -21,19 +21,33 @@ import numpy as np
 
 def build_bench(num_filters=1024, patch_size=6, alpha=0.25):
     from keystone_tpu.ops.image_ops import filter_bank_convolve, pool_image
+    from keystone_tpu.ops.pallas_kernels import (
+        fused_cifar_featurize,
+        use_pallas,
+    )
 
     rng = np.random.RandomState(0)
     filters = rng.randn(num_filters, patch_size * patch_size * 3).astype(np.float32)
-    means = rng.randn(patch_size * patch_size * 3).astype(np.float32) * 0.01
     w = rng.randn(num_filters * 2 * 2 * 2, 10).astype(np.float32) * 0.01
     b = rng.randn(10).astype(np.float32)
+
+    if use_pallas():
+        # fused Pallas featurization: conv/rectify/pool stay in VMEM
+        @jax.jit
+        def featurize_and_predict(imgs):
+            feats = fused_cifar_featurize(
+                imgs, jnp.asarray(filters), 32, patch_size, 3, 13, 14,
+                10.0, alpha)
+            return jnp.argmax(feats @ w + b, axis=-1)
+
+        return featurize_and_predict
 
     @jax.jit
     def featurize_and_predict(imgs):
         def one(img):
             conv = filter_bank_convolve(
                 img, jnp.asarray(filters), patch_size, 3, True,
-                jnp.asarray(means), 10.0,
+                None, 10.0,
             )
             pos = jnp.maximum(0.0, conv - alpha)
             neg = jnp.maximum(0.0, -conv - alpha)

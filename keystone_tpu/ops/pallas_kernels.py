@@ -111,3 +111,114 @@ def gram_cross(X: jax.Array, Y: jax.Array) -> Tuple[jax.Array, jax.Array]:
         return gram_cross_pallas(X, Y)
     Xt = X.T
     return Xt @ X, Xt @ Y
+
+
+# -- fused CIFAR featurization ---------------------------------------------
+#
+# The north-star pipeline (Convolver -> SymmetricRectifier -> Pooler,
+# SURVEY.md section 6) is HBM-bound as separate XLA ops: the (27, 27, 2K)
+# rectifier intermediate alone is ~6 MB/image written + read back. The
+# fused kernel keeps everything after im2col in VMEM: patch GEMM on the
+# MXU, patch normalization, symmetric rectification, and region-sum
+# pooling (as a mask GEMM), writing only the (regions, 2K) pooled
+# features back to HBM.
+
+
+def _fused_featurize_kernel(patch_ref, filt_ref, fsum_ref, bias_ref,
+                            mask_ref, out_ref, *, f_true, var_constant,
+                            alpha):
+    p = patch_ref[0]                       # (P, F) one image's patches
+    raw = jnp.dot(p, filt_ref[:], preferred_element_type=jnp.float32)
+    psum = jnp.sum(p, axis=1, keepdims=True)
+    psq = jnp.sum(p * p, axis=1, keepdims=True)
+    m = psum / f_true
+    var = (psq - f_true * m * m) / (f_true - 1.0)
+    sd = jnp.sqrt(var + var_constant)
+    # bias = filters @ whitener_means, subtracted post-normalization
+    # exactly like filter_bank_convolve (image_ops.py:110-111)
+    conv = (raw - m * fsum_ref[:]) / sd - bias_ref[:]  # (P, K)
+    pos = jnp.maximum(conv - alpha, 0.0)
+    neg = jnp.maximum(-conv - alpha, 0.0)
+    mask = mask_ref[:]                     # (R, P) region membership
+    out_ref[0, :, : conv.shape[1]] = jnp.dot(
+        mask, pos, preferred_element_type=jnp.float32)
+    out_ref[0, :, conv.shape[1]:] = jnp.dot(
+        mask, neg, preferred_element_type=jnp.float32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("img_size", "patch_size", "channels", "pool_stride",
+                     "pool_size", "var_constant", "alpha", "interpret"),
+)
+def fused_cifar_featurize(imgs, filters, img_size=32, patch_size=6,
+                          channels=3, pool_stride=13, pool_size=14,
+                          var_constant=10.0, alpha=0.25,
+                          whitener_means=None, interpret=False):
+    """Batched fused featurization: images (B, H, W, C), filters
+    (K, S*S*C) -> pooled (B, nPools*nPools*2K) features, numerically
+    identical to Convolver(normalize) >> SymmetricRectifier >> Pooler(sum)
+    >> vectorize."""
+    B = imgs.shape[0]
+    S, C = patch_size, channels
+    F = S * S * C
+    out_dim = img_size - S + 1
+    P = out_dim * out_dim
+    K = filters.shape[0]
+
+    # im2col outside the kernel (tiny vs the fused intermediates)
+    patches = jax.lax.conv_general_dilated_patches(
+        imgs, (S, S), (1, 1), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )  # (B, out, out, F) with feature order (c, dy, dx)
+    # reorder features to the Convolver's (dy, dx, c) filter layout
+    patches = patches.reshape(B, P, C, S * S).transpose(0, 1, 3, 2)
+    patches = patches.reshape(B, P, F)
+
+    Pp = _round_up(P, _SUBLANE)
+    Fp = _round_up(F, _LANE)
+    Kp = _round_up(K, _LANE)
+    patches = jnp.pad(patches, ((0, 0), (0, Pp - P), (0, Fp - F)))
+    filt = jnp.pad(filters.astype(jnp.float32).T, ((0, Fp - F), (0, Kp - K)))
+    fsum = jnp.sum(filters, axis=1).astype(jnp.float32)
+    fsum = jnp.pad(fsum, (0, Kp - K)).reshape(1, Kp)
+    if whitener_means is not None:
+        bias = (filters @ jnp.asarray(whitener_means)).astype(jnp.float32)
+    else:
+        bias = jnp.zeros((K,), jnp.float32)
+    bias = jnp.pad(bias, (0, Kp - K)).reshape(1, Kp)
+
+    # pooling-region membership mask over patch positions (x-major)
+    start = pool_size // 2
+    xs = list(range(start, out_dim, pool_stride))
+    mask_np = np.zeros((len(xs) * len(xs), Pp), np.float32)
+    for r, x in enumerate(xs):
+        for s, y in enumerate(xs):
+            x0, x1 = x - pool_size // 2, min(x + pool_size // 2, out_dim)
+            y0, y1 = y - pool_size // 2, min(y + pool_size // 2, out_dim)
+            for xi in range(x0, x1):
+                mask_np[r * len(xs) + s, xi * out_dim + y0: xi * out_dim + y1] = 1.0
+    R = mask_np.shape[0]
+    Rp = _round_up(R, _SUBLANE)
+    mask = jnp.asarray(np.pad(mask_np, ((0, Rp - R), (0, 0))))
+
+    kernel = functools.partial(
+        _fused_featurize_kernel, f_true=float(F),
+        var_constant=float(var_constant), alpha=float(alpha))
+    out = pl.pallas_call(
+        kernel,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, Pp, Fp), lambda i: (i, 0, 0)),
+            pl.BlockSpec((Fp, Kp), lambda i: (0, 0)),
+            pl.BlockSpec((1, Kp), lambda i: (0, 0)),
+            pl.BlockSpec((1, Kp), lambda i: (0, 0)),
+            pl.BlockSpec((Rp, Pp), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Rp, 2 * Kp), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, Rp, 2 * Kp), jnp.float32),
+        interpret=interpret,
+    )(patches, filt, fsum, bias, mask)
+    # strip padding: regions R, channels K per half
+    pooled = jnp.concatenate([out[:, :R, :K], out[:, :R, Kp:Kp + K]], axis=-1)
+    return pooled.reshape(B, R * 2 * K)

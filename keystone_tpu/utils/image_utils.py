@@ -1,0 +1,73 @@
+"""Image utilities (reference ``utils/images/ImageUtils.scala``).
+
+The reference's ``Image`` trait with four array layouts collapses to one
+TPU-native representation: float32 ``(H, W, C)`` arrays in [0, 255]
+(SURVEY.md section 7 design mapping). These helpers cover the reference's
+ImageUtils surface; per-pixel transforms are plain jnp expressions.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..loaders.image_loader_utils import decode_image
+from ..ops.image_ops import to_grayscale as _to_grayscale
+
+
+def load_image(path: str) -> Optional[np.ndarray]:
+    """File -> float32 (H, W, C) in [0, 255]; None if undecodable
+    (reference ``ImageUtils.loadImage``, :16)."""
+    with open(path, "rb") as f:
+        return decode_image(f.read())
+
+
+def write_image(path: str, img) -> None:
+    """float32 (H, W, C) [0, 255] -> image file
+    (reference ``ImageUtils.writeImage``, :59)."""
+    from PIL import Image as PILImage
+
+    arr = np.clip(np.asarray(img), 0, 255).astype(np.uint8)
+    if arr.ndim == 3 and arr.shape[2] == 1:
+        arr = arr[..., 0]
+    PILImage.fromarray(arr).save(path)
+
+
+def to_grayscale(img) -> jax.Array:
+    """NTSC luminance (reference ``ImageUtils.toGrayScale``, :73)."""
+    return _to_grayscale(img)
+
+
+def map_pixels(img, fn: Callable) -> jax.Array:
+    """Elementwise pixel transform (reference ``mapPixels``, :115)."""
+    return fn(jnp.asarray(img))
+
+
+def crop(img, x_start: int, y_start: int, x_end: int, y_end: int) -> jax.Array:
+    """Rectangular crop (reference ``crop``, :147)."""
+    return jnp.asarray(img)[x_start:x_end, y_start:y_end]
+
+
+def pixel_combine(a, b, fn: Callable = jnp.add) -> jax.Array:
+    """Combine two same-shape images pixelwise (reference
+    ``pixelCombine``, :191)."""
+    return fn(jnp.asarray(a), jnp.asarray(b))
+
+
+def split_channels(img) -> List[jax.Array]:
+    """(H, W, C) -> C single-channel images (reference
+    ``splitChannels``, :346)."""
+    img = jnp.asarray(img)
+    return [img[:, :, c] for c in range(img.shape[2])]
+
+
+def flip_horizontal(img) -> jax.Array:
+    """Mirror along the width axis (reference ``flipHorizontal``, :399)."""
+    return jnp.asarray(img)[:, ::-1]
+
+
+def flip_vertical(img) -> jax.Array:
+    """Mirror along the height axis (reference ``flipImage``, :376)."""
+    return jnp.asarray(img)[::-1, :]

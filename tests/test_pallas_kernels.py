@@ -25,3 +25,68 @@ def test_gram_cross_fallback_matches():
     g, c = gram_cross(jnp.asarray(X), jnp.asarray(Y))  # cpu fallback path
     np.testing.assert_allclose(np.asarray(g), X.T @ X, rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(np.asarray(c), X.T @ Y, rtol=1e-4, atol=1e-4)
+
+
+def test_fused_cifar_featurize_matches_composed_ops():
+    from keystone_tpu.ops.image_ops import filter_bank_convolve, pool_image
+    from keystone_tpu.ops.pallas_kernels import fused_cifar_featurize
+
+    rng = np.random.RandomState(0)
+    B, K, S = 3, 32, 6
+    imgs = rng.rand(B, 32, 32, 3).astype(np.float32) * 255
+    filters = rng.randn(K, S * S * 3).astype(np.float32)
+    got = np.asarray(fused_cifar_featurize(
+        jnp.asarray(imgs), jnp.asarray(filters), interpret=True))
+
+    def one(img):
+        conv = filter_bank_convolve(
+            jnp.asarray(img), jnp.asarray(filters), S, 3, True, None, 10.0)
+        pos = jnp.maximum(0.0, conv - 0.25)
+        neg = jnp.maximum(0.0, -conv - 0.25)
+        return np.asarray(pool_image(
+            jnp.concatenate([pos, neg], -1), 13, 14, "identity", "sum"
+        )).reshape(-1)
+
+    want = np.stack([one(i) for i in imgs])
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_fused_node_off_tpu_composes(mesh8):
+    from keystone_tpu.nodes.images.core import FusedConvRectifyPool
+    from keystone_tpu.parallel.dataset import ArrayDataset
+
+    rng = np.random.RandomState(0)
+    imgs = rng.rand(8, 32, 32, 3).astype(np.float32)
+    filters = rng.randn(16, 108).astype(np.float32)
+    node = FusedConvRectifyPool(filters, 32, 6)
+    out = node.apply_dataset(ArrayDataset.from_numpy(imgs)).numpy()
+    assert out.shape == (8, 2 * 2 * 2 * 16)
+    single = np.asarray(node.apply(imgs[0]))
+    np.testing.assert_allclose(out[0], single, rtol=1e-4, atol=1e-4)
+
+
+def test_fused_featurize_whitener_means_parity():
+    from keystone_tpu.ops.image_ops import filter_bank_convolve, pool_image
+    from keystone_tpu.ops.pallas_kernels import fused_cifar_featurize
+
+    rng = np.random.RandomState(2)
+    B, K, S = 2, 16, 6
+    imgs = rng.rand(B, 32, 32, 3).astype(np.float32) * 255
+    filters = rng.randn(K, S * S * 3).astype(np.float32)
+    means = rng.randn(S * S * 3).astype(np.float32)
+    got = np.asarray(fused_cifar_featurize(
+        jnp.asarray(imgs), jnp.asarray(filters),
+        whitener_means=jnp.asarray(means), interpret=True))
+
+    def one(img):
+        conv = filter_bank_convolve(
+            jnp.asarray(img), jnp.asarray(filters), S, 3, True,
+            jnp.asarray(means), 10.0)
+        pos = jnp.maximum(0.0, conv - 0.25)
+        neg = jnp.maximum(0.0, -conv - 0.25)
+        return np.asarray(pool_image(
+            jnp.concatenate([pos, neg], -1), 13, 14, "identity", "sum"
+        )).reshape(-1)
+
+    want = np.stack([one(i) for i in imgs])
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
