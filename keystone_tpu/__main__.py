@@ -33,6 +33,15 @@ def main(argv=None) -> int:
             print(f"  {name}")
         return 0
     app, rest = argv[0], argv[1:]
+    import os
+
+    if os.environ.get("KEYSTONE_DISTRIBUTED"):
+        # multi-host launch: every host runs the same command with
+        # KEYSTONE_DISTRIBUTED=1 (coordinator resolved from the standard
+        # jax.distributed environment) before any device use
+        from keystone_tpu.parallel.mesh import initialize_distributed
+
+        initialize_distributed()
     module = APPS.get(app)
     if module is None:
         print(f"unknown app '{app}'; run with no arguments to list apps",

@@ -339,8 +339,10 @@ class FusedConvRectifyPool(Transformer):
     as one Pallas TPU kernel (``ops/pallas_kernels.fused_cifar_featurize``):
     the conv/rectifier intermediates never leave VMEM, which roughly
     doubles featurization throughput on the north-star CIFAR benchmark.
-    Falls back to the composed XLA ops off-TPU. Filters must already be
-    whitened/normalized (the Convolver contract)."""
+    Falls back to the composed XLA ops off-TPU. Same contract as
+    Convolver: ``filters`` arrive pre-whitened by the caller
+    (filters_normalized @ whitener.T); the whitener contributes only its
+    means, subtracted post-normalization."""
 
     def __init__(self, filters, img_size: int, patch_size: int,
                  channels: int = 3, pool_stride: int = 13,
@@ -348,15 +350,10 @@ class FusedConvRectifyPool(Transformer):
                  whitener=None, var_constant: float = 10.0):
         import numpy as _np
 
-        filters = _np.asarray(filters, _np.float32)
+        self.filters = _np.asarray(filters, _np.float32)
         self.whitener_means = None
         if whitener is not None:
-            # fold the whitener in like the reference Convolver
-            # (Convolver.scala:76-79): filters * whitener.T, and keep the
-            # means for the post-normalization bias subtraction
-            filters = (filters @ whitener.whitener.T).astype(_np.float32)
             self.whitener_means = _np.asarray(whitener.means, _np.float32)
-        self.filters = filters
         self.img_size = img_size
         self.patch_size = patch_size
         self.channels = channels

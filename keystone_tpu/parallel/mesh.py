@@ -86,3 +86,28 @@ def batch_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
 def replicated_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
     mesh = mesh or get_mesh()
     return NamedSharding(mesh, P())
+
+
+def initialize_distributed(coordinator_address=None, num_processes=None,
+                           process_id=None):
+    """Multi-host initialization (the DCN scale-out entry point): wires
+    jax.distributed so ``jax.devices()`` spans all hosts and meshes built
+    from it run cross-host collectives over DCN, intra-slice ones over
+    ICI. No-op when already initialized or single-host args are absent.
+
+    The reference's analogue is Spark cluster attach
+    (``bin/run-pipeline.sh`` spark-submit); here every host runs the same
+    program (SPMD) and the mesh spans the pod.
+    """
+    import jax
+
+    if getattr(jax.distributed, "is_initialized", lambda: False)():
+        return
+    if coordinator_address is None:
+        jax.distributed.initialize()  # env-driven (TPU pods)
+    else:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
