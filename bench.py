@@ -61,6 +61,41 @@ def build_bench(num_filters=1024, patch_size=6, alpha=0.25):
     return featurize_and_predict
 
 
+def solver_bench():
+    """Optional second metric (BASELINE: "block-LS solver TFLOPS"):
+    one-pass BCD at CIFAR-scale (n=50k, d=8192 in 4096 blocks, k=10)."""
+    import functools
+    import time as _time
+
+    from keystone_tpu.ops import linalg
+
+    rng = np.random.default_rng(0)
+    n, d, k, bs = 50_000, 8192, 10, 4096
+    # generate per-block directly in f32: avoids a 3 GB f64 host
+    # intermediate and keeps only the block buffers on device
+    blocks = tuple(
+        jnp.asarray(rng.standard_normal((n, bs), dtype=np.float32))
+        for _ in range(d // bs))
+    Y = jnp.asarray(rng.standard_normal((n, k), dtype=np.float32))
+    run = jax.jit(functools.partial(linalg.bcd_core, num_passes=1))
+    [np.asarray(o) for o in run(blocks, Y, jnp.float32(0.1))]
+    iters = 5
+    t0 = _time.perf_counter()
+    for _ in range(iters):
+        out = run(blocks, Y, jnp.float32(0.1))
+    [np.asarray(o) for o in out]
+    dt = (_time.perf_counter() - t0) / iters
+    flops = sum(
+        2 * n * A.shape[1] ** 2 + A.shape[1] ** 3 / 3 + 4 * n * A.shape[1] * k
+        for A in blocks)
+    print(json.dumps({
+        "metric": "block_ls_solver_tflops",
+        "value": round(flops / dt / 1e12, 2),
+        "unit": "TFLOPS",
+        "vs_baseline": round(flops / dt / 1e12 / 45.0, 4),  # ~f32 MXU peak
+    }))
+
+
 def main():
     n_dev = len(jax.devices())
     batch = 1024
@@ -95,4 +130,9 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if "--solver" in sys.argv:
+        solver_bench()
+    else:
+        main()
