@@ -1,0 +1,104 @@
+"""Tar-based image loader tests with generated archives (mirrors the
+reference's ImageNetLoaderSuite / VOCLoaderSuite against stored tars)."""
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from keystone_tpu.loaders import (
+    VOCDataPath,
+    VOCLabelPath,
+    imagenet_loader,
+    iter_tar_images,
+    parse_voc_labels,
+    voc_loader,
+)
+
+
+def _png_bytes(rgb):
+    from PIL import Image as PILImage
+
+    buf = io.BytesIO()
+    PILImage.fromarray(rgb.astype(np.uint8)).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _write_tar(path, entries):
+    with tarfile.open(path, "w") as tf:
+        for name, data in entries:
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+
+
+def test_iter_tar_images(tmp_path):
+    rng = np.random.RandomState(0)
+    img = rng.randint(0, 255, (8, 9, 3))
+    tar = tmp_path / "imgs.tar"
+    _write_tar(str(tar), [
+        ("a/x.png", _png_bytes(img)),
+        ("a/not_an_image.txt", b"hello"),  # undecodable: skipped
+    ])
+    items = list(iter_tar_images(str(tar)))
+    assert len(items) == 1
+    name, arr = items[0]
+    assert name == "a/x.png" and arr.shape == (8, 9, 3)
+    np.testing.assert_allclose(arr, img, atol=1.0)
+
+
+def test_imagenet_loader(tmp_path):
+    rng = np.random.RandomState(0)
+    tar = tmp_path / "n01.tar"
+    _write_tar(str(tar), [
+        ("n01440764/im1.png", _png_bytes(rng.randint(0, 255, (6, 6, 3)))),
+        ("n01443537/im2.png", _png_bytes(rng.randint(0, 255, (7, 5, 3)))),
+    ])
+    labels = tmp_path / "labels.txt"
+    labels.write_text("n01440764 7\nn01443537 42\n")
+    ds = imagenet_loader(str(tmp_path), str(labels))
+    items = ds.collect()
+    assert sorted(it.label for it in items) == [7, 42]
+    assert all(it.image.ndim == 3 for it in items)
+
+
+def test_voc_loader_multilabel(tmp_path):
+    rng = np.random.RandomState(0)
+    tar = tmp_path / "voc.tar"
+    _write_tar(str(tar), [
+        ("VOCdevkit/VOC2007/JPEGImages/000001.jpg",
+         _png_bytes(rng.randint(0, 255, (6, 6, 3)))),
+        ("VOCdevkit/VOC2007/JPEGImages/000002.jpg",
+         _png_bytes(rng.randint(0, 255, (6, 6, 3)))),
+    ])
+    labels = tmp_path / "labels.csv"
+    # header + rows: col1 = 1-based class, col4 = quoted filename
+    labels.write_text(
+        'id,class,x,y,fname\n'
+        '1,3,0,0,"000001.jpg"\n'
+        '2,5,0,0,"000001.jpg"\n'
+        '3,1,0,0,"000002.jpg"\n')
+    lm = parse_voc_labels(str(labels))
+    assert lm["000001.jpg"] == [2, 4] and lm["000002.jpg"] == [0]
+
+    ds = voc_loader(
+        VOCDataPath(str(tar), "VOCdevkit"), VOCLabelPath(str(labels)))
+    items = sorted(ds.collect(), key=lambda it: it.filename)
+    assert items[0].labels == [2, 4]
+    assert items[1].labels == [0]
+
+
+def test_voc_loader_prefix_filter(tmp_path):
+    rng = np.random.RandomState(0)
+    tar = tmp_path / "voc.tar"
+    _write_tar(str(tar), [
+        ("VOCdevkit/VOC2007/JPEGImages/000001.jpg",
+         _png_bytes(rng.randint(0, 255, (4, 4, 3)))),
+        ("other/junk.png", _png_bytes(rng.randint(0, 255, (4, 4, 3)))),
+    ])
+    labels = tmp_path / "labels.csv"
+    labels.write_text('h\n1,1,0,0,"000001.jpg"\n')
+    ds = voc_loader(
+        VOCDataPath(str(tar), "VOCdevkit"), VOCLabelPath(str(labels)))
+    assert len(ds) == 1  # name prefix filtered out the junk entry
