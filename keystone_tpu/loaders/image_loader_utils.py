@@ -53,7 +53,9 @@ def decode_image(data: bytes) -> Optional[np.ndarray]:
 
 def list_archive_paths(data_path: str) -> List[str]:
     """All non-directory files under a path (reference
-    ``ImageLoaderUtils.getFilePathsRDD``)."""
+    ``ImageLoaderUtils.getFilePathsRDD`` filters only directories).
+    Non-archive files (labels.txt, READMEs) routinely sit alongside the
+    archives; :func:`load_tar_files` skips them at open time."""
     if os.path.isfile(data_path):
         return [data_path]
     return sorted(
@@ -91,8 +93,37 @@ def load_tar_files(
 ) -> HostDataset:
     """Load every image from every archive, applying the label mapping
     (reference ``ImageLoaderUtils.loadFiles``)."""
+    import gzip
+    import logging
+
+    log = logging.getLogger(__name__)
     items = []
+    opened_any = False
     for path in archive_paths:
-        for name, img in iter_tar_images(path, name_prefix):
-            items.append(image_builder(img, labels_map(name), name))
+        before = len(items)
+        it = iter_tar_images(path, name_prefix)
+        try:
+            for name, img in it:
+                opened_any = True
+                items.append(image_builder(img, labels_map(name), name))
+            opened_any = True  # readable archive, possibly zero images
+        except (tarfile.ReadError, gzip.BadGzipFile, EOFError, OSError) as e:
+            if len(items) == before:
+                # Failed before yielding anything: not a tar (labels.txt,
+                # README, checksums) — skip, matching the reference where
+                # non-archives simply yield no image records.
+                log.warning("Skipping non-archive file %s", path)
+            else:
+                # Truncated/corrupt mid-stream: keep what was read, but
+                # say so — silent partial data is worse than a warning.
+                log.warning(
+                    "Archive %s truncated/corrupt (%s); kept %d items from it",
+                    path, e, len(items) - before,
+                )
+                opened_any = True
+    if archive_paths and not opened_any:
+        raise tarfile.ReadError(
+            f"None of {len(archive_paths)} file(s) under the data path could be "
+            f"opened as tar archives (first: {archive_paths[0]})"
+        )
     return HostDataset(items)

@@ -83,7 +83,7 @@ class DenseLBFGSwithL2(LabelEstimator):
         """Reference cost model (LBFGS.scala:175-191)."""
         flops = n * d * k / num_machines
         bytes_scanned = n * d / num_machines
-        network = 2.0 * d * k * np.log2(max(num_machines, 2))
+        network = 2.0 * d * k * np.log2(max(num_machines, 1))
         return self.num_iterations * (
             max(cpu_w * flops, mem_w * bytes_scanned) + net_w * network
         )
@@ -196,7 +196,7 @@ class SparseLBFGSwithL2(LabelEstimator):
         """Reference cost model (LBFGS.scala:264-280)."""
         flops = n * sparsity * d * k / num_machines
         bytes_scanned = n * d * sparsity / num_machines
-        network = 2.0 * d * k * np.log2(max(num_machines, 2))
+        network = 2.0 * d * k * np.log2(max(num_machines, 1))
         return self.num_iterations * (
             self.sparse_overhead * max(cpu_w * flops, mem_w * bytes_scanned)
             + net_w * network

@@ -178,7 +178,7 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         """Reference cost model (BlockLinearMapper.scala:268-282)."""
         flops = n * d * (self.block_size + k) / num_machines
         bytes_scanned = n * d / num_machines + d * k
-        network = 2.0 * (d * (self.block_size + k)) * np.log2(max(num_machines, 2))
+        network = 2.0 * (d * (self.block_size + k)) * np.log2(max(num_machines, 1))
         return self.num_iter * (
             max(cpu_w * flops, mem_w * bytes_scanned) + net_w * network
         )

@@ -111,7 +111,7 @@ class DistributedPCAEstimator(Estimator):
 
     def cost(self, n, d, k, sparsity, num_machines, cpu_w, mem_w, net_w) -> float:
         """Reference cost model (DistributedPCA.scala:59-73)."""
-        log2m = np.log2(max(num_machines, 2))
+        log2m = np.log2(max(num_machines, 1))
         flops = n * d * d / num_machines + d * d * d * log2m
         bytes_scanned = n * d
         network = d * d * log2m

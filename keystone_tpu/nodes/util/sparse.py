@@ -30,9 +30,14 @@ class SparseVector:
     def __init__(self, indices, values, size: int):
         idx = np.asarray(indices, dtype=np.int32)
         val = np.asarray(values, dtype=np.float32)
-        order = np.argsort(idx, kind="stable")
-        self.indices = idx[order]
-        self.values = val[order]
+        # Coalesce duplicates by summing, so todense() and the padded-COO
+        # einsum paths (which sum contributions) agree. np.unique also
+        # sorts, which the class invariant requires.
+        uniq, inverse = np.unique(idx, return_inverse=True)
+        summed = np.zeros(uniq.shape[0], dtype=np.float32)
+        np.add.at(summed, inverse, val)
+        self.indices = uniq
+        self.values = summed
         self.size = int(size)
 
     @staticmethod

@@ -157,6 +157,9 @@ int64_t csv_parse_f32(const char* buf, int64_t len, float* out, int64_t cap) {
         if (p >= end) break;
         for (;;) {
             while (p < end && (*p == ' ' || *p == '\t')) ++p;
+            /* strtof treats '\n' as skippable whitespace, which would let an
+             * empty trailing field swallow the next row's first value. */
+            if (p >= end || *p == '\n' || *p == '\r') return -1;
             char* next = nullptr;
             float v = strtof(p, &next);
             if (next == p || n >= cap) return -1;  /* empty/bad field */

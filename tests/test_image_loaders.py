@@ -102,3 +102,18 @@ def test_voc_loader_prefix_filter(tmp_path):
     ds = voc_loader(
         VOCDataPath(str(tar), "VOCdevkit"), VOCLabelPath(str(labels)))
     assert len(ds) == 1  # name prefix filtered out the junk entry
+
+
+def test_load_tar_files_raises_when_nothing_readable(tmp_path):
+    # A directly-named (or all-junk) path that cannot be opened as a tar
+    # must error loudly, not return an empty dataset.
+    import tarfile
+
+    import pytest as _pytest
+
+    from keystone_tpu.loaders.image_loader_utils import load_tar_files
+
+    bad = tmp_path / "notatar.bin"
+    bad.write_bytes(b"junk" * 100)
+    with _pytest.raises(tarfile.ReadError):
+        load_tar_files([str(bad)], lambda n: 0, lambda img, lab, name: (img, lab))

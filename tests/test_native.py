@@ -65,3 +65,13 @@ def test_native_csv_parse(tmp_path, native_lib):
     p.write_text("1.5,2.25,3\n-4,5e-3,6\n")
     out = kn.csv_parse(str(p))
     np.testing.assert_allclose(out, [[1.5, 2.25, 3], [-4, 5e-3, 6]])
+
+
+def test_native_csv_parse_rejects_empty_trailing_field(tmp_path, native_lib):
+    # "1,\n2,\n" has an empty trailing field per row; strtof would skip the
+    # newline and swallow the next row's value, yielding [[1,2]] silently.
+    # The strict parser must bail to numpy, which raises.
+    p = tmp_path / "bad.csv"
+    p.write_text("1,\n2,\n")
+    with pytest.raises(ValueError):
+        kn.csv_parse(str(p), num_cols=2)

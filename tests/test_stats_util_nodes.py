@@ -144,3 +144,20 @@ def test_matrix_vectorizer_column_major():
     x = np.array([[[1.0, 2.0], [3.0, 4.0]]], np.float32)  # one 2x2 matrix
     out = MatrixVectorizer()(x).numpy()
     np.testing.assert_array_equal(out[0], [1, 3, 2, 4])  # column-major
+
+
+def test_sparse_vector_coalesces_duplicate_indices():
+    # Duplicate indices must sum (matching the padded-COO einsum paths),
+    # not last-write-win in todense().
+    from keystone_tpu.nodes.util.sparse import SparseVector, sparse_batch
+
+    sv = SparseVector([3, 1, 3, 1, 7], [1.0, 2.0, 4.0, 8.0, 0.5], size=10)
+    assert sv.indices.tolist() == [1, 3, 7]
+    np.testing.assert_allclose(sv.values, [10.0, 5.0, 0.5])
+    dense = sv.todense()
+    assert dense[1] == 10.0 and dense[3] == 5.0 and dense[7] == 0.5
+    # padded-COO scatter-sum of the batch form must equal todense()
+    idx, val, size = sparse_batch([sv])
+    scattered = np.zeros(size, dtype=np.float32)
+    np.add.at(scattered, idx[0], val[0])
+    np.testing.assert_allclose(scattered, dense)
