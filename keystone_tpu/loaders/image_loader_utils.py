@@ -9,9 +9,12 @@ or extract fixed-size features before moving to device arrays.
 """
 from __future__ import annotations
 
+import gzip
 import io
+import logging
 import os
 import tarfile
+import zlib
 from dataclasses import dataclass, field
 from typing import Callable, Iterator, List, Optional, Sequence
 
@@ -93,9 +96,6 @@ def load_tar_files(
 ) -> HostDataset:
     """Load every image from every archive, applying the label mapping
     (reference ``ImageLoaderUtils.loadFiles``)."""
-    import gzip
-    import logging
-
     log = logging.getLogger(__name__)
     items = []
     opened_any = False
@@ -107,7 +107,7 @@ def load_tar_files(
                 opened_any = True
                 items.append(image_builder(img, labels_map(name), name))
             opened_any = True  # readable archive, possibly zero images
-        except (tarfile.ReadError, gzip.BadGzipFile, EOFError, OSError) as e:
+        except (tarfile.ReadError, gzip.BadGzipFile, EOFError, zlib.error) as e:
             if len(items) == before:
                 # Failed before yielding anything: not a tar (labels.txt,
                 # README, checksums) — skip, matching the reference where
