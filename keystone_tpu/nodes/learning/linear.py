@@ -78,6 +78,33 @@ class LinearMapEstimator(LabelEstimator):
         network = d * (d + k)
         return max(cpu_w * flops, mem_w * bytes_scanned) + net_w * network
 
+    @staticmethod
+    def compute_cost(
+        ds,
+        labels,
+        lam: float,
+        weights: np.ndarray,
+        intercept: Optional[np.ndarray] = None,
+    ) -> float:
+        """Ridge objective at (weights, intercept): ||XW + b - L||_F^2 / (2n)
+        + lam/2 ||W||_F^2 (reference ``LinearMapper.scala:124-161``,
+        ``LinearMapEstimator.computeCost``). ``ds``/``labels`` may be
+        Datasets or arrays; the residual reduction runs on device over the
+        sharded batch."""
+        ds, labels = ensure_array(ds), ensure_array(labels)
+        b = (
+            jnp.zeros((weights.shape[1],), jnp.float32)
+            if intercept is None
+            else jnp.asarray(intercept)
+        )
+        cost = _squared_residual_sum(
+            ds.data, labels.data, jnp.asarray(weights), b, ds.mask
+        )
+        total = float(cost) / (2.0 * ds.n)
+        if lam != 0.0:
+            total += lam / 2.0 * float(np.sum(np.asarray(weights) ** 2))
+        return total
+
 
 @jax.jit
 def _centered_normal_equations(X, Y, x_mean, y_mean, mask, lam):
@@ -85,6 +112,18 @@ def _centered_normal_equations(X, Y, x_mean, y_mean, mask, lam):
     Xc = (X - x_mean) * m
     Yc = (Y - y_mean) * m
     return linalg.ridge_cho_solve(linalg.gram(Xc), linalg.cross(Xc, Yc), lam)
+
+
+@jax.jit
+def _masked_sse(pred, Y, b, mask):
+    m = mask[:, None].astype(pred.dtype)
+    resid = (pred + b - Y) * m
+    return jnp.sum(resid * resid)
+
+
+@jax.jit
+def _squared_residual_sum(X, Y, W, b, mask):
+    return _masked_sse(X @ W, Y, b, mask)
 
 
 class BlockLinearMapper(Transformer):
@@ -128,6 +167,45 @@ class BlockLinearMapper(Transformer):
         if self.intercept is not None:
             out = out + self.intercept
         return out
+
+    def _block_bounds(self) -> List[tuple]:
+        bounds, lo = [], 0
+        for w in self.block_weights:
+            bounds.append((lo, lo + w.shape[0]))
+            lo += w.shape[0]
+        return bounds
+
+    def apply_and_evaluate(self, blocks, evaluator) -> None:
+        """Incremental per-block evaluation (reference
+        ``BlockLinearMapper.scala:105-142``): after adding feature block i's
+        contribution, call ``evaluator`` on the running prediction (partial
+        sum + intercept). Lets callers track test error as the block solve
+        consumes features, without materializing all blocks at once.
+
+        ``blocks`` is a sequence of per-block feature Datasets/arrays
+        aligned with ``block_weights``; each is centered by its slice of
+        ``feature_means``. The partial sums stay on device; only the
+        evaluated copy is handed to the callback.
+        """
+        assert len(blocks) == len(self.block_weights)
+        bounds = self._block_bounds()
+        partial = None
+        for (lo, hi), w, block in zip(bounds, self.block_weights, blocks):
+            block = ensure_array(block)
+            x = block.data
+            if self.feature_means is not None:
+                x = x - self.feature_means[lo:hi]
+            contrib = x @ jnp.asarray(w)
+            partial = contrib if partial is None else partial + contrib
+            out = partial
+            if self.intercept is not None:
+                out = out + jnp.asarray(self.intercept)
+            # Re-zero pad rows (centering/intercept made them nonzero) so
+            # the emitted dataset keeps ArrayDataset's zero-pad invariant.
+            out = out * block.mask[:, None].astype(out.dtype)
+            evaluator(
+                ArrayDataset(out, block.n, block.mesh, _already_sharded=True)
+            )
 
 
 class BlockLeastSquaresEstimator(LabelEstimator):
@@ -182,6 +260,42 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         return self.num_iter * (
             max(cpu_w * flops, mem_w * bytes_scanned) + net_w * network
         )
+
+    @staticmethod
+    def compute_cost(
+        blocks,
+        labels,
+        lam: float,
+        block_weights: Sequence[np.ndarray],
+        intercept: Optional[np.ndarray] = None,
+    ) -> float:
+        """Training objective for a block model (reference
+        ``BlockLinearMapper.scala:144-187`` ``computeCost``):
+        ||sum_i X_i W_i + b - L||_F^2 / (2n) + lam/2 * sum_i ||W_i||_F^2.
+        ``blocks`` holds the per-block features (Datasets or arrays)."""
+        blocks = list(blocks)
+        assert blocks and len(blocks) == len(block_weights), (
+            f"{len(blocks)} feature blocks vs {len(block_weights)} weight blocks"
+        )
+        labels = ensure_array(labels)
+        partial = None
+        for w, block in zip(block_weights, blocks):
+            block = ensure_array(block)
+            contrib = block.data @ jnp.asarray(w)
+            partial = contrib if partial is None else partial + contrib
+        b = (
+            jnp.zeros((labels.data.shape[1],), jnp.float32)
+            if intercept is None
+            else jnp.asarray(intercept)
+        )
+        cost = float(
+            _masked_sse(partial, labels.data, b, labels.mask)
+        ) / (2.0 * labels.n)
+        if lam != 0.0:
+            cost += lam / 2.0 * float(
+                sum(np.sum(np.asarray(w) ** 2) for w in block_weights)
+            )
+        return cost
 
 
 @functools.partial(jax.jit, static_argnames=("bounds", "num_iter"))

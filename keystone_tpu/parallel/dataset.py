@@ -239,5 +239,7 @@ def ensure_array(ds: "Dataset", mesh: Optional[Mesh] = None) -> "ArrayDataset":
     boundary hit by solvers fed from ragged host pipelines."""
     if isinstance(ds, ArrayDataset):
         return ds
+    if isinstance(ds, (np.ndarray, jnp.ndarray)):
+        return ArrayDataset.from_numpy(np.asarray(ds), mesh)
     assert isinstance(ds, HostDataset), type(ds)
     return ds.to_device(mesh)

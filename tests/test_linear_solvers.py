@@ -80,3 +80,88 @@ def test_padding_does_not_corrupt_solve():
     model = BlockLeastSquaresEstimator(block_size=16, num_iter=1, lam=0.2).fit(A, Y)
     W, Am, Ym = centered_ridge(A, Y, 0.2)
     np.testing.assert_allclose(model.weights, W, rtol=5e-3, atol=5e-3)
+
+
+def test_linear_compute_cost_matches_numpy():
+    """LinearMapEstimator.computeCost (reference LinearMapper.scala:124-161):
+    objective = ||AW + b - Y||^2/(2n) + lam/2 ||W||^2."""
+    A, Y = make_problem(n=120, d=10, k=3, seed=5)
+    rng = np.random.RandomState(6)
+    W = rng.randn(10, 3).astype(np.float32)
+    b = rng.randn(3).astype(np.float32)
+    lam = 0.7
+    got = LinearMapEstimator.compute_cost(A, Y, lam, W, b)
+    want = (np.linalg.norm(A @ W + b - Y) ** 2) / (2 * A.shape[0]) + (
+        lam / 2
+    ) * np.sum(W**2)
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+    # lam=0 branch and no intercept
+    got0 = LinearMapEstimator.compute_cost(A, Y, 0.0, W, None)
+    want0 = (np.linalg.norm(A @ W - Y) ** 2) / (2 * A.shape[0])
+    np.testing.assert_allclose(got0, want0, rtol=1e-4)
+
+
+def test_block_compute_cost_matches_numpy():
+    """BlockLeastSquaresEstimator.computeCost (BlockLinearMapper.scala:144-187)."""
+    A, Y = make_problem(n=100, d=12, k=2, seed=7)
+    rng = np.random.RandomState(8)
+    bounds = [(0, 5), (5, 10), (10, 12)]
+    Ws = [rng.randn(hi - lo, 2).astype(np.float32) for lo, hi in bounds]
+    b = rng.randn(2).astype(np.float32)
+    lam = 0.3
+    blocks = [A[:, lo:hi] for lo, hi in bounds]
+    got = BlockLeastSquaresEstimator.compute_cost(blocks, Y, lam, Ws, b)
+    pred = sum(blk @ w for blk, w in zip(blocks, Ws)) + b
+    want = (np.linalg.norm(pred - Y) ** 2) / (2 * A.shape[0]) + (lam / 2) * sum(
+        np.sum(w**2) for w in Ws
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_apply_and_evaluate_incremental(mesh8):
+    """BlockLinearMapper.applyAndEvaluate (BlockLinearMapper.scala:105-142):
+    evaluator sees the cumulative per-block predictions; the last call
+    equals full apply()."""
+    A, Y = make_problem(n=96, d=12, k=3, seed=9)
+    mapper = BlockLeastSquaresEstimator(block_size=4, num_iter=4, lam=0.1).fit(
+        A, Y
+    )
+    bounds = mapper._block_bounds()
+    blocks = [A[:, lo:hi] for lo, hi in bounds]
+
+    seen = []
+    mapper.apply_and_evaluate(blocks, lambda ds: seen.append(ds.numpy()))
+    assert len(seen) == len(mapper.block_weights)
+
+    # incremental partials match the cumulative numpy sums (+ intercept)
+    partial = np.zeros((A.shape[0], 3), np.float64)
+    for i, ((lo, hi), w) in enumerate(zip(bounds, mapper.block_weights)):
+        x = blocks[i]
+        if mapper.feature_means is not None:
+            x = x - mapper.feature_means[lo:hi]
+        partial = partial + x.astype(np.float64) @ np.asarray(w, np.float64)
+        want = partial + (0 if mapper.intercept is None else mapper.intercept)
+        np.testing.assert_allclose(seen[i], want, rtol=2e-3, atol=2e-3)
+
+    # final evaluation == full apply
+    np.testing.assert_allclose(seen[-1], mapper(A).numpy(), rtol=2e-3, atol=2e-3)
+
+
+def test_apply_and_evaluate_pad_rows_stay_zero(mesh8):
+    """Pad rows of the emitted datasets must honor ArrayDataset's zero-pad
+    invariant even though centering/intercept would otherwise fill them."""
+    from keystone_tpu.parallel.dataset import ArrayDataset
+
+    A, Y = make_problem(n=101, d=8, k=2, seed=11)  # 101 % 8 != 0 -> padding
+    mapper = BlockLeastSquaresEstimator(block_size=4, num_iter=2, lam=0.1).fit(
+        A, Y
+    )
+    blocks = [
+        ArrayDataset.from_numpy(A[:, lo:hi]) for lo, hi in mapper._block_bounds()
+    ]
+    outs = []
+    mapper.apply_and_evaluate(blocks, lambda ds: outs.append(ds))
+    for ds in outs:
+        data = np.asarray(ds.data)
+        assert data.shape[0] > ds.n  # padding actually present
+        np.testing.assert_array_equal(data[ds.n:], 0.0)
