@@ -6,24 +6,41 @@ between its own class statistics (weight ``mixture_weight``) and the
 population statistics (weight ``1 - mixture_weight``), per pass per
 feature block (reference :102-320).
 
-TPU-native structure: the reference re-shuffles to one-class-per-partition
-(``groupByClasses``, :332-369) and runs per-partition local solves. Here
-the data is sorted by class once (a host argsort + device gather — the
-shuffle analogue), population Grams/cross-products are sharded GEMMs with
-all-reduce, and the per-class statistics + solves run as a ``lax.scan``
-over class segments of the sorted arrays (each step: masked dynamic slice,
-class Gram on the MXU, replicated Cholesky solve).
+TPU-native structure — the mesh analogue of the reference's
+``groupByClasses`` shuffle (:332-369, one class per Spark partition):
+
+- The row-sharded feature matrix is regrouped ON DEVICE into a
+  class-major tensor ``Xcm (C_pad, S, d)`` (class, within-class slot,
+  feature) via one permutation gather; pad slots are zero. Classes shard
+  over the ``model`` mesh axis, slots over ``data`` — so per-class work
+  is class-parallel and within-class reductions are data-parallel.
+- Per-class statistics (means, covariances, cross-products) are batched
+  GEMMs contracting the slot axis: XLA turns the sharded contractions
+  into per-class partial Grams + psum over ``data`` — the collective
+  form of the reference's per-partition accumulate + treeReduce.
+- Population statistics contract both (class, slot) axes → psum over
+  the whole mesh.
+- The per-class regularized solves are a batched Cholesky sharded over
+  ``model``.
+
+Only O(n) int32 label metadata (class ids) touches the host, to build
+the permutation — the feature matrix itself never leaves the mesh
+(asserted by ``tests/test_weighted_mesh.py`` under a transfer guard).
+Padding inflates memory by max_class/mean_class like the reference's
+one-class-per-partition stragglers.
 """
 from __future__ import annotations
 
 import functools
-from typing import List, Optional, Sequence
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...parallel.dataset import ensure_array, ArrayDataset, Dataset
+from ...parallel.mesh import DATA_AXIS, MODEL_AXIS, get_mesh
 from ...workflow.label_estimator import LabelEstimator
 from .linear import BlockLinearMapper
 
@@ -49,40 +66,51 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
 
     def _fit(self, ds: Dataset, labels: Dataset) -> BlockLinearMapper:
         ds, labels = ensure_array(ds), ensure_array(labels)
-        X = np.asarray(ds.numpy(), np.float32)
-        L = np.asarray(labels.numpy(), np.float32)
-        return self.fit_arrays(X, L)
+        return self._fit_sharded(ds, labels)
 
     def fit_arrays(self, X: np.ndarray, L: np.ndarray) -> BlockLinearMapper:
-        n, d = X.shape
-        n_classes = L.shape[1]
+        return self._fit_sharded(
+            ArrayDataset.from_numpy(np.asarray(X, np.float32)),
+            ArrayDataset.from_numpy(np.asarray(L, np.float32)),
+        )
+
+    def _fit_sharded(
+        self, ds: ArrayDataset, labels: ArrayDataset
+    ) -> BlockLinearMapper:
+        n, d = ds.n, ds.data.shape[1]
+        n_classes = labels.data.shape[1]
         w = self.mixture_weight
         lam = self.lam
         bs = self.block_size
         bounds = [(i, min(d, i + bs)) for i in range(0, d, bs)]
+        mesh = ds.mesh or get_mesh()
 
-        # group by class: sort rows by class index (the reshuffle analogue)
-        class_idx = np.argmax(L, axis=1)
-        order = np.argsort(class_idx, kind="stable")
-        Xs = X[order]
-        Ls = L[order]
-        sorted_idx = class_idx[order]
-        counts = np.bincount(sorted_idx, minlength=n_classes).astype(np.int32)
-        starts = np.concatenate([[0], np.cumsum(counts)[:-1]]).astype(np.int32)
-        max_seg = int(counts.max())
+        # --- label metadata (host, O(n) ints — the driver-side part) ---
+        class_idx = _fetch_to_host(_argmax_labels(labels.data))[: n]
+        counts = np.bincount(class_idx, minlength=n_classes).astype(np.int64)
+        perm, C_pad, S = _class_major_perm(class_idx, counts, n_classes, mesh)
 
         # joint label mean (reference :148-156)
-        joint_label_mean = 2 * w + 2 * (1 - w) * counts / n - 1.0
+        joint_label_mean = (
+            2.0 * w + 2.0 * (1 - w) * counts / n - 1.0
+        ).astype(np.float32)
 
-        # pad so per-class dynamic slices never run off the end
-        Xs_pad = np.concatenate([Xs, np.zeros((max_seg, d), np.float32)])
-        R = (Ls - joint_label_mean).astype(np.float32)
-        R_pad = np.concatenate([R, np.zeros((max_seg, n_classes), np.float32)])
+        # --- device: class-major layout, sharded (model, data, -) ---
+        cm_sharding = NamedSharding(mesh, P(MODEL_AXIS, DATA_AXIS, None))
+        perm_j = jax.device_put(
+            jnp.asarray(perm), NamedSharding(mesh, P(MODEL_AXIS, DATA_AXIS))
+        )
+        Xcm = _to_class_major(ds.data, perm_j, out_sharding=cm_sharding)
+        Lcm = _to_class_major(labels.data, perm_j, out_sharding=cm_sharding)
+        mask_cm = (perm_j < np.int32(ds.data.shape[0])).astype(jnp.float32)
+        # residual starts as centered labels, zeroed on pad slots
+        Rcm = (Lcm - jnp.asarray(joint_label_mean)) * mask_cm[:, :, None]
 
-        Xs_j = jnp.asarray(Xs_pad)
-        R_j = jnp.asarray(R_pad)
-        starts_j = jnp.asarray(starts)
-        counts_j = jnp.asarray(counts.astype(np.float32))
+        counts_f = jnp.asarray(
+            np.concatenate(
+                [counts, np.zeros(C_pad - n_classes, np.int64)]
+            ).astype(np.float32)
+        )
 
         models = [
             jnp.zeros((hi - lo, n_classes), jnp.float32) for lo, hi in bounds
@@ -91,36 +119,34 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
 
         for pass_idx in range(self.num_iter):
             for b, (lo, hi) in enumerate(bounds):
-                Xb = Xs_j[:, lo:hi]
+                Xb = Xcm[:, :, lo:hi]
                 if pass_idx == 0:
-                    pop_mean, pop_cov, joint_means = _block_stats(
-                        Xb, starts_j, counts_j, max_seg, n, w
+                    block_stats[b] = _block_stats_cm(
+                        Xb, mask_cm, counts_f, n, w
                     )
-                    block_stats[b] = (pop_mean, pop_cov, joint_means)
-                else:
-                    pop_mean, pop_cov, joint_means = block_stats[b]
+                pop_mean, pop_cov, joint_means = block_stats[b]
 
-                delta = _block_pass(
+                delta = _block_pass_cm(
                     Xb,
-                    R_j,
+                    Rcm,
                     models[b],
                     pop_mean,
                     pop_cov,
                     joint_means,
-                    starts_j,
-                    counts_j,
-                    max_seg,
+                    mask_cm,
+                    counts_f,
                     n,
                     jnp.float32(w),
                     jnp.float32(lam),
                 )
                 models[b] = models[b] + delta
-                R_j = _update_residual(R_j, Xb, delta, n)
+                Rcm = _update_residual_cm(Rcm, Xb, delta, mask_cm)
 
         W_blocks = [np.asarray(m) for m in models]
+        # joint feature means per class, assembled across blocks: (C, d)
         joint_means_all = np.concatenate(
-            [np.asarray(s[2]) for s in block_stats], axis=1
-        )  # (C, d)
+            [np.asarray(s[2])[:n_classes] for s in block_stats], axis=1
+        )
         W_full = np.concatenate(W_blocks, axis=0)  # (d, C)
         final_b = joint_label_mean - np.sum(joint_means_all.T * W_full, axis=0)
         return BlockLinearMapper(
@@ -128,66 +154,116 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         )
 
 
-@functools.partial(jax.jit, static_argnames=("max_seg", "n"))
-def _block_stats(Xb, starts, counts, max_seg, n, w):
-    """Population mean/cov + per-class joint means (reference :195-206)."""
-    Xreal = Xb[:n]
-    pop_mean = jnp.sum(Xreal, axis=0) / n
-    pop_cov = (Xreal.T @ Xreal) / n - jnp.outer(pop_mean, pop_mean)
+@jax.jit
+def _argmax_labels(L):
+    return jnp.argmax(L, axis=1).astype(jnp.int32)
 
-    def class_mean(start, count):
-        seg = jax.lax.dynamic_slice_in_dim(Xb, start, max_seg, axis=0)
-        mask = (jnp.arange(max_seg) < count)[:, None].astype(Xb.dtype)
-        return jnp.sum(seg * mask, axis=0) / jnp.maximum(count, 1.0)
 
-    class_means = jax.vmap(class_mean)(starts, counts)  # (C, d_b)
+def _fetch_to_host(arr) -> np.ndarray:
+    """Fetch a (small, metadata-sized) device array to host, working even
+    when it spans non-addressable devices in a multi-host mesh."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+    return np.asarray(arr)
+
+
+def _class_major_perm(class_idx, counts, n_classes, mesh):
+    """Row permutation into the (C_pad, S) class-major layout.
+
+    C_pad rounds the class count up to the ``model`` axis size, S rounds
+    the largest class up to the ``data`` axis size; pad slots hold an
+    out-of-bounds index so the gather fills zeros (mode='fill')."""
+    smodel = max(mesh.shape[MODEL_AXIS], 1)
+    sdata = max(mesh.shape[DATA_AXIS], 1)
+    C_pad = -(-n_classes // smodel) * smodel
+    max_count = max(int(counts.max()) if counts.size else 1, 1)
+    S = -(-max_count // sdata) * sdata
+    order = np.argsort(class_idx, kind="stable")
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    oob = np.int32(2**31 - 1)
+    perm = np.full((C_pad, S), oob, np.int32)
+    for c in range(n_classes):
+        cnt = int(counts[c])
+        perm[c, :cnt] = order[starts[c] : starts[c] + cnt]
+    return perm, C_pad, S
+
+
+@functools.partial(jax.jit, static_argnames=("out_sharding",))
+def _to_class_major(X, perm, out_sharding=None):
+    out = jnp.take(X, perm, axis=0, mode="fill", fill_value=0)
+    if out_sharding is not None:
+        out = jax.lax.with_sharding_constraint(out, out_sharding)
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _block_stats_cm(Xb, mask, counts, n, w):
+    """Population mean/cov + per-class joint means (reference :195-206),
+    batched over the class axis. Xb (C_pad, S, d_b), mask (C_pad, S)."""
+    Xm = Xb * mask[:, :, None]
+    pop_mean = jnp.einsum("csd->d", Xm) / n
+    pop_cov = jnp.einsum("csd,cse->de", Xm, Xm) / n - jnp.outer(
+        pop_mean, pop_mean
+    )
+    cnt = jnp.maximum(counts, 1.0)[:, None]
+    class_means = jnp.einsum("csd->cd", Xm) / cnt  # (C_pad, d_b)
     joint_means = w * class_means + (1 - w) * pop_mean
     return pop_mean, pop_cov, joint_means
 
 
-@functools.partial(jax.jit, static_argnames=("max_seg", "n"))
-def _block_pass(Xb, R, model, pop_mean, pop_cov, joint_means, starts, counts,
-                max_seg, n, w, lam):
-    """One coordinate-descent step for one block: per-class joint
-    statistics and solves (reference :237-292)."""
-    d_b = Xb.shape[1]
-    Xreal, Rreal = Xb[:n], R[:n]
-    pop_xtr = (Xreal.T @ Rreal) / n  # (d_b, C)
-    residual_mean = jnp.sum(Rreal, axis=0) / n  # (C,)
-
-    def per_class(c):
-        start, count = starts[c], counts[c]
-        seg = jax.lax.dynamic_slice_in_dim(Xb, start, max_seg, axis=0)
-        res_seg = jax.lax.dynamic_slice_in_dim(R, start, max_seg, axis=0)[:, c]
-        mask = (jnp.arange(max_seg) < count).astype(Xb.dtype)
-        segm = seg * mask[:, None]
-        cnt = jnp.maximum(count, 1.0)
-        class_mean = jnp.sum(segm, axis=0) / cnt
-        class_cov = (segm.T @ segm) / cnt - jnp.outer(class_mean, class_mean)
-        res_m = res_seg * mask
-        class_xtr = segm.T @ res_m / cnt
-        mean_diff = class_mean - pop_mean
-
-        joint_xtx = (
-            pop_cov * (1 - w)
-            + class_cov * w
-            + jnp.outer(mean_diff, mean_diff) * (1 - w) * w
-        )
-        mean_mixture_wt = residual_mean[c] * (1 - w) + w * jnp.sum(res_m) / cnt
-        joint_xtr = (
-            pop_xtr[:, c] * (1 - w)
-            + class_xtr * w
-            - joint_means[c] * mean_mixture_wt
-        )
-        A = joint_xtx + lam * jnp.eye(d_b, dtype=Xb.dtype)
-        rhs = joint_xtr - model[:, c] * lam
-        return jnp.linalg.solve(A, rhs)
-
-    delta = jax.lax.map(per_class, jnp.arange(joint_means.shape[0]))
-    return delta.T  # (d_b, C)
-
-
 @functools.partial(jax.jit, static_argnames=("n",))
-def _update_residual(R, Xb, delta, n):
-    upd = Xb[:n] @ delta
-    return R.at[:n].add(-upd)
+def _block_pass_cm(Xb, Rcm, model, pop_mean, pop_cov, joint_means, mask,
+                   counts, n, w, lam):
+    """One coordinate-descent step for one block (reference :237-292):
+    per-class joint statistics and solves, batched over classes and
+    sharded (classes over 'model', slots over 'data')."""
+    C_pad, S, d_b = Xb.shape
+    k = Rcm.shape[2]
+    Xm = Xb * mask[:, :, None]
+    Rm = Rcm * mask[:, :, None]
+
+    pop_xtr = jnp.einsum("csd,csk->dk", Xm, Rm) / n       # (d_b, k)
+    residual_mean = jnp.einsum("csk->k", Rm) / n          # (k,)
+
+    # class c's own residual column: res[c, s] = Rcm[c, s, c]
+    c_ids = jnp.minimum(jnp.arange(C_pad), k - 1)
+    res = jnp.take_along_axis(Rm, c_ids[:, None, None], axis=2)[:, :, 0]
+
+    cnt = jnp.maximum(counts, 1.0)
+    class_means = jnp.einsum("csd->cd", Xm) / cnt[:, None]
+    class_cov = (
+        jnp.einsum("csd,cse->cde", Xm, Xm) / cnt[:, None, None]
+        - jnp.einsum("cd,ce->cde", class_means, class_means)
+    )
+    class_xtr = jnp.einsum("csd,cs->cd", Xm, res) / cnt[:, None]
+    mean_diff = class_means - pop_mean                    # (C_pad, d_b)
+
+    joint_xtx = (
+        (1 - w) * pop_cov[None]
+        + w * class_cov
+        + (1 - w) * w * jnp.einsum("cd,ce->cde", mean_diff, mean_diff)
+    )
+    res_class_mean = jnp.einsum("cs->c", res) / cnt
+    mean_mixture_wt = (
+        jnp.take(residual_mean, c_ids) * (1 - w) + w * res_class_mean
+    )
+    pop_xtr_c = jnp.take(pop_xtr, c_ids, axis=1).T        # (C_pad, d_b)
+    joint_xtr = (
+        (1 - w) * pop_xtr_c
+        + w * class_xtr
+        - joint_means * mean_mixture_wt[:, None]
+    )
+    model_c = jnp.take(model, c_ids, axis=1).T            # (C_pad, d_b)
+    A = joint_xtx + lam * jnp.eye(d_b, dtype=Xb.dtype)[None]
+    rhs = joint_xtr - lam * model_c
+    chol = jnp.linalg.cholesky(A)                         # SPD: batched Cholesky
+    delta = jax.scipy.linalg.cho_solve((chol, True), rhs[..., None])[..., 0]
+    return delta[:k].T                                    # (d_b, k)
+
+
+@jax.jit
+def _update_residual_cm(Rcm, Xb, delta, mask):
+    upd = jnp.einsum("csd,dk->csk", Xb, delta)
+    return Rcm - upd * mask[:, :, None]

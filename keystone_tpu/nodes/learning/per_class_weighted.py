@@ -24,6 +24,7 @@ import numpy as np
 
 from ...parallel.dataset import ensure_array, ArrayDataset, Dataset
 from ...workflow.label_estimator import LabelEstimator
+from .block_weighted import _argmax_labels, _fetch_to_host
 from .linear import BlockLinearMapper
 
 
@@ -44,38 +45,55 @@ class PerClassWeightedLeastSquaresEstimator(LabelEstimator):
 
     def _fit(self, ds: Dataset, labels: Dataset) -> BlockLinearMapper:
         ds, labels = ensure_array(ds), ensure_array(labels)
-        X = np.asarray(ds.numpy(), np.float32)
-        L = np.asarray(labels.numpy(), np.float32)
-        return self.fit_arrays(X, L)
+        return self._fit_sharded(ds, labels)
 
     def fit_arrays(self, X: np.ndarray, L: np.ndarray) -> BlockLinearMapper:
-        n, d = X.shape
-        n_classes = L.shape[1]
+        return self._fit_sharded(
+            ArrayDataset.from_numpy(np.asarray(X, np.float32)),
+            ArrayDataset.from_numpy(np.asarray(L, np.float32)),
+        )
+
+    def _fit_sharded(
+        self, ds: ArrayDataset, labels: ArrayDataset
+    ) -> BlockLinearMapper:
+        """Per-class solves over the row-sharded feature matrix: every Gram
+        and cross-product inside ``_solve_single_class`` contracts the
+        sharded example axis, so XLA emits per-shard partials + psum (the
+        reference's per-partition accumulate + treeReduce). X never leaves
+        the mesh; only O(n) int32 class ids reach the host."""
+        n, d = ds.n, ds.data.shape[1]
+        n_classes = labels.data.shape[1]
         w = self.mixture_weight
         bs = self.block_size
         bounds = tuple((i, min(d, i + bs)) for i in range(0, d, bs))
 
-        class_idx = np.argmax(L, axis=1)
-        counts = np.bincount(class_idx, minlength=n_classes).astype(np.float64)
-        counts = np.maximum(counts, 1.0)
-        pop_mean = X.mean(axis=0)
-        # per-class means and joint feature means (reference :127-169)
-        onehot = np.zeros((n, n_classes), np.float32)
-        onehot[np.arange(n), class_idx] = 1.0
-        class_means = (onehot.T @ X) / counts[:, None].astype(np.float32)
-        jfm = w * class_means + (1 - w) * pop_mean  # (C, d)
+        X, L = ds.data, labels.data
+        mask = ds.mask.astype(jnp.float32)  # (padded_n,)
+        cls_dev = _argmax_labels(L)  # computed once, reused per class
+        class_idx = _fetch_to_host(cls_dev)[: n]
+        counts = np.maximum(
+            np.bincount(class_idx, minlength=n_classes).astype(np.float64), 1.0
+        )
+        # population / per-class means via sharded reductions
+        pop_sum, class_sums = _label_stats(X, cls_dev, mask, n_classes)
+        pop_mean = np.asarray(pop_sum) / n
+        class_means = np.asarray(class_sums) / counts[:, None].astype(
+            np.float32
+        )
+        jfm = (w * class_means + (1 - w) * pop_mean).astype(np.float32)
         joint_label_mean = (counts / n) * 2.0 * (1 - w) - 1.0 + 2.0 * w
 
-        Xj = jnp.asarray(X)
         models = np.zeros((d, n_classes), np.float32)
         for c in range(n_classes):
-            b_c = np.full(n, (1 - w) / n, np.float32)
-            b_c[class_idx == c] += w / counts[c]
-            y_c = (L[:, c] - joint_label_mean[c]).astype(np.float32)
+            onehot_c = _class_indicator(cls_dev, c, mask)
+            b_c = mask * np.float32((1 - w) / n) + onehot_c * np.float32(
+                w / counts[c]
+            )
+            y_c = (L[:, c] - np.float32(joint_label_mean[c])) * mask
             W_c = _solve_single_class(
-                Xj,
-                jnp.asarray(b_c),
-                jnp.asarray(y_c),
+                X,
+                b_c,
+                y_c,
                 jnp.asarray(jfm[c]),
                 jnp.float32(self.lam),
                 bounds,
@@ -86,6 +104,19 @@ class PerClassWeightedLeastSquaresEstimator(LabelEstimator):
         blocks = [models[lo:hi] for lo, hi in bounds]
         final_b = joint_label_mean - np.sum(jfm.T * models, axis=0)
         return BlockLinearMapper(blocks, bs, intercept=final_b.astype(np.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _label_stats(X, cls, mask, k):
+    """Masked population sum and per-class sums (onehot^T X), sharded."""
+    Xm = X * mask[:, None]
+    onehot = jax.nn.one_hot(cls, k, dtype=X.dtype) * mask[:, None]
+    return jnp.einsum("nd->d", Xm), onehot.T @ Xm
+
+
+@jax.jit
+def _class_indicator(cls, c, mask):
+    return (cls == c).astype(jnp.float32) * mask
 
 
 @functools.partial(jax.jit, static_argnames=("bounds", "num_iter"))

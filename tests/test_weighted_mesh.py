@@ -1,0 +1,145 @@
+"""Mesh-native weighted solver tests: the flagship solvers must keep the
+feature matrix sharded (no host collect — the round-1 implementation's
+``ds.numpy()`` is banned here by monkeypatch) and must produce correct
+solutions when collectives cross BOTH mesh axes (classes over ``model``,
+within-class slots over ``data``)."""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from keystone_tpu.nodes.learning.block_weighted import (
+    BlockWeightedLeastSquaresEstimator,
+    _class_major_perm,
+    _to_class_major,
+)
+from keystone_tpu.nodes.learning.per_class_weighted import (
+    PerClassWeightedLeastSquaresEstimator,
+)
+from keystone_tpu.parallel.dataset import ArrayDataset, HostDataset
+from keystone_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    make_mesh,
+    mesh_scope,
+)
+
+
+def make_problem(n=240, d=12, k=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = rng.randint(0, k, n)
+    L = -np.ones((n, k), np.float32)
+    L[np.arange(n), y] = 1.0
+    return X, L, y
+
+
+def weighted_gradient(X, L, W, b, lam, mw):
+    X = X.astype(np.float64)
+    L = L.astype(np.float64)
+    n, k = L.shape
+    y = np.argmax(L, axis=1)
+    counts = np.bincount(y, minlength=k)
+    neg = (1.0 - mw) / n
+    wts = np.full((n, k), neg)
+    wts[np.arange(n), y] = neg + mw / counts[y]
+    resid = X @ W + b - L
+    return X.T @ (resid * wts) + lam * W
+
+
+@pytest.mark.parametrize(
+    "est_cls",
+    [BlockWeightedLeastSquaresEstimator, PerClassWeightedLeastSquaresEstimator],
+)
+def test_weighted_fit_never_collects_features(mesh8, est_cls, monkeypatch):
+    """The VERDICT round-1 finding: _fit must not gather the feature
+    matrix to host. numpy()/collect() on the feature dataset raise here,
+    so the fit passes only if X stays on the mesh end to end."""
+    X, L, y = make_problem(n=160, d=12, k=4, seed=1)
+    ds = ArrayDataset.from_numpy(X)
+    labels = ArrayDataset.from_numpy(L)
+
+    def _banned(self, *a, **k):
+        raise AssertionError("feature dataset was collected to host")
+
+    monkeypatch.setattr(ArrayDataset, "numpy", _banned)
+    monkeypatch.setattr(HostDataset, "collect", _banned, raising=False)
+
+    model = est_cls(
+        block_size=6, num_iter=5, lam=0.1, mixture_weight=0.3
+    )._fit(ds, labels)
+    g = weighted_gradient(
+        X, L, np.asarray(model.weights, np.float64),
+        np.asarray(model.intercept, np.float64), 0.1, 0.3,
+    )
+    assert np.linalg.norm(g.ravel()) < 5e-2
+
+
+def test_block_weighted_on_2d_mesh_crosses_both_axes():
+    """data=4 x model=2 mesh: per-class Grams contract the 'data'-sharded
+    slot axis (psum over data) while classes parallelize over 'model'.
+    The solution must match the single-axis mesh run and have ~zero
+    objective gradient."""
+    devs = jax.devices()[:8]
+    X, L, y = make_problem(n=200, d=10, k=4, seed=2)
+    est = BlockWeightedLeastSquaresEstimator(
+        block_size=5, num_iter=6, lam=0.2, mixture_weight=0.4
+    )
+    with mesh_scope(make_mesh(devs, data=8, model=1)):
+        m1 = est.fit_arrays(X, L)
+    with mesh_scope(make_mesh(devs, data=4, model=2)):
+        m2 = est.fit_arrays(X, L)
+    np.testing.assert_allclose(
+        np.asarray(m1.weights), np.asarray(m2.weights), rtol=1e-4, atol=1e-4
+    )
+    g = weighted_gradient(
+        X, L, np.asarray(m2.weights, np.float64),
+        np.asarray(m2.intercept, np.float64), 0.2, 0.4,
+    )
+    assert np.linalg.norm(g.ravel()) < 5e-2
+
+
+def test_class_major_layout_sharded_over_both_axes():
+    """The (C_pad, S, d) class-major tensor really is distributed: classes
+    over 'model', slots over 'data' — each device holds a (C_pad/2, S/4, d)
+    brick, never the full tensor."""
+    devs = jax.devices()[:8]
+    mesh = make_mesh(devs, data=4, model=2)
+    X, L, y = make_problem(n=96, d=6, k=4, seed=3)
+    class_idx = y.astype(np.int32)
+    counts = np.bincount(class_idx, minlength=4).astype(np.int64)
+    perm, C_pad, S = _class_major_perm(class_idx, counts, 4, mesh)
+    assert C_pad % 2 == 0 and S % 4 == 0
+
+    with mesh_scope(mesh):
+        Xj = jax.device_put(X, NamedSharding(mesh, P(DATA_AXIS, None)))
+        perm_j = jax.device_put(
+            perm, NamedSharding(mesh, P(MODEL_AXIS, DATA_AXIS))
+        )
+        cm_sharding = NamedSharding(mesh, P(MODEL_AXIS, DATA_AXIS, None))
+        Xcm = _to_class_major(Xj, perm_j, out_sharding=cm_sharding)
+
+    assert Xcm.shape == (C_pad, S, 6)
+    shard_shapes = {s.data.shape for s in Xcm.addressable_shards}
+    assert shard_shapes == {(C_pad // 2, S // 4, 6)}
+    # content: row s of class c is the s-th example of class c
+    dense = np.asarray(Xcm)
+    for c in range(4):
+        rows = X[class_idx == c]
+        np.testing.assert_allclose(dense[c, : len(rows)], rows, rtol=1e-6)
+        np.testing.assert_array_equal(dense[c, len(rows):], 0.0)
+
+
+def test_perm_out_of_bounds_fills_zero():
+    mesh = make_mesh(jax.devices()[:8], data=8, model=1)
+    class_idx = np.array([0, 0, 1], np.int32)
+    counts = np.array([2, 1], np.int64)
+    perm, C_pad, S = _class_major_perm(class_idx, counts, 2, mesh)
+    X = np.arange(12, dtype=np.float32).reshape(3, 4) + 1.0
+    with mesh_scope(mesh):
+        Xcm = np.asarray(_to_class_major(jax.numpy.asarray(X), perm))
+    np.testing.assert_allclose(Xcm[0, 0], X[0])
+    np.testing.assert_allclose(Xcm[0, 1], X[1])
+    np.testing.assert_allclose(Xcm[1, 0], X[2])
+    assert (Xcm[0, 2:] == 0).all() and (Xcm[1, 1:] == 0).all()
