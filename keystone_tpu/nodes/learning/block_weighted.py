@@ -39,7 +39,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ...parallel.dataset import ensure_array, ArrayDataset, Dataset
+from ...parallel.dataset import (
+    argmax_labels,
+    ensure_array,
+    fetch_to_host,
+    ArrayDataset,
+    Dataset,
+)
 from ...parallel.mesh import DATA_AXIS, MODEL_AXIS, get_mesh
 from ...workflow.label_estimator import LabelEstimator
 from .linear import BlockLinearMapper
@@ -86,7 +92,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         mesh = ds.mesh or get_mesh()
 
         # --- label metadata (host, O(n) ints — the driver-side part) ---
-        class_idx = _fetch_to_host(_argmax_labels(labels.data))[: n]
+        class_idx = fetch_to_host(argmax_labels(labels.data))[: n]
         counts = np.bincount(class_idx, minlength=n_classes).astype(np.int64)
         perm, C_pad, S = _class_major_perm(class_idx, counts, n_classes, mesh)
 
@@ -138,6 +144,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     n,
                     jnp.float32(w),
                     jnp.float32(lam),
+                    smodel=mesh.shape[MODEL_AXIS],
                 )
                 models[b] = models[b] + delta
                 Rcm = _update_residual_cm(Rcm, Xb, delta, mask_cm)
@@ -154,19 +161,6 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         )
 
 
-@jax.jit
-def _argmax_labels(L):
-    return jnp.argmax(L, axis=1).astype(jnp.int32)
-
-
-def _fetch_to_host(arr) -> np.ndarray:
-    """Fetch a (small, metadata-sized) device array to host, working even
-    when it spans non-addressable devices in a multi-host mesh."""
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-
-        return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
-    return np.asarray(arr)
 
 
 def _class_major_perm(class_idx, counts, n_classes, mesh):
@@ -213,24 +207,80 @@ def _block_stats_cm(Xb, mask, counts, n, w):
     return pop_mean, pop_cov, joint_means
 
 
-@functools.partial(jax.jit, static_argnames=("n",))
+#: Per-chunk budget for the batched (chunk, d_b, d_b) class covariance /
+#: Cholesky tensors. The reference bounds this memory by processing one
+#: class per partition; here the class axis is chunked so peak memory is
+#: O(chunk * d_b^2) regardless of the class count (ImageNet: 1000 classes
+#: at block_size 4096 would otherwise need ~67 GB per tensor).
+_CLASS_CHUNK_BYTES = 1 << 30
+
+
+def _class_chunk(C_pad: int, d_b: int, smodel: int) -> int:
+    per_class = 4 * d_b * d_b
+    chunk = max(int(_CLASS_CHUNK_BYTES // max(per_class, 1)), 1)
+    if chunk >= C_pad:
+        return C_pad
+    # multiple of the model-axis size so each chunk shards evenly
+    chunk = max((chunk // smodel) * smodel, smodel)
+    return min(chunk, C_pad)
+
+
 def _block_pass_cm(Xb, Rcm, model, pop_mean, pop_cov, joint_means, mask,
-                   counts, n, w, lam):
+                   counts, n, w, lam, smodel=1):
     """One coordinate-descent step for one block (reference :237-292):
     per-class joint statistics and solves, batched over classes and
-    sharded (classes over 'model', slots over 'data')."""
+    sharded (classes over 'model', slots over 'data'). The O(d_b^2)
+    per-class tensors are built chunk-of-classes at a time."""
     C_pad, S, d_b = Xb.shape
     k = Rcm.shape[2]
+    res, pop_xtr, residual_mean = _pass_globals(Xb, Rcm, mask, n, k)
+
+    chunk = _class_chunk(C_pad, d_b, smodel)
+    deltas = []
+    for a in range(0, C_pad, chunk):
+        b = min(a + chunk, C_pad)
+        c_ids = jnp.minimum(jnp.arange(a, b), k - 1)
+        deltas.append(
+            _chunk_solve(
+                Xb[a:b],
+                res[a:b],
+                mask[a:b],
+                counts[a:b],
+                joint_means[a:b],
+                jnp.take(model, c_ids, axis=1).T,
+                jnp.take(pop_xtr, c_ids, axis=1).T,
+                jnp.take(residual_mean, c_ids),
+                pop_mean,
+                pop_cov,
+                n,
+                w,
+                lam,
+            )
+        )
+    delta = jnp.concatenate(deltas, axis=0)               # (C_pad, d_b)
+    return delta[:k].T                                    # (d_b, k)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "k"))
+def _pass_globals(Xb, Rcm, mask, n, k):
+    """Whole-population quantities for one pass: class-own residual
+    columns, population cross-products, residual means."""
+    C_pad = Xb.shape[0]
     Xm = Xb * mask[:, :, None]
     Rm = Rcm * mask[:, :, None]
-
     pop_xtr = jnp.einsum("csd,csk->dk", Xm, Rm) / n       # (d_b, k)
     residual_mean = jnp.einsum("csk->k", Rm) / n          # (k,)
-
-    # class c's own residual column: res[c, s] = Rcm[c, s, c]
     c_ids = jnp.minimum(jnp.arange(C_pad), k - 1)
     res = jnp.take_along_axis(Rm, c_ids[:, None, None], axis=2)[:, :, 0]
+    return res, pop_xtr, residual_mean
 
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _chunk_solve(Xb, res, mask, counts, joint_means, model_c, pop_xtr_c,
+                 residual_mean_c, pop_mean, pop_cov, n, w, lam):
+    """Joint statistics + regularized solve for one chunk of classes."""
+    d_b = Xb.shape[2]
+    Xm = Xb * mask[:, :, None]
     cnt = jnp.maximum(counts, 1.0)
     class_means = jnp.einsum("csd->cd", Xm) / cnt[:, None]
     class_cov = (
@@ -238,7 +288,7 @@ def _block_pass_cm(Xb, Rcm, model, pop_mean, pop_cov, joint_means, mask,
         - jnp.einsum("cd,ce->cde", class_means, class_means)
     )
     class_xtr = jnp.einsum("csd,cs->cd", Xm, res) / cnt[:, None]
-    mean_diff = class_means - pop_mean                    # (C_pad, d_b)
+    mean_diff = class_means - pop_mean                    # (chunk, d_b)
 
     joint_xtx = (
         (1 - w) * pop_cov[None]
@@ -246,21 +296,16 @@ def _block_pass_cm(Xb, Rcm, model, pop_mean, pop_cov, joint_means, mask,
         + (1 - w) * w * jnp.einsum("cd,ce->cde", mean_diff, mean_diff)
     )
     res_class_mean = jnp.einsum("cs->c", res) / cnt
-    mean_mixture_wt = (
-        jnp.take(residual_mean, c_ids) * (1 - w) + w * res_class_mean
-    )
-    pop_xtr_c = jnp.take(pop_xtr, c_ids, axis=1).T        # (C_pad, d_b)
+    mean_mixture_wt = residual_mean_c * (1 - w) + w * res_class_mean
     joint_xtr = (
         (1 - w) * pop_xtr_c
         + w * class_xtr
         - joint_means * mean_mixture_wt[:, None]
     )
-    model_c = jnp.take(model, c_ids, axis=1).T            # (C_pad, d_b)
     A = joint_xtx + lam * jnp.eye(d_b, dtype=Xb.dtype)[None]
     rhs = joint_xtr - lam * model_c
     chol = jnp.linalg.cholesky(A)                         # SPD: batched Cholesky
-    delta = jax.scipy.linalg.cho_solve((chol, True), rhs[..., None])[..., 0]
-    return delta[:k].T                                    # (d_b, k)
+    return jax.scipy.linalg.cho_solve((chol, True), rhs[..., None])[..., 0]
 
 
 @jax.jit

@@ -22,9 +22,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...parallel.dataset import ensure_array, ArrayDataset, Dataset
+from ...parallel.dataset import (
+    argmax_labels,
+    ensure_array,
+    fetch_to_host,
+    ArrayDataset,
+    Dataset,
+)
 from ...workflow.label_estimator import LabelEstimator
-from .block_weighted import _argmax_labels, _fetch_to_host
 from .linear import BlockLinearMapper
 
 
@@ -69,8 +74,8 @@ class PerClassWeightedLeastSquaresEstimator(LabelEstimator):
 
         X, L = ds.data, labels.data
         mask = ds.mask.astype(jnp.float32)  # (padded_n,)
-        cls_dev = _argmax_labels(L)  # computed once, reused per class
-        class_idx = _fetch_to_host(cls_dev)[: n]
+        cls_dev = argmax_labels(L)  # computed once, reused per class
+        class_idx = fetch_to_host(cls_dev)[: n]
         counts = np.maximum(
             np.bincount(class_idx, minlength=n_classes).astype(np.float64), 1.0
         )

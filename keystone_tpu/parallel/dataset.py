@@ -243,3 +243,19 @@ def ensure_array(ds: "Dataset", mesh: Optional[Mesh] = None) -> "ArrayDataset":
         return ArrayDataset.from_numpy(np.asarray(ds), mesh)
     assert isinstance(ds, HostDataset), type(ds)
     return ds.to_device(mesh)
+
+
+@jax.jit
+def argmax_labels(L):
+    """Class ids from a one-hot/indicator label matrix, on device."""
+    return jnp.argmax(L, axis=1).astype(jnp.int32)
+
+
+def fetch_to_host(arr) -> np.ndarray:
+    """Fetch a (small, metadata-sized) device array to host, working even
+    when it spans non-addressable devices in a multi-host mesh."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+    return np.asarray(arr)

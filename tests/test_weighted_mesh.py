@@ -143,3 +143,25 @@ def test_perm_out_of_bounds_fills_zero():
     np.testing.assert_allclose(Xcm[0, 1], X[1])
     np.testing.assert_allclose(Xcm[1, 0], X[2])
     assert (Xcm[0, 2:] == 0).all() and (Xcm[1, 1:] == 0).all()
+
+
+def test_class_chunking_matches_unchunked(mesh8, monkeypatch):
+    """The memory-bounded class-chunked solve must equal the one-shot
+    batched solve (chunk forced down to the model-axis size)."""
+    import keystone_tpu.nodes.learning.block_weighted as bw
+
+    X, L, y = make_problem(n=160, d=12, k=6, seed=4)
+    est = BlockWeightedLeastSquaresEstimator(
+        block_size=6, num_iter=4, lam=0.15, mixture_weight=0.35
+    )
+    m_full = est.fit_arrays(X, L)
+    monkeypatch.setattr(bw, "_CLASS_CHUNK_BYTES", 1)  # => chunk == smodel
+    m_chunked = est.fit_arrays(X, L)
+    np.testing.assert_allclose(
+        np.asarray(m_full.weights), np.asarray(m_chunked.weights),
+        rtol=1e-5, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(m_full.intercept), np.asarray(m_chunked.intercept),
+        rtol=1e-5, atol=1e-5,
+    )
