@@ -3,10 +3,19 @@
 Text processing is host-stage work (ragged, non-numeric); featurization
 hands off to device arrays via sparse vectors (``nodes/util/sparse``).
 The reference's CoreNLP/Epic-backed nodes (CoreNLPFeatureExtractor,
-POSTagger, NER) wrap external JVM model libraries with no TPU analogue;
-they are intentionally out of scope here and their pipeline role
-(lemmatized-ngram extraction) is covered by Tokenizer + NGramsFeaturizer.
+POSTagger, NER) keep their node surface here with pluggable models;
+small in-tree rule-based English models are the defaults (``corenlp.py``).
 """
+from .corenlp import (
+    CoreNLPFeatureExtractor,
+    NER,
+    POSTagger,
+    RuleBasedNerModel,
+    RuleBasedPosModel,
+    Segmentation,
+    TaggedSequence,
+    english_lemmatize,
+)
 from .hashing import HashingTF, NGramsHashingTF, java_string_hash, scala_hash
 from .indexers import NaiveBitPackIndexer, NGramIndexer, NGramIndexerImpl
 from .ngrams import (
@@ -21,6 +30,14 @@ from .text import LowerCase, Tokenizer, Trim
 from .word_freq import OOV_INDEX, WordFrequencyEncoder, WordFrequencyTransformer
 
 __all__ = [
+    "CoreNLPFeatureExtractor",
+    "NER",
+    "POSTagger",
+    "RuleBasedNerModel",
+    "RuleBasedPosModel",
+    "Segmentation",
+    "TaggedSequence",
+    "english_lemmatize",
     "HashingTF",
     "NGramsHashingTF",
     "java_string_hash",

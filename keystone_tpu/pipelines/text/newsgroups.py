@@ -2,6 +2,10 @@
 ``pipelines/text/NewsgroupsPipeline.scala:15-77``):
 Trim -> LowerCase -> Tokenizer -> NGrams(1..n) -> TermFrequency(binary) ->
 CommonSparseFeatures(100k) -> NaiveBayes -> MaxClassifier.
+
+With ``lemmatize=True`` the tokenize+ngram prefix is replaced by
+:class:`CoreNLPFeatureExtractor` (lemmatized, entity-typed n-grams —
+the reference's CoreNLP featurization variant).
 """
 from __future__ import annotations
 
@@ -14,7 +18,13 @@ from ...evaluation.multiclass import evaluate_multiclass
 from ...loaders.csv_loader import LabeledData
 from ...loaders.newsgroups import CLASSES, newsgroups_loader
 from ...nodes.learning import NaiveBayesEstimator
-from ...nodes.nlp import LowerCase, NGramsFeaturizer, Tokenizer, Trim
+from ...nodes.nlp import (
+    CoreNLPFeatureExtractor,
+    LowerCase,
+    NGramsFeaturizer,
+    Tokenizer,
+    Trim,
+)
 from ...nodes.stats import TermFrequency
 from ...nodes.util import CommonSparseFeatures, Densify, MaxClassifier
 
@@ -25,6 +35,7 @@ class NewsgroupsConfig:
     test_location: str = ""
     n_grams: int = 2
     common_features: int = 100000
+    lemmatize: bool = False
 
 
 def run(config: NewsgroupsConfig, train: Optional[LabeledData] = None,
@@ -37,13 +48,14 @@ def run(config: NewsgroupsConfig, train: Optional[LabeledData] = None,
         test = newsgroups_loader(config.test_location)
     num_classes = num_classes or len(CLASSES)
 
-    predictor = (
-        Trim()
-        >> LowerCase()
-        >> Tokenizer()
-        >> NGramsFeaturizer(list(range(1, config.n_grams + 1)))
-        >> TermFrequency(lambda x: 1)
-    ).and_then(
+    orders = list(range(1, config.n_grams + 1))
+    if config.lemmatize:
+        featurizer = Trim() >> CoreNLPFeatureExtractor(orders)
+    else:
+        featurizer = (
+            Trim() >> LowerCase() >> Tokenizer() >> NGramsFeaturizer(orders)
+        )
+    predictor = (featurizer >> TermFrequency(lambda x: 1)).and_then(
         CommonSparseFeatures(config.common_features), train.data
     ) >> Densify()
     predictor = predictor.and_then(
@@ -63,9 +75,10 @@ def main(argv=None):
     p.add_argument("--testLocation", required=True)
     p.add_argument("--nGrams", type=int, default=2)
     p.add_argument("--commonFeatures", type=int, default=100000)
+    p.add_argument("--lemmatize", action="store_true")
     a = p.parse_args(argv)
     run(NewsgroupsConfig(a.trainLocation, a.testLocation, a.nGrams,
-                         a.commonFeatures))
+                         a.commonFeatures, a.lemmatize))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,213 @@
+"""Tests for the linguistic annotation nodes (reference
+CoreNLPFeatureExtractor/POSTagger/NER suites — the reference tests these
+through pipeline usage; here each node surface gets direct coverage)."""
+import numpy as np
+
+from keystone_tpu.nodes.nlp import (
+    CoreNLPFeatureExtractor,
+    NER,
+    POSTagger,
+    RuleBasedNerModel,
+    RuleBasedPosModel,
+    english_lemmatize,
+)
+
+
+# ------------------------------------------------------------- lemmatizer
+
+
+def test_lemmatizer_irregulars():
+    assert english_lemmatize("was") == "be"
+    assert english_lemmatize("children") == "child"
+    assert english_lemmatize("wrote") == "write"
+    assert english_lemmatize("geese") == "goose"
+
+
+def test_lemmatizer_suffix_rules():
+    assert english_lemmatize("cities") == "city"
+    assert english_lemmatize("churches") == "church"
+    assert english_lemmatize("dogs") == "dog"
+    assert english_lemmatize("running") == "run"       # undoubling
+    assert english_lemmatize("making") == "make"       # CVC e-restore
+    assert english_lemmatize("jumped") == "jump"
+    assert english_lemmatize("studied") == "study"
+    assert english_lemmatize("tried") == "try"
+    assert english_lemmatize("stopped") == "stop"
+
+
+def test_lemmatizer_pos_gated_comparatives():
+    # -er stripping only for adjective/adverb tags
+    assert english_lemmatize("faster", "JJR") == "fast"
+    assert english_lemmatize("biggest", "JJS") == "big"
+    assert english_lemmatize("corner", "NN") == "corner"
+    assert english_lemmatize("water") == "water"
+
+
+def test_lemmatizer_keeps_short_and_safe_words():
+    assert english_lemmatize("is") == "be"  # irregular, not s-stripped
+    assert english_lemmatize("bus") == "bus"
+    assert english_lemmatize("class") == "class"
+    assert english_lemmatize("analysis") == "analysis"
+
+
+# -------------------------------------------------------------------- POS
+
+
+def test_pos_tagger_sentence():
+    tagged = POSTagger().apply(
+        "The quick dogs are running quickly".split()
+    )
+    assert tagged.words[0] == "The"
+    got = dict(tagged.pairs())
+    assert got["The"] == "DT"
+    assert got["dogs"] == "NNS"
+    assert got["are"] == "VBP"
+    assert got["running"] == "VBG"
+    assert got["quickly"] == "RB"
+
+
+def test_pos_tagger_numbers_and_proper_nouns():
+    tagged = RuleBasedPosModel().best_sequence(
+        ["She", "saw", "Paris", "in", "1999"]
+    )
+    got = dict(tagged.pairs())
+    assert got["She"] == "PRP"
+    assert got["Paris"] == "NNP"
+    assert got["in"] == "IN"
+    assert got["1999"] == "CD"
+
+
+def test_pos_tagger_pluggable_model():
+    class Upper:
+        def best_sequence(self, words):
+            from keystone_tpu.nodes.nlp.corenlp import TaggedSequence
+
+            return TaggedSequence(list(words), ["X"] * len(words))
+
+    assert POSTagger(Upper()).apply(["a", "b"]).tags == ["X", "X"]
+
+
+# -------------------------------------------------------------------- NER
+
+
+def test_ner_spans_and_labels():
+    seg = NER().apply(
+        "Yesterday Dr. Alice Smith flew to Paris with 3 colleagues".split()
+    )
+    by_label = {label: (start, end) for label, start, end in seg.spans}
+    assert "PERSON" in by_label
+    start, end = by_label["PERSON"]
+    assert seg.words[start:end] == ["Dr.", "Alice", "Smith"]
+    assert "LOCATION" in by_label
+    lstart, lend = by_label["LOCATION"]
+    assert seg.words[lstart:lend] == ["Paris"]
+    assert "NUMBER" in by_label
+    labels = seg.labels
+    assert labels[seg.words.index("to")] == "O"
+
+
+def test_ner_organization():
+    seg = RuleBasedNerModel().best_sequence(
+        "He joined Acme Corp last year".split()
+    )
+    assert ("ORGANIZATION", 2, 4) in seg.spans
+
+
+def test_ner_sentence_initial_capital_not_entity():
+    seg = RuleBasedNerModel().best_sequence("Running is fun".split())
+    assert seg.spans == []
+
+
+# ---------------------------------------------- CoreNLPFeatureExtractor
+
+
+def test_corenlp_extractor_lemmatizes_and_entity_types():
+    out = CoreNLPFeatureExtractor([1]).apply("Alice visited Paris. The dogs were running.")
+    assert "PERSON" in out
+    assert "LOCATION" in out
+    assert "dog" in out            # lemmatized plural
+    assert "run" in out            # lemmatized gerund
+    assert "be" in out             # were -> be
+    assert "dogs" not in out
+
+
+def test_corenlp_extractor_respects_sentence_boundaries():
+    out = CoreNLPFeatureExtractor([2]).apply("Cats sleep. Dogs bark.")
+    # no bigram spans the sentence boundary ("sleep dog" must not appear)
+    assert "cat sleep" in out
+    assert "dog bark" in out
+    assert all("sleep dog" != g for g in out)
+
+
+def test_corenlp_extractor_multiple_orders():
+    out = CoreNLPFeatureExtractor([1, 2]).apply("big red cars stopped")
+    assert "big" in out and "big red" in out and "red car" in out
+    assert "car stop" in out
+
+
+def test_corenlp_extractor_in_newsgroups_pipeline():
+    """The lemmatizing featurizer variant trains end to end."""
+    from keystone_tpu.loaders.csv_loader import LabeledData
+    from keystone_tpu.parallel.dataset import ArrayDataset, HostDataset
+    from keystone_tpu.pipelines.text.newsgroups import (
+        NewsgroupsConfig,
+        run,
+    )
+
+    docs, labels = [], []
+    for i in range(12):
+        if i % 2 == 0:
+            docs.append("The spacecraft orbited Mars. Rockets launched daily.")
+            labels.append(0)
+        else:
+            docs.append("The pitchers threw fastballs. Baseball games ended late.")
+            labels.append(1)
+    train = LabeledData(
+        data=HostDataset(docs), labels=ArrayDataset.from_numpy(
+            np.asarray(labels, np.int32))
+    )
+    _, eval_ = run(
+        NewsgroupsConfig(n_grams=2, common_features=500, lemmatize=True),
+        train=train, test=train, num_classes=2,
+    )
+    assert eval_.total_error < 0.2
+
+
+def test_pos_tagger_comparatives_feed_lemmatizer():
+    model = RuleBasedPosModel()
+    tagged = model.best_sequence("the faster horses ran".split())
+    got = dict(tagged.pairs())
+    assert got["faster"] == "JJR"
+    assert english_lemmatize("faster", got["faster"]) == "fast"
+    # -er nouns stay nouns
+    assert dict(model.best_sequence(["the", "computer"]).pairs())["computer"] == "NN"
+
+
+def test_extractor_rejects_length_mismatched_model():
+    import pytest as _pytest
+
+    from keystone_tpu.nodes.nlp.corenlp import TaggedSequence
+
+    class Short:
+        def best_sequence(self, words):
+            return TaggedSequence(list(words)[:-1], ["NN"] * (len(words) - 1))
+
+    with _pytest.raises(ValueError):
+        CoreNLPFeatureExtractor([1], pos_model=Short()).apply("a b c d")
+
+
+def test_eq_key_distinguishes_custom_models():
+    class Custom:
+        def best_sequence(self, words):
+            from keystone_tpu.nodes.nlp.corenlp import TaggedSequence
+
+            return TaggedSequence(list(words), ["NN"] * len(words))
+
+    a = CoreNLPFeatureExtractor([1], pos_model=Custom())
+    b = CoreNLPFeatureExtractor([1], pos_model=Custom())
+    assert a.eq_key() != b.eq_key()  # distinct custom instances never merge
+    # stateless defaults do merge
+    assert (
+        CoreNLPFeatureExtractor([1]).eq_key()
+        == CoreNLPFeatureExtractor([1]).eq_key()
+    )
