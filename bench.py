@@ -37,11 +37,16 @@ import numpy as np
 SMALL = os.environ.get("KEYSTONE_BENCH_SMALL") == "1"
 
 
+_emitted = 0
+
+
 def _emit(metric, value, unit, vs_baseline, **extra):
+    global _emitted
     line = {"metric": metric, "value": value, "unit": unit,
             "vs_baseline": vs_baseline}
     line.update(extra)
     print(json.dumps(line), flush=True)
+    _emitted += 1
 
 
 # ------------------------------------------------------- featurize bench
@@ -393,6 +398,10 @@ def main():
             section()
         except Exception:
             traceback.print_exc()
+    if _emitted == 0:
+        # every section failed: fail loudly instead of exiting 0 with an
+        # empty metrics stream
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,88 @@
+#!/usr/bin/env bash
+# Pod-scale cluster launcher — the analogue of the reference's
+# bin/keystone-ec2.sh (spark-ec2 provisioning, reference EC2.md:17-31),
+# rebuilt for Cloud TPU pod slices.
+#
+# The reference provisioned a Spark driver + executors and submitted an
+# assembly jar. A TPU pod is SPMD instead: every host (worker) runs the
+# SAME program; jax.distributed wires the hosts together, jax.devices()
+# spans the whole slice, and the mesh's collectives ride ICI within the
+# slice (DCN across slices). There is no driver/executor split.
+#
+# Usage:
+#   bin/keystone-tpu-pod.sh create  <name> --zone Z --type v5litepod-64 [--version IMG]
+#   bin/keystone-tpu-pod.sh install <name> --zone Z        # rsync repo + deps to all workers
+#   bin/keystone-tpu-pod.sh run     <name> --zone Z -- <app> [--flags]
+#   bin/keystone-tpu-pod.sh ssh     <name> --zone Z [--worker N]
+#   bin/keystone-tpu-pod.sh delete  <name> --zone Z
+#
+# Requires the `gcloud` CLI, authenticated with a project that has TPU
+# quota. See CLUSTER.md for the full walkthrough and env-var contract.
+set -euo pipefail
+
+die() { echo "keystone-tpu-pod: $*" >&2; exit 1; }
+
+cmd="${1:-}"; shift || true
+name="${1:-}"; shift || true
+[[ -n "$cmd" && -n "$name" ]] || {
+  grep '^#   bin/' "$0" | sed 's/^# *//'; exit 1; }
+
+zone="" type="v5litepod-16" version="tpu-ubuntu2204-base" worker="all"
+passthru=()
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    --zone) zone="$2"; shift 2 ;;
+    --type) type="$2"; shift 2 ;;
+    --version) version="$2"; shift 2 ;;
+    --worker) worker="$2"; shift 2 ;;
+    --) shift; passthru=("$@"); break ;;
+    *) die "unknown flag $1" ;;
+  esac
+done
+[[ -n "$zone" ]] || die "--zone is required"
+
+gtpu() { gcloud compute tpus tpu-vm "$@"; }
+
+case "$cmd" in
+  create)
+    gtpu create "$name" --zone "$zone" \
+      --accelerator-type "$type" --version "$version"
+    ;;
+  install)
+    # Ship the repo to every worker and build the native host library.
+    # (The reference shipped an assembly jar; we rsync the source tree.)
+    here="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+    tmp="$(mktemp /tmp/keystone-tpu-XXXX.tar.gz)"
+    tar -C "$here" -czf "$tmp" --exclude .git --exclude __pycache__ .
+    gtpu scp "$tmp" "$name:/tmp/keystone-tpu.tar.gz" \
+      --zone "$zone" --worker=all
+    gtpu ssh "$name" --zone "$zone" --worker=all --command \
+      'mkdir -p ~/keystone-tpu && tar -C ~/keystone-tpu -xzf /tmp/keystone-tpu.tar.gz \
+       && make -C ~/keystone-tpu/native || true \
+       && pip install -q "jax[tpu]" flax optax orbax-checkpoint einops chex'
+    rm -f "$tmp"
+    ;;
+  run)
+    [[ ${#passthru[@]} -gt 0 ]] || die "run needs '-- <app> [--flags]'"
+    # SPMD: the same command on every worker. jax.distributed resolves
+    # the coordinator from the TPU metadata environment, so no explicit
+    # coordinator address is needed on Cloud TPU. Local KEYSTONE_* env
+    # vars (e.g. KEYSTONE_MESH_MODEL) are forwarded to every worker;
+    # args are %q-quoted so spaces/metacharacters survive the remote shell.
+    envfwd="KEYSTONE_DISTRIBUTED=1"
+    while IFS='=' read -r k v; do
+      [[ "$k" == KEYSTONE_* && "$k" != KEYSTONE_DISTRIBUTED ]] \
+        && envfwd+=" $(printf '%q=%q' "$k" "$v")"
+    done < <(env)
+    gtpu ssh "$name" --zone "$zone" --worker=all --command \
+      "cd ~/keystone-tpu && $envfwd PYTHONPATH=~/keystone-tpu \
+       python -m keystone_tpu $(printf '%q ' "${passthru[@]}")"
+    ;;
+  ssh)
+    gtpu ssh "$name" --zone "$zone" --worker="$worker"
+    ;;
+  delete)
+    gtpu delete "$name" --zone "$zone" --quiet
+    ;;
+  *) die "unknown command '$cmd' (create|install|run|ssh|delete)" ;;
+esac

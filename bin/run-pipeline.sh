@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Single-host launcher — the analogue of the reference's
+# bin/run-pipeline.sh local mode (reference: bin/run-pipeline.sh:6-43).
+#
+#   bin/run-pipeline.sh <app> [--flags]
+#   bin/run-pipeline.sh                 # list apps
+#
+# The reference capped OMP_NUM_THREADS to protect OpenBLAS inside Spark
+# executors (run-pipeline.sh:12-31). Here TPU compute goes through XLA,
+# but host-side stages (image decode, tokenization, numpy in loaders)
+# still use OpenBLAS/OpenMP through numpy — same cap, same reason.
+set -euo pipefail
+
+KEYSTONE_HOME="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+
+if [[ -z "${OMP_NUM_THREADS:-}" ]]; then
+  ncores="$(getconf _NPROCESSORS_ONLN 2>/dev/null || echo 8)"
+  export OMP_NUM_THREADS="$(( ncores < 32 ? ncores : 32 ))"
+fi
+
+# Build the native host library on first use (cifar decode, text hashing,
+# csv parse — keystone_tpu/native falls back to pure Python without it).
+if [[ ! -e "$KEYSTONE_HOME/native/libkeystone_native.so" ]] \
+    && command -v make >/dev/null 2>&1; then
+  make -C "$KEYSTONE_HOME/native" >/dev/null 2>&1 || true
+fi
+
+export PYTHONPATH="$KEYSTONE_HOME${PYTHONPATH:+:$PYTHONPATH}"
+exec python -m keystone_tpu "$@"

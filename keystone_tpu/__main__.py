@@ -35,13 +35,48 @@ def main(argv=None) -> int:
     app, rest = argv[0], argv[1:]
     import os
 
-    if os.environ.get("KEYSTONE_DISTRIBUTED"):
+    # Environments that import jax at interpreter start (device-plugin
+    # sitecustomize) can pin the platform before JAX_PLATFORMS is read;
+    # re-assert the user's choice via config, which wins as long as no
+    # backend has been used yet (same trick as tests/conftest.py).
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+    # explicit multi-host wiring for non-TPU-metadata environments
+    # (CLUSTER.md "Environment contract"); consumed here so individual
+    # apps stay launch-agnostic
+    dist_args = {}
+    for flag, key, cast in (("--coordinator", "coordinator_address", str),
+                            ("--num-processes", "num_processes", int),
+                            ("--process-id", "process_id", int)):
+        if flag in rest:
+            i = rest.index(flag)
+            if i + 1 >= len(rest):
+                print(f"{flag} requires a value", file=sys.stderr)
+                return 2
+            try:
+                dist_args[key] = cast(rest[i + 1])
+            except ValueError:
+                print(f"{flag} expects {cast.__name__}, got {rest[i + 1]!r}",
+                      file=sys.stderr)
+                return 2
+            del rest[i:i + 2]
+    if dist_args and "coordinator_address" not in dist_args:
+        print("--num-processes/--process-id require --coordinator "
+              "(without it the coordinator comes from the TPU metadata "
+              "env; set KEYSTONE_DISTRIBUTED=1 instead)", file=sys.stderr)
+        return 2
+
+    if os.environ.get("KEYSTONE_DISTRIBUTED") or dist_args:
         # multi-host launch: every host runs the same command with
         # KEYSTONE_DISTRIBUTED=1 (coordinator resolved from the standard
         # jax.distributed environment) before any device use
         from keystone_tpu.parallel.mesh import initialize_distributed
 
-        initialize_distributed()
+        initialize_distributed(**dist_args)
     module = APPS.get(app)
     if module is None:
         print(f"unknown app '{app}'; run with no arguments to list apps",

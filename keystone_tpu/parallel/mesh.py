@@ -50,11 +50,18 @@ def set_mesh(mesh: Optional[Mesh]) -> None:
 
 
 def get_mesh() -> Mesh:
-    """The process-global mesh, lazily built over all visible devices."""
+    """The process-global mesh, lazily built over all visible devices.
+
+    ``KEYSTONE_MESH_MODEL=k`` sizes the ``model`` axis of the lazily
+    built default mesh (CLUSTER.md environment contract).
+    """
     global _global_mesh
     with _lock:
         if _global_mesh is None:
-            _global_mesh = make_mesh()
+            import os
+
+            model = int(os.environ.get("KEYSTONE_MESH_MODEL", "1"))
+            _global_mesh = make_mesh(model=model)
         return _global_mesh
 
 
