@@ -53,12 +53,17 @@ case "$cmd" in
     # (The reference shipped an assembly jar; we rsync the source tree.)
     here="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
     tmp="$(mktemp /tmp/keystone-tpu-XXXX.tar.gz)"
-    tar -C "$here" -czf "$tmp" --exclude .git --exclude __pycache__ .
+    # exclude locally built artifacts: a shipped .so would look up-to-date
+    # to the remote make and the wrong-platform binary would be kept
+    tar -C "$here" -czf "$tmp" --exclude .git --exclude __pycache__ \
+      --exclude '*.so' --exclude '*.dylib' .
     gtpu scp "$tmp" "$name:/tmp/keystone-tpu.tar.gz" \
       --zone "$zone" --worker=all
+    # only the native build is optional (pure-Python fallbacks exist);
+    # mkdir/tar/pip failures must fail the install
     gtpu ssh "$name" --zone "$zone" --worker=all --command \
       'mkdir -p ~/keystone-tpu && tar -C ~/keystone-tpu -xzf /tmp/keystone-tpu.tar.gz \
-       && make -C ~/keystone-tpu/native || true \
+       && { make -C ~/keystone-tpu/native || echo "native build failed; using pure-Python fallbacks" >&2; } \
        && pip install -q "jax[tpu]" flax optax orbax-checkpoint einops chex'
     rm -f "$tmp"
     ;;
@@ -74,9 +79,11 @@ case "$cmd" in
       [[ "$k" == KEYSTONE_* && "$k" != KEYSTONE_DISTRIBUTED ]] \
         && envfwd+=" $(printf '%q=%q' "$k" "$v")"
     done < <(env)
+    # run-pipeline.sh applies the OMP cap and PYTHONPATH on the worker
+    # (CLUSTER.md environment contract) and resolves python3 itself
     gtpu ssh "$name" --zone "$zone" --worker=all --command \
-      "cd ~/keystone-tpu && $envfwd PYTHONPATH=~/keystone-tpu \
-       python -m keystone_tpu $(printf '%q ' "${passthru[@]}")"
+      "cd ~/keystone-tpu && $envfwd \
+       bash bin/run-pipeline.sh $(printf '%q ' "${passthru[@]}")"
     ;;
   ssh)
     gtpu ssh "$name" --zone "$zone" --worker="$worker"

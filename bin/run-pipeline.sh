@@ -26,4 +26,6 @@ if [[ ! -e "$KEYSTONE_HOME/native/libkeystone_native.so" ]] \
 fi
 
 export PYTHONPATH="$KEYSTONE_HOME${PYTHONPATH:+:$PYTHONPATH}"
-exec python -m keystone_tpu "$@"
+PY=python3
+command -v python3 >/dev/null 2>&1 || PY=python
+exec "$PY" -m keystone_tpu "$@"

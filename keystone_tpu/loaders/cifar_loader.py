@@ -18,28 +18,36 @@ NROW, NCOL, NCHAN = 32, 32, 3
 RECORD = 1 + NROW * NCOL * NCHAN
 
 
-def load_cifar_numpy(path: str):
-    """Returns (images (n,32,32,3) float32 in [0,255], labels (n,) int32)."""
+def load_cifar_numpy(path: str, packed: bool = False):
+    """Returns (images (n,32,32,3), labels (n,) int32). Images are
+    float32 in [0,255] by default; ``packed=True`` keeps them uint8 —
+    the analogue of the reference's byte-packed CIFAR layout
+    (``RowColumnMajorByteArrayVectorizedImage``, Image.scala:333-365),
+    4x smaller in host and HBM memory. jnp type promotion converts to
+    f32 on device inside the first float op, so downstream nodes see
+    identical values."""
     if os.path.isdir(path):
         files = sorted(glob.glob(os.path.join(path, "*.bin")))
     else:
         files = sorted(glob.glob(path)) or [path]
-    from ..native import cifar_decode
+    from ..native import cifar_decode, cifar_decode_u8
 
+    decode = cifar_decode_u8 if packed else cifar_decode
     imgs, labels = [], []
     for f in files:
         with open(f, "rb") as fh:
             raw = fh.read()
         assert len(raw) % RECORD == 0, f"corrupt CIFAR file {f}"
-        i, l = cifar_decode(raw, NROW, NCOL, NCHAN)  # native when built
+        i, l = decode(raw, NROW, NCOL, NCHAN)  # native when built
         imgs.append(i)
         labels.append(l)
     return np.concatenate(imgs), np.concatenate(labels)
 
 
-def cifar_loader(path: str) -> LabeledData:
-    images, labels = load_cifar_numpy(path)
+def cifar_loader(path: str, packed: bool = False) -> LabeledData:
+    images, labels = load_cifar_numpy(path, packed=packed)
+    pk = ":u8" if packed else ""
     return LabeledData(
-        data=ArrayDataset.from_numpy(images, tag=f"cifar:{path}:data"),
+        data=ArrayDataset.from_numpy(images, tag=f"cifar:{path}{pk}:data"),
         labels=ArrayDataset.from_numpy(labels, tag=f"cifar:{path}:labels"),
     )

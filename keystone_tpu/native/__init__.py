@@ -117,6 +117,26 @@ def cifar_decode(raw: bytes, rows: int = 32, cols: int = 32,
     return planes.transpose(0, 2, 3, 1).astype(np.float32), labels
 
 
+def cifar_decode_u8(raw: bytes, rows: int = 32, cols: int = 32,
+                    chans: int = 3):
+    """Decode CIFAR binary records WITHOUT float inflation ->
+    (images uint8 (n,rows,cols,chans), labels int32 (n,)).
+
+    The byte-packed analogue of the reference's
+    ``RowColumnMajorByteArrayVectorizedImage`` (Image.scala:333-365),
+    which existed exactly to avoid 4x memory blow-up at CIFAR load time;
+    the f32 conversion happens on device, fused by XLA into the first
+    consuming op.
+    """
+    rec = 1 + rows * cols * chans
+    n = len(raw) // rec
+    assert len(raw) % rec == 0, "corrupt CIFAR buffer"
+    arr = np.frombuffer(raw, np.uint8).reshape(n, rec)
+    labels = arr[:, 0].astype(np.int32)
+    planes = arr[:, 1:].reshape(n, chans, rows, cols)
+    return np.ascontiguousarray(planes.transpose(0, 2, 3, 1)), labels
+
+
 # ---------------- text hashing ----------------
 
 def java_hash_tokens(tokens: Sequence[str]) -> np.ndarray:

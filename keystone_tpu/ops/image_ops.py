@@ -157,7 +157,12 @@ NTSC_RED, NTSC_GREEN, NTSC_BLUE = 0.2989, 0.5870, 0.1140
 
 def to_grayscale(img: jax.Array) -> jax.Array:
     """Grayscale with a single kept channel. 3-channel images use the
-    MATLAB luma weights; otherwise the reference's RMS-over-channels."""
+    MATLAB luma weights; otherwise the reference's RMS-over-channels.
+
+    Integer images (the packed-u8 load path) are promoted to f32 first —
+    luma weights truncate to zero in an integer dtype."""
+    if jnp.issubdtype(img.dtype, jnp.integer):
+        img = img.astype(jnp.float32)
     if img.shape[-1] == 1:
         return img
     if img.shape[-1] == 3:

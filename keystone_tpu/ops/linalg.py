@@ -155,10 +155,33 @@ def block_coordinate_descent(
     return list(run(tuple(blocks), Y, jnp.asarray(lam, Y.dtype)))
 
 
+def _class_spec(k: int):
+    """Sharding specs putting label columns over the ``model`` axis when
+    the mesh has one and it divides k; (None, None) disables.
+
+    This is the plain-BCD analogue of the weighted solver's class-major
+    layout (SURVEY.md section 2.14 feature-block/class parallelism): the
+    Gram/Cholesky work is replicated across ``model`` groups, but the
+    k-column cross-products, triangular solves, and rank-b prediction
+    updates — the terms that scale with the class count — split over it.
+    """
+    from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    mesh = get_mesh()
+    model = dict(mesh.shape).get(MODEL_AXIS, 1)
+    if model > 1 and k % model == 0:
+        return (NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS)),
+                NamedSharding(mesh, P(None, MODEL_AXIS)))
+    return None, None
+
+
 def bcd_core(blocks, Y, lam, *, num_passes: int):
     """Traceable BCD body (callable from inside other jitted programs)."""
     dtype = Y.dtype
     k = Y.shape[1]
+    y_spec, w_spec = _class_spec(k)
+    if y_spec is not None:
+        Y = jax.lax.with_sharding_constraint(Y, y_spec)
     # Precompute per-block Cholesky factors once per solve: the Gram of
     # each block is pass-invariant, so multi-pass BCD reuses factors.
     factors = []
@@ -170,7 +193,10 @@ def bcd_core(blocks, Y, lam, *, num_passes: int):
     for _ in range(num_passes):
         for i, A in enumerate(blocks):
             target = Y - pred + A @ Ws[i]
-            Wi = jax.scipy.linalg.cho_solve(factors[i], cross(A, target))
+            rhs = cross(A, target)
+            if w_spec is not None:
+                rhs = jax.lax.with_sharding_constraint(rhs, w_spec)
+            Wi = jax.scipy.linalg.cho_solve(factors[i], rhs)
             pred = pred + A @ (Wi - Ws[i])
             Ws[i] = Wi
     return Ws

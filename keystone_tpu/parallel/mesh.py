@@ -60,7 +60,13 @@ def get_mesh() -> Mesh:
         if _global_mesh is None:
             import os
 
-            model = int(os.environ.get("KEYSTONE_MESH_MODEL", "1"))
+            raw = os.environ.get("KEYSTONE_MESH_MODEL") or "1"
+            try:
+                model = int(raw)
+            except ValueError:
+                raise ValueError(
+                    f"KEYSTONE_MESH_MODEL must be an integer, got {raw!r}"
+                ) from None
             _global_mesh = make_mesh(model=model)
         return _global_mesh
 

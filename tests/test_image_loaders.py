@@ -117,3 +117,68 @@ def test_load_tar_files_raises_when_nothing_readable(tmp_path):
     bad.write_bytes(b"junk" * 100)
     with _pytest.raises(tarfile.ReadError):
         load_tar_files([str(bad)], lambda n: 0, lambda img, lab, name: (img, lab))
+
+
+def _write_cifar_bin(path, n=24, seed=0):
+    """Synthesize a binary CIFAR file (reference record layout:
+    1 label byte + 3 row-major 32x32 planes, CifarLoader.scala:14-51)."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n).astype(np.uint8)
+    planes = rng.randint(0, 256, (n, 3, 32, 32)).astype(np.uint8)
+    rec = np.concatenate([labels[:, None], planes.reshape(n, -1)], axis=1)
+    path.write_bytes(rec.tobytes())
+    return planes.transpose(0, 2, 3, 1), labels
+
+
+def test_cifar_loader_float_and_packed_agree(tmp_path):
+    """packed=True keeps uint8 (4x smaller); values are identical after
+    the on-device float conversion."""
+    import numpy as np
+
+    from keystone_tpu.loaders.cifar_loader import cifar_loader
+
+    expect_imgs, expect_labels = _write_cifar_bin(tmp_path / "b1.bin")
+    f = cifar_loader(str(tmp_path / "b1.bin"))
+    p = cifar_loader(str(tmp_path / "b1.bin"), packed=True)
+
+    import jax
+
+    assert jax.tree_util.tree_leaves(p.data.data)[0].dtype == np.uint8
+    assert jax.tree_util.tree_leaves(f.data.data)[0].dtype == np.float32
+    np.testing.assert_array_equal(np.asarray(f.labels.numpy()), expect_labels)
+    np.testing.assert_array_equal(np.asarray(p.labels.numpy()), expect_labels)
+    np.testing.assert_array_equal(f.data.numpy(), expect_imgs.astype(np.float32))
+    np.testing.assert_array_equal(p.data.numpy(), expect_imgs)
+
+    # device-side float op sees identical values from either layout
+    scaled_f = f.data.map(lambda x: x / 255.0).numpy()
+    scaled_p = p.data.map(lambda x: x / 255.0).numpy()
+    np.testing.assert_allclose(scaled_f, scaled_p, rtol=1e-6)
+
+
+def test_cifar_packed_pipeline_parity(tmp_path):
+    """The real LinearPixels app path (GrayScaler -> vectorize -> solve)
+    gives the same predictions from packed-u8 and f32 datasets."""
+    import numpy as np
+
+    from keystone_tpu.loaders.cifar_loader import cifar_loader
+    from keystone_tpu.nodes.images.core import (
+        GrayScaler,
+        ImageVectorizer,
+        PixelScaler,
+    )
+    from keystone_tpu.nodes.learning.linear import LinearMapEstimator
+    from keystone_tpu.nodes.util import ClassLabelIndicatorsFromIntLabels
+
+    _write_cifar_bin(tmp_path / "b1.bin", n=40)
+    preds = {}
+    for packed in (False, True):
+        d = cifar_loader(str(tmp_path / "b1.bin"), packed=packed)
+        feat = ImageVectorizer().apply_dataset(
+            GrayScaler().apply_dataset(PixelScaler().apply_dataset(d.data)))
+        labels = ClassLabelIndicatorsFromIntLabels(10).apply_dataset(d.labels)
+        model = LinearMapEstimator(lam=10.0).fit(feat, labels)
+        preds[packed] = np.asarray(model.apply_dataset(feat).numpy())
+    np.testing.assert_allclose(preds[False], preds[True], rtol=1e-4, atol=1e-4)

@@ -162,3 +162,15 @@ def test_zca_whitener_decorrelates():
     np.testing.assert_allclose(cov, np.eye(6), atol=0.15)
     # whitener is symmetric
     np.testing.assert_allclose(w.whitener, w.whitener.T, atol=1e-4)
+
+
+def test_grayscale_uint8_promotes():
+    """Packed-u8 images: luma weights must not truncate to zero."""
+    import numpy as np
+
+    from keystone_tpu.ops.image_ops import to_grayscale
+
+    img = np.full((4, 4, 3), 100, np.uint8)
+    out = np.asarray(to_grayscale(img))
+    np.testing.assert_allclose(out, 100.0 * 0.9999, rtol=1e-3)
+    assert out.dtype == np.float32
