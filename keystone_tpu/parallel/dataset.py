@@ -22,6 +22,7 @@ eager, like a cached RDD.
 """
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Iterable, List, Optional, Sequence
 
 import jax
@@ -177,6 +178,19 @@ def _shard_pytree(data: Any, n: int, mesh: Mesh) -> Any:
     sh = batch_sharding(mesh)
 
     def put(x):
+        if isinstance(x, jax.Array) and not isinstance(x, jax.core.Tracer):
+            # already on device: pad + reshard there — round-tripping
+            # through np.asarray would drag the whole array over the
+            # host link (catastrophic on tunneled chips, wasteful
+            # everywhere)
+            if x.shape[0] != n:
+                raise ValueError(f"leading dim {x.shape[0]} != n={n}")
+            if rows != n:
+                pad = [(0, rows - n)] + [(0, 0)] * (x.ndim - 1)
+                x = jax.jit(
+                    functools.partial(jnp.pad, pad_width=pad)
+                )(x)
+            return jax.device_put(x, sh)
         x = np.asarray(x)
         if x.shape[0] != n:
             raise ValueError(f"leading dim {x.shape[0]} != n={n}")

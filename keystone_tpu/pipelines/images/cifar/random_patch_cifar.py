@@ -61,8 +61,12 @@ def learn_filters(train_images, config: RandomCifarConfig):
         >> ImageVectorizer()
         >> Sampler(WHITENER_SAMPLES, seed=config.seed)
     )
-    base_filters = patch_extractor(train_images).numpy()
-    base_filter_mat = np.asarray(normalize_rows(base_filters, 10.0))
+    sample = patch_extractor(train_images).get()
+    # normalize ON DEVICE, then download the sampled matrix once for the
+    # driver-local ZCA fit (reference collects the sample the same way)
+    base_filter_mat = np.asarray(
+        normalize_rows(sample.data, 10.0)
+    )[: sample.n]
     whitener = ZCAWhitenerEstimator(config.whitening_epsilon).fit_single(
         base_filter_mat
     )

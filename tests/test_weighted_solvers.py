@@ -109,3 +109,42 @@ def test_cosine_random_features():
     # cauchy variant
     node2 = CosineRandomFeatures.create(8, 16, gamma=0.5, w_dist="cauchy", seed=2)
     assert node2.W.shape == (16, 8)
+
+
+def test_woodbury_solver_matches_cholesky():
+    """The low-rank (Woodbury) per-class solve is numerically equivalent
+    to the direct batched-Cholesky path; 'auto' picks woodbury when the
+    padded class size is well under the block width."""
+    from keystone_tpu.nodes.learning.block_weighted import (
+        BlockWeightedLeastSquaresEstimator,
+    )
+
+    X, L, y = make_problem(n=240, d=48, k=4, seed=5)
+    kw = dict(block_size=48, num_iter=3, lam=0.3, mixture_weight=0.35)
+    m_chol = BlockWeightedLeastSquaresEstimator(
+        solver="cholesky", **kw).fit_arrays(X, L)
+    m_wood = BlockWeightedLeastSquaresEstimator(
+        solver="woodbury", **kw).fit_arrays(X, L)
+    np.testing.assert_allclose(
+        np.asarray(m_chol.weights), np.asarray(m_wood.weights),
+        rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(
+        np.asarray(m_chol.intercept), np.asarray(m_wood.intercept),
+        rtol=2e-3, atol=2e-3)
+
+
+def test_woodbury_multi_block():
+    """Woodbury parity across multiple feature blocks and passes."""
+    from keystone_tpu.nodes.learning.block_weighted import (
+        BlockWeightedLeastSquaresEstimator,
+    )
+
+    X, L, y = make_problem(n=300, d=40, k=5, seed=6)
+    kw = dict(block_size=16, num_iter=4, lam=0.2, mixture_weight=0.25)
+    m_chol = BlockWeightedLeastSquaresEstimator(
+        solver="cholesky", **kw).fit_arrays(X, L)
+    m_wood = BlockWeightedLeastSquaresEstimator(
+        solver="woodbury", **kw).fit_arrays(X, L)
+    np.testing.assert_allclose(
+        np.asarray(m_chol.weights), np.asarray(m_wood.weights),
+        rtol=5e-3, atol=5e-3)
