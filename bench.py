@@ -21,6 +21,14 @@ Default invocation emits ONE JSON line PER METRIC
    flagged by the extra "dataset" key. vs_baseline = 0.16 / value
    (>1 means better than the ~84% published-accuracy bar).
 
+Plus per-app benches covering the rest of BASELINE.md's benchmark
+configs: ``imagenet_rehearsal_images_per_sec_per_chip`` (SIFT->PCA->FV +
+1000-class weighted solve at VGA shapes),
+``mnist_random_fft_images_per_sec_per_chip`` (4 FFT branches, blockSize
+2048) and ``timit_frames_per_sec_per_chip`` (8x4096 cosine features, 147
+classes), each through the real app DAG on synthetic data with the
+test error recorded in the metric line.
+
 ``--solver`` runs only metric 3 (kept for compatibility).
 ``KEYSTONE_BENCH_SMALL=1`` shrinks sizes for CPU smoke-testing.
 """
@@ -269,16 +277,26 @@ def find_real_cifar10():
 
 
 def make_surrogate_cifar(n_train, n_test, seed=0):
-    """Learnable surrogate at CIFAR shapes: 10 texture prototypes, each
-    image a randomly shifted, noised, brightness-jittered view. Honest
-    stand-in for plumbing+accuracy when the real dataset is absent
-    (zero-egress image); flagged in the metric line."""
+    """Discriminative surrogate at CIFAR shapes, the honest stand-in
+    when the real dataset is absent (zero-egress image); flagged in the
+    metric line.
+
+    Built so featurization quality is what the accuracy measures: the
+    10 classes come in 5 pairs SHARING a smooth low-frequency base (so
+    raw-pixel linear models confuse the pair) and differing in
+    high-frequency texture (what whitened random patch filters pick
+    up). Images are shifted crops with gain jitter + heavy noise."""
     rng = np.random.RandomState(seed)
-    base = rng.rand(10, 40, 40, 3).astype(np.float32)
-    # smooth the prototypes so patches carry class-discriminative texture
-    for _ in range(2):
-        base = (base + np.roll(base, 1, 1) + np.roll(base, 1, 2)
-                + np.roll(base, -1, 1) + np.roll(base, -1, 2)) / 5.0
+    smooth = rng.rand(5, 40, 40, 3).astype(np.float32)
+    for _ in range(6):
+        smooth = (smooth + np.roll(smooth, 1, 1) + np.roll(smooth, 1, 2)
+                  + np.roll(smooth, -1, 1) + np.roll(smooth, -1, 2)) / 5.0
+    texture = rng.rand(10, 40, 40, 3).astype(np.float32)
+    # one sharpening pass keeps texture high-frequency
+    texture = texture - (np.roll(texture, 1, 1) + np.roll(texture, 1, 2)
+                         + np.roll(texture, -1, 1)
+                         + np.roll(texture, -1, 2)) / 4.0
+    base = smooth[np.arange(10) // 2] + 0.9 * texture
     base = (base - base.min()) / (base.max() - base.min()) * 255.0
 
     def split(n, r):
@@ -289,7 +307,7 @@ def make_surrogate_cifar(n_train, n_test, seed=0):
             crop = base[y[i], dy[i]:dy[i] + 32, dx[i]:dx[i] + 32]
             gain = 0.7 + 0.6 * r.rand()
             imgs[i] = np.clip(
-                crop * gain + 24.0 * r.randn(32, 32, 3), 0, 255)
+                crop * gain + 32.0 * r.randn(32, 32, 3), 0, 255)
         return imgs, y
 
     tr = split(n_train, np.random.RandomState(seed + 1))
@@ -326,9 +344,119 @@ def accuracy_bench():
     config = RandomCifarConfig(num_filters=num_filters, lam=10.0, seed=0)
     _, _, test_eval = run(config, train=train, test=test)
     err = float(test_eval.total_error)
+    extra = dict(dataset=dataset, num_filters=num_filters)
+    if dataset == "surrogate":
+        # context: the raw-pixel linear baseline on the same data — the
+        # surrogate is built so patch-conv featurization beats it by a
+        # wide margin; a numerics regression in the pipeline collapses
+        # the gap
+        from keystone_tpu.pipelines.images.cifar.linear_pixels import (
+            run as run_linear,
+            LinearPixelsConfig,
+        )
+
+        _, _, lin_eval = run_linear(
+            LinearPixelsConfig(lam=10.0), train=train, test=test)
+        extra["linear_pixels_test_error"] = round(
+            float(lin_eval.total_error), 4)
     _emit("cifar_randompatch_test_error", round(err, 4), "test error",
-          round(0.16 / max(err, 1e-4), 4), dataset=dataset,
-          num_filters=num_filters)
+          round(0.16 / max(err, 1e-4), 4), **extra)
+
+
+# ------------------------------------------------ TIMIT / MNIST configs
+
+
+def _clear_prefix_state():
+    """Drop cross-pipeline prefix-cache state so a timed rerun of an app
+    actually refits instead of reusing the warm run's fitted results."""
+    from keystone_tpu.workflow.env import PipelineEnv
+
+    PipelineEnv.get_or_create().clear_state()
+
+
+def timit_bench():
+    """TIMIT at the reference scale defaults (BASELINE.md: 50 x 4096
+    cosine random features over 440-dim inputs, 147 classes,
+    TimitPipeline.scala:24-35): featurize + one-epoch block solve +
+    predict, frames/sec/chip, everything device-resident. No published
+    baseline; vs_baseline against a 10k frames/sec/chip strawman."""
+    from keystone_tpu.loaders.csv_loader import LabeledData
+    from keystone_tpu.loaders.timit import TimitFeaturesData
+    from keystone_tpu.parallel.dataset import ArrayDataset
+    from keystone_tpu.pipelines.speech.timit import TimitConfig, run
+
+    n_dev = len(jax.devices())
+    n_train = 2_048 if SMALL else 32_768
+    n_test = 512 if SMALL else 4_096
+    num_cosines = 2 if SMALL else 8     # branches of 4096 features
+    k, d = 147, 440
+
+    rng = np.random.RandomState(0)
+    protos = rng.randn(k, d).astype(np.float32)  # class prototypes
+
+    def split(n, seed):
+        r = np.random.RandomState(seed)
+        y = r.randint(0, k, n)
+        X = (protos[y] + 1.5 * r.randn(n, d)).astype(np.float32)
+        return LabeledData(ArrayDataset.from_numpy(X),
+                           ArrayDataset.from_numpy(y.astype(np.int32)))
+
+    data = TimitFeaturesData(train=split(n_train, 1), test=split(n_test, 2))
+    # gamma matched to the synthetic feature scale (||x-x'||^2 ~ 2d);
+    # the app default 0.0555 is calibrated for real TIMIT features
+    config = TimitConfig(num_cosines=num_cosines, num_epochs=1, lam=1e-2,
+                         gamma=1.0 / (2 * d))
+
+    run(config, data=data)  # warm: DAG tracing + XLA compiles
+    _clear_prefix_state()   # the timed run must refit, not reuse
+    t0 = time.perf_counter()
+    _, test_eval = run(config, data=data)
+    dt = time.perf_counter() - t0
+    per_chip = (n_train + n_test) / dt / n_dev
+    _emit("timit_frames_per_sec_per_chip", round(per_chip, 1),
+          "frames/sec/chip", round(per_chip / 10_000.0, 4),
+          num_cosine_features=num_cosines * 4096,
+          test_error=round(float(test_eval.total_error), 4))
+
+
+def mnist_bench():
+    """MnistRandomFFT at the README example scale (4 FFT branches,
+    blockSize 2048, BASELINE.md): images/sec/chip through the real app
+    DAG on synthetic MNIST-shaped data."""
+    from keystone_tpu.loaders.csv_loader import LabeledData
+    from keystone_tpu.parallel.dataset import ArrayDataset
+    from keystone_tpu.pipelines.images.mnist.random_fft import (
+        MnistRandomFFTConfig,
+        run,
+    )
+
+    n_dev = len(jax.devices())
+    n_train = 2_048 if SMALL else 16_384
+    n_test = 512 if SMALL else 2_048
+
+    rng = np.random.RandomState(0)
+    protos = rng.rand(10, 784).astype(np.float32)
+
+    def split(n, seed):
+        r = np.random.RandomState(seed)
+        y = r.randint(0, 10, n)
+        X = np.clip(protos[y] + 0.35 * r.randn(n, 784), 0, 1).astype(
+            np.float32)
+        return LabeledData(ArrayDataset.from_numpy(X),
+                           ArrayDataset.from_numpy(y.astype(np.int32)))
+
+    train, test = split(n_train, 1), split(n_test, 2)
+    config = MnistRandomFFTConfig(num_ffts=4, block_size=2048, lam=1e-2)
+
+    run(config, train=train, test=test)  # warm: DAG tracing + XLA compiles
+    _clear_prefix_state()   # the timed run must refit, not reuse
+    t0 = time.perf_counter()
+    _, _, test_eval = run(config, train=train, test=test)
+    dt = time.perf_counter() - t0
+    per_chip = (n_train + n_test) / dt / n_dev
+    _emit("mnist_random_fft_images_per_sec_per_chip", round(per_chip, 1),
+          "images/sec/chip", round(per_chip / 10_000.0, 4),
+          test_error=round(float(test_eval.total_error), 4))
 
 
 # -------------------------------------------- ImageNet shape rehearsal
@@ -417,7 +545,7 @@ def main():
     import traceback
 
     for section in (featurize_bench, solver_bench, imagenet_rehearsal_bench,
-                    e2e_bench, accuracy_bench):
+                    e2e_bench, mnist_bench, timit_bench, accuracy_bench):
         try:
             section()
         except Exception:

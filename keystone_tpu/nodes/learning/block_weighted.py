@@ -63,6 +63,10 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
     ):
         if solver not in ("auto", "cholesky", "woodbury"):
             raise ValueError(f"unknown solver {solver!r}")
+        if solver == "woodbury" and lam <= 0.0:
+            # M = (1-w) pop_cov + lam I must be invertible; with lam=0 a
+            # rank-deficient pop_cov would silently produce NaN weights
+            raise ValueError("solver='woodbury' requires lam > 0")
         self.block_size = block_size
         self.num_iter = num_iter
         self.lam = lam
@@ -222,8 +226,15 @@ def _block_stats_cm(Xb, mask, counts, n, w):
 _CLASS_CHUNK_BYTES = 1 << 30
 
 
-def _class_chunk(C_pad: int, d_b: int, smodel: int) -> int:
-    per_class = 4 * d_b * d_b
+def _class_chunk(C_pad: int, d_b: int, smodel: int, S: int = 0) -> int:
+    if S:  # woodbury: per-class footprint is the rank-(S+2) factors,
+        # not a (d_b, d_b) covariance — orders of magnitude smaller, so
+        # chunks are correspondingly larger (fewer dispatches). ~6 such
+        # tensors are live at peak (Xb, Xm, V, the cho_solve input copy
+        # and result, MinvVT)
+        per_class = 4 * (S + 2) * d_b * 6
+    else:
+        per_class = 4 * d_b * d_b
     chunk = max(int(_CLASS_CHUNK_BYTES // max(per_class, 1)), 1)
     if chunk >= C_pad:
         return C_pad
@@ -264,10 +275,10 @@ def _block_pass_cm(Xb, Rcm, model, pop_mean, pop_cov, joint_means, mask,
         if pop_chol is None:
             pop_chol = _pop_cholesky(pop_cov, w, lam)
         chunk_fn = functools.partial(_chunk_solve_woodbury, pop_chol=pop_chol)
+        chunk = _class_chunk(C_pad, d_b, smodel, S=S)
     else:
         chunk_fn = functools.partial(_chunk_solve, pop_cov=pop_cov)
-
-    chunk = _class_chunk(C_pad, d_b, smodel)
+        chunk = _class_chunk(C_pad, d_b, smodel)
     deltas = []
     for a in range(0, C_pad, chunk):
         b = min(a + chunk, C_pad)

@@ -27,14 +27,14 @@ class Sampler(Transformer):
         idx.sort()
         if isinstance(ds, ArrayDataset):
             import jax
-            import jax.numpy as jnp
 
             # gather ON DEVICE: the input may be huge (e.g. every window
             # of every training image); pulling it to host to select a
             # small sample is a multi-GB transfer for a few-MB result
             idx_dev = jnp.asarray(idx)
-            gather = jax.jit(lambda x: jnp.take(x, idx_dev, axis=0))
-            data = jax.tree_util.tree_map(gather, ds.data)
+            data = jax.tree_util.tree_map(
+                lambda x: jnp.take(x, idx_dev, axis=0), ds.data
+            )
             return ArrayDataset(data, take, ds.mesh)
         items = ds.collect()
         return HostDataset([items[i] for i in idx])

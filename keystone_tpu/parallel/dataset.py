@@ -187,9 +187,7 @@ def _shard_pytree(data: Any, n: int, mesh: Mesh) -> Any:
                 raise ValueError(f"leading dim {x.shape[0]} != n={n}")
             if rows != n:
                 pad = [(0, rows - n)] + [(0, 0)] * (x.ndim - 1)
-                x = jax.jit(
-                    functools.partial(jnp.pad, pad_width=pad)
-                )(x)
+                x = jnp.pad(x, pad)  # eager: hits the persistent op cache
             return jax.device_put(x, sh)
         x = np.asarray(x)
         if x.shape[0] != n:
