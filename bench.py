@@ -520,13 +520,16 @@ def imagenet_rehearsal_bench():
     feat_dt = time.perf_counter() - t0
     per_chip = n_imgs / feat_dt / len(jax.devices())
 
-    # 1000-class weighted solve at the combined FV dimension
+    # 1000-class weighted solve at the combined FV dimension; warmed so
+    # the metric is solver time, not XLA compile time
     X = rng.randn(n_solve, d_solve).astype(np.float32)
     y = rng.randint(0, n_classes, n_solve)
     L = -np.ones((n_solve, n_classes), np.float32)
     L[np.arange(n_solve), y] = 1.0
+    est = BlockWeightedLeastSquaresEstimator(4096, 1, 6e-5, 0.25)
+    np.asarray(est.fit(X, L).weights)  # warm
     t0 = time.perf_counter()
-    model = BlockWeightedLeastSquaresEstimator(4096, 1, 6e-5, 0.25).fit(X, L)
+    model = est.fit(X, L)
     np.asarray(model.weights)
     solve_dt = time.perf_counter() - t0
 

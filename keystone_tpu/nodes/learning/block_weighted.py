@@ -279,9 +279,25 @@ def _block_pass_cm(Xb, Rcm, model, pop_mean, pop_cov, joint_means, mask,
     else:
         chunk_fn = functools.partial(_chunk_solve, pop_cov=pop_cov)
         chunk = _class_chunk(C_pad, d_b, smodel)
+
+    # uniform chunks: one compiled shape serves every chunk (a ragged
+    # tail chunk would cost a second XLA compile); the extra pad classes
+    # are all-zero and their deltas fall outside delta[:k]
+    nch = -(-C_pad // chunk)               # number of chunks
+    chunk = -(-C_pad // nch)               # evenly spread classes
+    chunk = -(-chunk // smodel) * smodel   # keep 'model'-shardable
+    total = nch * chunk
+    if total != C_pad:
+        cpad = total - C_pad
+        Xb = jnp.pad(Xb, ((0, cpad), (0, 0), (0, 0)))
+        res = jnp.pad(res, ((0, cpad), (0, 0)))
+        mask = jnp.pad(mask, ((0, cpad), (0, 0)))
+        counts = jnp.pad(counts, ((0, cpad),))
+        joint_means = jnp.pad(joint_means, ((0, cpad), (0, 0)))
+
     deltas = []
-    for a in range(0, C_pad, chunk):
-        b = min(a + chunk, C_pad)
+    for a in range(0, total, chunk):
+        b = a + chunk
         c_ids = jnp.minimum(jnp.arange(a, b), k - 1)
         deltas.append(
             chunk_fn(

@@ -1,0 +1,102 @@
+"""Map-chain fusion: collapse linear chains of per-item device nodes
+into ONE jitted stage.
+
+The TPU-native optimization SURVEY.md section 7 calls "staged jit'd
+segments": the reference pays nothing for chains of `rdd.map`s (Spark
+pipelines narrow transformations within a stage automatically); here
+each Transformer node is otherwise a separate `jit(vmap(...))` dispatch.
+Fusing a >> b >> c into one jit removes per-node dispatch latency and
+lets XLA fuse elementwise work across node boundaries into surrounding
+GEMMs — the HBM-bandwidth win.
+
+Runs after fitting too: `FittedPipeline.apply` re-optimizes its
+transformer-only graph, so fitted model chains (scaler >> linear model
+>> argmax) also fuse.
+
+Only nodes with DEFAULT dataset semantics fuse — anything overriding
+``apply_dataset`` (whole-batch GEMMs, Windower-style reshapes, host
+stages, Cacher materialization points) keeps its node boundary.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..graph import Graph
+from ..graph_ids import NodeId
+from ..transformer import HostTransformer, Transformer
+from .rule import Rule
+
+
+class FusedTransformer(Transformer):
+    """Composition of per-item transformers executed in one jit."""
+
+    def __init__(self, stages: List[Transformer]):
+        flat: List[Transformer] = []
+        for s in stages:
+            flat.extend(s.stages if isinstance(s, FusedTransformer) else [s])
+        self.stages = flat
+
+    def eq_key(self):
+        return (FusedTransformer,
+                tuple(s._cached_eq_key() for s in self.stages))
+
+    def apply(self, x):
+        for s in self.stages:
+            x = s.apply(x)
+        return x
+
+    def label(self) -> str:
+        return "Fused[" + " >> ".join(s.label() for s in self.stages) + "]"
+
+
+#: The optimizer re-runs on every bind of an unfitted pipeline; reusing
+#: the same FusedTransformer instance for the same stage chain keeps its
+#: per-instance jit cache warm across binds (a fresh instance per
+#: optimize pass would recompile the fused stage every time).
+_fusion_cache: Dict[Tuple, FusedTransformer] = {}
+
+
+def fused_transformer(stages: List[Transformer]) -> FusedTransformer:
+    fused = FusedTransformer(stages)
+    try:
+        return _fusion_cache.setdefault(fused._cached_eq_key(), fused)
+    except TypeError:  # unhashable stage key: skip memoization
+        return fused
+
+
+def _fusable(op) -> bool:
+    return (
+        isinstance(op, Transformer)
+        and not isinstance(op, HostTransformer)
+        and type(op).apply_dataset is Transformer.apply_dataset
+        and not getattr(op, "saveable", False)
+    )
+
+
+class MapFusionRule(Rule):
+    """Fuse one (producer, consumer) pair of default-semantics
+    transformers per application; a FixedPoint batch drives whole chains
+    to a single node."""
+
+    def apply(self, graph: Graph) -> Graph:
+        consumers = {}
+        for nid, deps in graph.dependencies.items():
+            for d in deps:
+                consumers.setdefault(d, set()).add(nid)
+        sink_deps = set(graph.sink_dependencies.values())
+
+        for b in sorted(graph.nodes, key=lambda n: n.id):
+            deps = graph.get_dependencies(b)
+            if len(deps) != 1 or not isinstance(deps[0], NodeId):
+                continue
+            a = deps[0]
+            op_a, op_b = graph.get_operator(a), graph.get_operator(b)
+            if not (_fusable(op_a) and _fusable(op_b)):
+                continue
+            if consumers.get(a, set()) != {b} or a in sink_deps:
+                continue  # a's output is needed elsewhere
+            fused = fused_transformer([op_a, op_b])
+            g = graph.set_operator(b, fused)
+            g = g.set_dependencies(b, graph.get_dependencies(a))
+            return g.remove_node(a)
+        return graph

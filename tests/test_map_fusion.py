@@ -1,0 +1,184 @@
+"""Map-chain fusion (workflow/optimizer/fusion.py): linear chains of
+default-semantics transformers collapse into one jitted node, without
+changing results; boundary nodes (multi-consumer, sinks, Cacher,
+apply_dataset overriders, host stages) do not fuse."""
+import numpy as np
+import pytest
+
+from keystone_tpu.nodes.util import MaxClassifier
+from keystone_tpu.parallel.dataset import ArrayDataset
+from keystone_tpu.workflow.common import Cacher
+from keystone_tpu.workflow.env import PipelineEnv
+from keystone_tpu.workflow.optimizer.fusion import (
+    FusedTransformer,
+    MapFusionRule,
+)
+from keystone_tpu.workflow.optimizer.rule import Batch, FixedPoint, Optimizer
+from keystone_tpu.workflow.transformer import (
+    HostTransformer,
+    LambdaTransformer,
+    Transformer,
+)
+
+
+def t(fn, name):
+    return LambdaTransformer(fn, name)
+
+
+class FusionOnly(Optimizer):
+    @property
+    def batches(self):
+        return [Batch("fuse", FixedPoint(100), [MapFusionRule()])]
+
+
+def fuse(graph):
+    return FusionOnly().execute(graph)
+
+
+def test_chain_fuses_to_one_node():
+    pipe = (t(lambda x: x + 1, "a") >> t(lambda x: x * 2, "b")
+            >> t(lambda x: x - 3, "c"))
+    g = fuse(pipe.graph)
+    assert len(g.nodes) == 1
+    (op,) = [g.get_operator(n) for n in g.nodes]
+    assert isinstance(op, FusedTransformer)
+    assert [s.label() for s in op.stages] == ["a", "b", "c"]
+    # semantics preserved, batch and datum paths
+    ds = ArrayDataset.from_numpy(np.arange(8.0).reshape(8, 1))
+    fitted = pipe.fit()
+    out = np.asarray(fitted.apply(ds).get().numpy())
+    np.testing.assert_allclose(out, (np.arange(8.0).reshape(8, 1) + 1) * 2 - 3)
+    assert float(fitted.apply_datum(np.array([5.0])).get()) == (5 + 1) * 2 - 3
+
+
+def test_multi_consumer_not_fused():
+    """After CSE merges the shared prefix (as DefaultOptimizer does
+    before fusing), the two-consumer node must NOT fuse into either
+    branch — that would recompute it."""
+    from keystone_tpu.workflow.optimizer.rules import EquivalentNodeMergeRule
+    from keystone_tpu.workflow.pipeline import Pipeline
+
+    class CseThenFuse(Optimizer):
+        @property
+        def batches(self):
+            return [
+                Batch("cse", FixedPoint(100), [EquivalentNodeMergeRule()]),
+                Batch("fuse", FixedPoint(100), [MapFusionRule()]),
+            ]
+
+    a = t(lambda x: x + 1, "a").to_pipeline()
+    b = a >> t(lambda x: x * 2, "b")
+    c = a >> t(lambda x: x * 3, "c")
+    both = Pipeline.gather([b, c])
+    g = CseThenFuse().execute(both.graph)
+    labels = sorted(op.label() for op in
+                    (g.get_operator(n) for n in g.nodes))
+    assert "a" in labels  # shared prefix kept as its own node
+    assert "b" in labels and "c" in labels
+
+
+def test_cacher_breaks_chain():
+    pipe = (t(lambda x: x + 1, "a") >> Cacher("mid")
+            >> t(lambda x: x * 2, "b"))
+    g = fuse(pipe.graph)
+    kinds = [type(g.get_operator(n)).__name__ for n in g.nodes]
+    assert "Cacher" in kinds
+    assert len(g.nodes) == 3  # nothing fused across the cache point
+
+
+def test_host_transformer_not_fused():
+    class H(HostTransformer):
+        def apply(self, x):
+            return x + 1
+
+    pipe = t(lambda x: x * 2, "a") >> H()
+    g = fuse(pipe.graph)
+    assert len(g.nodes) == 2
+
+
+def test_fused_eq_key_enables_cse():
+    # same underlying stage objects -> equal keys (CSE can merge);
+    # different stages -> different keys
+    a, b, c = t(lambda x: x, "a"), t(lambda x: x, "b"), t(lambda x: x, "c")
+    assert (FusedTransformer([a, b]).eq_key()
+            == FusedTransformer([a, b]).eq_key())
+    assert (FusedTransformer([a, b]).eq_key()
+            != FusedTransformer([a, c]).eq_key())
+
+
+def test_fused_instance_reused_across_binds():
+    """The optimizer re-runs per bind; the SAME FusedTransformer object
+    (and so its warm jit cache) must come back for the same chain."""
+    from keystone_tpu.workflow.optimizer.fusion import fused_transformer
+
+    a, b = t(lambda x: x + 1, "a"), t(lambda x: x * 2, "b")
+    assert fused_transformer([a, b]) is fused_transformer([a, b])
+
+    pipe = a >> b
+    ds = ArrayDataset.from_numpy(np.ones((4, 1)))
+    ops1 = _fused_ops_of_bound(pipe, ds)
+    ops2 = _fused_ops_of_bound(pipe, ds)
+    assert ops1 and ops1 == ops2  # same instances, not fresh copies
+
+
+def _fused_ops_of_bound(pipe, ds):
+    bound = pipe.apply(ds)
+    bound.get()
+    g = bound._executor.graph  # optimized graph
+    ops = [g.get_operator(n) for n in sorted(g.nodes, key=lambda n: n.id)]
+    return [op for op in ops if isinstance(op, FusedTransformer)]
+
+
+def test_default_optimizer_matches_noop_end_to_end():
+    """Full app parity: default optimizer (with fusion) == NoOpOptimizer."""
+    from keystone_tpu.nodes.learning import BlockLeastSquaresEstimator
+    from keystone_tpu.nodes.stats import StandardScaler
+    from keystone_tpu.nodes.util import ClassLabelIndicatorsFromIntLabels
+    from keystone_tpu.workflow.optimizer.default import NoOpOptimizer
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 12).astype(np.float32)
+    y = rng.randint(0, 4, 64)
+    ds = ArrayDataset.from_numpy(X)
+    labels = ClassLabelIndicatorsFromIntLabels(4).apply_dataset(
+        ArrayDataset.from_numpy(y.astype(np.int32)))
+
+    def build():
+        feat = (t(lambda x: x * 2.0, "scale")
+                >> t(lambda x: x + 1.0, "shift")
+                >> t(lambda x: np.tanh(1) * x, "gain"))
+        return (feat.and_then(StandardScaler(), ds)
+                .and_then(BlockLeastSquaresEstimator(8, 1, 0.1), ds, labels)
+                >> MaxClassifier())
+
+    env = PipelineEnv.get_or_create()
+    preds = {}
+    for name, opt in (("noop", NoOpOptimizer()), ("default", None)):
+        env.clear_state()
+        if opt is not None:
+            env.set_optimizer(opt)
+        else:
+            from keystone_tpu.workflow.optimizer.default import (
+                DefaultOptimizer,
+            )
+
+            env.set_optimizer(DefaultOptimizer())
+        fitted = build().fit()
+        preds[name] = np.asarray(fitted.apply(ds).get().numpy())
+    np.testing.assert_array_equal(preds["noop"], preds["default"])
+
+
+def test_fitted_pipeline_fuses_model_chain():
+    """After fit(), the transformer-only graph fuses scaler-like chains
+    downstream of the (formerly) estimator node."""
+    pipe = (t(lambda x: x + 1, "a")
+            >> t(lambda x: x * 2, "b")
+            >> t(lambda x: x - 1, "c")
+            >> t(lambda x: x / 2, "d"))
+    fitted = pipe.fit()
+    bound = fitted.apply(ArrayDataset.from_numpy(np.ones((4, 2))))
+    out = np.asarray(bound.get().numpy())
+    np.testing.assert_allclose(out, ((1 + 1) * 2 - 1) / 2 * np.ones((4, 2)))
+    fused = _fused_ops_of_bound(fitted.to_pipeline(),
+                                ArrayDataset.from_numpy(np.ones((4, 2))))
+    assert len(fused) == 1 and len(fused[0].stages) == 4
