@@ -54,18 +54,48 @@ def decode_image(data: bytes) -> Optional[np.ndarray]:
         return None
 
 
-def list_archive_paths(data_path: str) -> List[str]:
+def list_archive_paths(data_path: str, process_shard: bool = True) -> List[str]:
     """All non-directory files under a path (reference
     ``ImageLoaderUtils.getFilePathsRDD`` filters only directories).
     Non-archive files (labels.txt, READMEs) routinely sit alongside the
-    archives; :func:`load_tar_files` skips them at open time."""
+    archives; :func:`load_tar_files` skips them at open time.
+
+    On a multi-host (SPMD) run each process keeps its
+    ``process_index``-strided share of the archives — the analogue of
+    HDFS splits landing on different executors (CLUSTER.md "Data").
+    ``process_shard=False`` returns the full global listing.
+    """
     if os.path.isfile(data_path):
-        return [data_path]
-    return sorted(
-        os.path.join(data_path, f)
-        for f in os.listdir(data_path)
-        if os.path.isfile(os.path.join(data_path, f))
-    )
+        paths = [data_path]
+    else:
+        paths = sorted(
+            os.path.join(data_path, f)
+            for f in os.listdir(data_path)
+            if os.path.isfile(os.path.join(data_path, f))
+        )
+    if process_shard:
+        import jax
+
+        pc = jax.process_count()
+        if pc > 1:
+            # stride over actual archives only — READMEs/labels.txt in
+            # the sorted listing must not skew which host gets which
+            # share (they'd be skipped at open time anyway)
+            archives = [p for p in paths if p.endswith(
+                (".tar", ".tar.gz", ".tgz", ".tar.bz2"))]
+            mine = archives[jax.process_index()::pc]
+            if not mine:
+                # an empty share would surface as a collective hang or a
+                # shape mismatch far from here — fail at the loader
+                raise ValueError(
+                    f"host {jax.process_index()}/{pc} has no archives: "
+                    f"only {len(archives)} archive(s) under "
+                    f"{data_path!r}. Repack the data into >= "
+                    "process_count archives, or pass process_shard="
+                    "False to load everything on each host."
+                )
+            paths = mine
+    return paths
 
 
 def iter_tar_images(

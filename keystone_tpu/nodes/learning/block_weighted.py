@@ -60,6 +60,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         mixture_weight: float,
         num_features: Optional[int] = None,
         solver: str = "auto",
+        checkpoint_path: Optional[str] = None,
     ):
         if solver not in ("auto", "cholesky", "woodbury"):
             raise ValueError(f"unknown solver {solver!r}")
@@ -73,6 +74,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         self.mixture_weight = mixture_weight
         self.num_features = num_features
         self.solver = solver
+        self.checkpoint_path = checkpoint_path
 
     @property
     def weight(self) -> int:
@@ -132,10 +134,36 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         block_stats: List[Optional[tuple]] = [None] * len(bounds)
         block_chols: List[Optional[jax.Array]] = [None] * len(bounds)
 
-        for pass_idx in range(self.num_iter):
+        # per-pass checkpoint/resume (CLUSTER.md failure-recovery story)
+        ckpt = None
+        start_pass = 0
+        if self.checkpoint_path:
+            from ...utils.checkpoint import SolverCheckpoint
+
+            ckpt = SolverCheckpoint(self.checkpoint_path)
+            # untagged datasets get a cheap content fingerprint so a
+            # stale checkpoint from *different* data of the same shape
+            # can never warm-start this solve
+            ds_id = ds.tag or _data_fingerprint(Xcm)
+            labels_id = labels.tag or _data_fingerprint(Rcm)
+            ckpt_key = (n, d, n_classes, bs, self.num_iter, float(lam),
+                        float(w), self.solver, ds_id, labels_id)
+            saved = ckpt.load(
+                ckpt_key,
+                model_shapes=[(hi - lo, n_classes) for lo, hi in bounds])
+            if saved is not None and saved["pass"] + 1 < self.num_iter:
+                models = [jnp.asarray(m) for m in saved["models"]]
+                start_pass = saved["pass"] + 1
+                # rebuild the residual from the restored model: the loop
+                # invariant is Rcm = Rcm0 - sum_b Xb @ models[b] (masked)
+                for b, (lo, hi) in enumerate(bounds):
+                    Rcm = _update_residual_cm(
+                        Rcm, Xcm[:, :, lo:hi], models[b], mask_cm)
+
+        for pass_idx in range(start_pass, self.num_iter):
             for b, (lo, hi) in enumerate(bounds):
                 Xb = Xcm[:, :, lo:hi]
-                if pass_idx == 0:
+                if block_stats[b] is None:
                     block_stats[b] = _block_stats_cm(
                         Xb, mask_cm, counts_f, n, w
                     )
@@ -159,6 +187,13 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 )
                 models[b] = models[b] + delta
                 Rcm = _update_residual_cm(Rcm, Xb, delta, mask_cm)
+            if ckpt is not None and pass_idx + 1 < self.num_iter:
+                # a final-pass checkpoint has no consumer (resume needs
+                # pass+1 < num_iter) — skip the write, and clear the
+                # file once the solve completes
+                ckpt.save(ckpt_key, pass_idx, models)
+        if ckpt is not None:
+            ckpt.clear()
 
         W_blocks = [np.asarray(m) for m in models]
         # joint feature means per class, assembled across blocks: (C, d)
@@ -172,6 +207,21 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         )
 
 
+
+
+@jax.jit
+def _fingerprint_moments(arr):
+    # one fused pass; XLA reduces in-register, no full-size temporaries
+    return (jnp.sum(arr), jnp.sum(jnp.square(arr)), jnp.sum(jnp.abs(arr)))
+
+
+def _data_fingerprint(arr: jax.Array) -> str:
+    """Cheap content identity for checkpoint keys: three global moments
+    of the (sharded) array, fused into one jitted pass over data already
+    resident in HBM. Two same-shape datasets colliding on all three to
+    full f32 precision is vanishingly unlikely."""
+    s, s2, sa = _fingerprint_moments(arr)
+    return f"fp:{float(s):.8e}:{float(s2):.8e}:{float(sa):.8e}"
 
 
 def _class_major_perm(class_idx, counts, n_classes, mesh):

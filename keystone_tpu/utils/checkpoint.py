@@ -21,6 +21,8 @@ from __future__ import annotations
 import pickle
 from typing import Any, Dict
 
+import numpy as np
+
 from ..workflow.env import PipelineEnv
 from ..workflow.expression import TransformerExpression
 from ..workflow.pipeline import FittedPipeline
@@ -65,3 +67,102 @@ def load_state(path: str) -> int:
         env.state[prefix] = TransformerExpression(
             lambda t=transformer: t)
     return len(saved)
+
+
+# -- per-pass solver checkpointing ----------------------------------------
+
+
+class SolverCheckpoint:
+    """Per-pass checkpoint/resume for long block solvers (the
+    CLUSTER.md failure-recovery story: the reference leaned on Spark
+    task retry + lineage; a gang-scheduled TPU step restarts from the
+    last completed BCD pass instead).
+
+    The checkpoint holds only the model blocks + pass index — residuals
+    are rebuilt from the model on resume (one masked GEMM per block),
+    so checkpoint size is O(d*k), not O(n*k). Writes are atomic
+    (tmp + rename). ``key`` must identify the problem; mismatched keys
+    are ignored so a stale file can never poison a different solve.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def load(self, key, model_shapes=None) -> "dict | None":
+        """Return ``{"pass": int, "models": [...]}`` or ``None``.
+
+        On a multi-host run every process MUST take the same resume
+        decision or they issue different collective sequences and
+        deadlock, so process 0 (the only writer) is authoritative: its
+        pass index and model blocks are broadcast in one collective.
+        ``model_shapes`` (one ``(rows, cols)`` per block) is required
+        there so hosts without a readable file can stage placeholder
+        leaves of the right structure.
+        """
+        import os
+
+        import jax
+
+        d = None
+        if os.path.exists(self.path):
+            try:
+                with open(self.path, "rb") as f:
+                    d = pickle.load(f)
+                if not isinstance(d, dict) or d.get("key") != key:
+                    d = None
+            except Exception:
+                d = None
+
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            if model_shapes is None:
+                raise ValueError(
+                    "model_shapes is required for multi-host load()")
+            authoritative = jax.process_index() == 0 and d is not None
+            payload = {
+                "pass": np.int32(d["pass"] if authoritative else -1),
+                "models": (
+                    [np.asarray(m, np.float32) for m in d["models"]]
+                    if authoritative else
+                    [np.zeros(s, np.float32) for s in model_shapes]),
+            }
+            out = multihost_utils.broadcast_one_to_all(payload)
+            if int(out["pass"]) < 0:
+                return None
+            return {"pass": int(out["pass"]),
+                    "models": [np.asarray(m) for m in out["models"]]}
+        return d
+
+    def save(self, key, pass_idx: int, models) -> None:
+        import os
+
+        import jax
+
+        # multi-host: every process runs the solver loop over the same
+        # replicated models, so only process 0 persists — concurrent
+        # writers on a shared filesystem would interleave bytes. The
+        # pid-suffixed tmp also keeps two local runs from clobbering
+        # each other's in-flight file.
+        if jax.process_index() != 0:
+            return
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump(
+                {"key": key, "pass": pass_idx,
+                 "models": [np.asarray(m) for m in models]}, f)
+        os.replace(tmp, self.path)
+
+    def clear(self) -> None:
+        """Remove the checkpoint after a successful solve so a stale
+        file never lingers at the path (process 0 only)."""
+        import os
+
+        import jax
+
+        if jax.process_index() != 0:
+            return
+        try:
+            os.remove(self.path)
+        except OSError:
+            pass

@@ -113,3 +113,87 @@ def test_graph_to_dot(mesh8):
     pipe, X = _fit_toy(mesh8)
     dot = pipe.to_pipeline()._graph.to_dot("test")
     assert "digraph" in dot and "->" in dot
+
+
+def test_weighted_solver_checkpoint_resume(tmp_path, monkeypatch):
+    """Per-pass checkpoint/resume (CLUSTER.md failure-recovery story):
+    a solve crashed mid-pass resumes from the last completed pass and
+    lands on the same solution as an uninterrupted run; stale or
+    mismatched checkpoints are ignored; a completed solve leaves no
+    checkpoint file behind."""
+    import os
+    import pickle
+
+    import numpy as np
+    import pytest
+
+    from keystone_tpu.nodes.learning.block_weighted import (
+        BlockWeightedLeastSquaresEstimator,
+    )
+    from keystone_tpu.utils.checkpoint import SolverCheckpoint
+
+    rng = np.random.RandomState(0)
+    n, d, k = 200, 24, 4
+    X = rng.randn(n, d).astype(np.float32)
+    y = rng.randint(0, k, n)
+    L = (-np.ones((n, k)) + 2 * np.eye(k)[y]).astype(np.float32)
+    path = str(tmp_path / "solver.ckpt")
+    kw = dict(block_size=8, num_iter=4, lam=0.2, mixture_weight=0.3)
+
+    full = BlockWeightedLeastSquaresEstimator(**kw).fit_arrays(X, L)
+
+    # crash the solve during pass 2 (after the pass-1 checkpoint lands)
+    real_save = SolverCheckpoint.save
+
+    def crash_after_pass_1(self, key, pass_idx, models):
+        real_save(self, key, pass_idx, models)
+        if pass_idx == 1:
+            raise RuntimeError("simulated preemption")
+
+    def fit_crashing(X_, L_):
+        monkeypatch.setattr(SolverCheckpoint, "save", crash_after_pass_1)
+        try:
+            with pytest.raises(RuntimeError, match="simulated preemption"):
+                BlockWeightedLeastSquaresEstimator(
+                    **kw, checkpoint_path=path).fit_arrays(X_, L_)
+        finally:
+            monkeypatch.setattr(SolverCheckpoint, "save", real_save)
+
+    fit_crashing(X, L)
+    with open(path, "rb") as f:
+        assert pickle.load(f)["pass"] == 1
+
+    # resume with the identical config -> same solution as uninterrupted
+    resumed = BlockWeightedLeastSquaresEstimator(
+        **kw, checkpoint_path=path).fit_arrays(X, L)
+    np.testing.assert_allclose(
+        np.asarray(full.weights), np.asarray(resumed.weights),
+        rtol=1e-4, atol=1e-4)
+    # a completed solve clears its checkpoint
+    assert not os.path.exists(path)
+
+    # mismatched key -> ignored, fresh fit still correct
+    with open(path, "wb") as f:
+        pickle.dump({"key": ("bogus",), "pass": 0, "models": []}, f)
+    fresh = BlockWeightedLeastSquaresEstimator(
+        **kw, checkpoint_path=path).fit_arrays(X, L)
+    np.testing.assert_allclose(
+        np.asarray(full.weights), np.asarray(fresh.weights),
+        rtol=1e-4, atol=1e-4)
+
+    # non-dict pickle at the path -> ignored, not a crash
+    with open(path, "wb") as f:
+        pickle.dump([1, 2, 3], f)
+    BlockWeightedLeastSquaresEstimator(
+        **kw, checkpoint_path=path).fit_arrays(X, L)
+
+    # same shapes, DIFFERENT data -> content fingerprint rejects the
+    # stale mid-way checkpoint; the fit must match a from-scratch solve
+    fit_crashing(X, L)  # mid-way ckpt (pass 1 of 4) for data X
+    X2 = rng.randn(n, d).astype(np.float32)
+    clean = BlockWeightedLeastSquaresEstimator(**kw).fit_arrays(X2, L)
+    poisoned = BlockWeightedLeastSquaresEstimator(
+        **kw, checkpoint_path=path).fit_arrays(X2, L)
+    np.testing.assert_allclose(
+        np.asarray(clean.weights), np.asarray(poisoned.weights),
+        rtol=1e-4, atol=1e-4)

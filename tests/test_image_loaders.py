@@ -182,3 +182,30 @@ def test_cifar_packed_pipeline_parity(tmp_path):
         model = LinearMapEstimator(lam=10.0).fit(feat, labels)
         preds[packed] = np.asarray(model.apply_dataset(feat).numpy())
     np.testing.assert_allclose(preds[False], preds[True], rtol=1e-4, atol=1e-4)
+
+
+def test_archive_listing_host_strided(tmp_path, monkeypatch):
+    """Multi-host SPMD: each process lists its strided share of the
+    archives (CLUSTER.md 'Data'); single-host sees everything."""
+    import jax
+
+    from keystone_tpu.loaders.image_loader_utils import list_archive_paths
+
+    for i in range(5):
+        (tmp_path / f"shard{i}.tar").write_bytes(b"x")
+    all_paths = list_archive_paths(str(tmp_path))
+    assert len(all_paths) == 5
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    mine = list_archive_paths(str(tmp_path))
+    assert [p.split("shard")[1] for p in mine] == ["1.tar", "3.tar"]
+    assert len(list_archive_paths(str(tmp_path), process_shard=False)) == 5
+
+    # fewer archives than hosts -> loud failure at the loader, not a
+    # collective hang downstream
+    import pytest
+
+    monkeypatch.setattr(jax, "process_count", lambda: 8)
+    with pytest.raises(ValueError, match="no archives"):
+        list_archive_paths(str(tmp_path / "shard0.tar"))
