@@ -195,15 +195,18 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         if ckpt is not None:
             ckpt.clear()
 
-        W_blocks = [np.asarray(m) for m in models]
-        # joint feature means per class, assembled across blocks: (C, d)
-        joint_means_all = np.concatenate(
-            [np.asarray(s[2])[:n_classes] for s in block_stats], axis=1
+        # everything stays on device: materializing (d, C) weights to
+        # host here costs a multi-second d2h at ImageNet scale, and
+        # apply() consumes them on device anyway
+        W_blocks = models
+        # intercept from per-block sums — no concatenated (d, C) copy
+        # of the joint means or weights is ever materialized
+        final_b = jnp.asarray(joint_label_mean) - sum(
+            jnp.sum(s[2][:n_classes].T * m, axis=0)
+            for s, m in zip(block_stats, W_blocks)
         )
-        W_full = np.concatenate(W_blocks, axis=0)  # (d, C)
-        final_b = joint_label_mean - np.sum(joint_means_all.T * W_full, axis=0)
         return BlockLinearMapper(
-            W_blocks, bs, intercept=final_b.astype(np.float32)
+            W_blocks, bs, intercept=final_b.astype(jnp.float32)
         )
 
 
@@ -324,10 +327,8 @@ def _block_pass_cm(Xb, Rcm, model, pop_mean, pop_cov, joint_means, mask,
     if solver == "woodbury":
         if pop_chol is None:
             pop_chol = _pop_cholesky(pop_cov, w, lam)
-        chunk_fn = functools.partial(_chunk_solve_woodbury, pop_chol=pop_chol)
         chunk = _class_chunk(C_pad, d_b, smodel, S=S)
     else:
-        chunk_fn = functools.partial(_chunk_solve, pop_cov=pop_cov)
         chunk = _class_chunk(C_pad, d_b, smodel)
 
     # uniform chunks: one compiled shape serves every chunk (a ragged
@@ -336,6 +337,29 @@ def _block_pass_cm(Xb, Rcm, model, pop_mean, pop_cov, joint_means, mask,
     nch = -(-C_pad // chunk)               # number of chunks
     chunk = -(-C_pad // nch)               # evenly spread classes
     chunk = -(-chunk // smodel) * smodel   # keep 'model'-shardable
+    delta = _block_pass_chunked(
+        Xb, res, mask, counts, joint_means, model, pop_xtr,
+        residual_mean, pop_mean, pop_cov if solver == "cholesky"
+        else pop_chol, w, lam,
+        n=n, k=k, chunk=chunk, nch=nch, solver=solver)
+    # pop_chol returned for caller-side caching: M is pass-invariant, so
+    # multi-pass fits factor it once per block
+    return delta, pop_chol                                # (d_b, k)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n", "k", "chunk", "nch", "solver"))
+def _block_pass_chunked(Xb, res, mask, counts, joint_means, model,
+                        pop_xtr, residual_mean, pop_mean, pop_factor,
+                        w, lam, *, n, k, chunk, nch, solver):
+    """All per-class chunk solves of one block pass in ONE dispatch:
+    a Python loop of per-chunk jit calls pays a host round-trip per
+    chunk (seconds of pure latency per pass through a dev tunnel, and
+    needless dispatch overhead anywhere); ``lax.map`` keeps the
+    chunk-at-a-time HBM bound while the whole pass compiles once.
+    ``pop_factor`` is the population Cholesky factor (woodbury) or the
+    population covariance (cholesky)."""
+    C_pad, S, d_b = Xb.shape
     total = nch * chunk
     if total != C_pad:
         cpad = total - C_pad
@@ -345,30 +369,33 @@ def _block_pass_cm(Xb, Rcm, model, pop_mean, pop_cov, joint_means, mask,
         counts = jnp.pad(counts, ((0, cpad),))
         joint_means = jnp.pad(joint_means, ((0, cpad), (0, 0)))
 
-    deltas = []
-    for a in range(0, total, chunk):
-        b = a + chunk
-        c_ids = jnp.minimum(jnp.arange(a, b), k - 1)
-        deltas.append(
-            chunk_fn(
-                Xb[a:b],
-                res[a:b],
-                mask[a:b],
-                counts[a:b],
-                joint_means[a:b],
-                jnp.take(model, c_ids, axis=1).T,
-                jnp.take(pop_xtr, c_ids, axis=1).T,
-                jnp.take(residual_mean, c_ids),
-                pop_mean,
-                n=n,
-                w=w,
-                lam=lam,
-            )
-        )
-    delta = jnp.concatenate(deltas, axis=0)               # (C_pad, d_b)
-    # pop_chol returned for caller-side caching: M is pass-invariant, so
-    # multi-pass fits factor it once per block
-    return delta[:k].T, pop_chol                          # (d_b, k)
+    c_ids = jnp.minimum(jnp.arange(total), k - 1)
+    model_t = jnp.take(model, c_ids, axis=1).T            # (total, d_b)
+    pop_xtr_t = jnp.take(pop_xtr, c_ids, axis=1).T        # (total, d_b)
+    rmean_t = jnp.take(residual_mean, c_ids)              # (total,)
+
+    def body(args):
+        (Xc, resc, maskc, cntc, jmc, mc, pxc, rmc) = args
+        if solver == "woodbury":
+            return _chunk_solve_woodbury(
+                Xc, resc, maskc, cntc, jmc, mc, pxc, rmc, pop_mean,
+                pop_factor, n=n, w=w, lam=lam)
+        return _chunk_solve(
+            Xc, resc, maskc, cntc, jmc, mc, pxc, rmc, pop_mean,
+            pop_factor, n=n, w=w, lam=lam)
+
+    stacked = (
+        Xb.reshape(nch, chunk, S, d_b),
+        res.reshape(nch, chunk, S),
+        mask.reshape(nch, chunk, S),
+        counts.reshape(nch, chunk),
+        joint_means.reshape(nch, chunk, d_b),
+        model_t.reshape(nch, chunk, d_b),
+        pop_xtr_t.reshape(nch, chunk, d_b),
+        rmean_t.reshape(nch, chunk),
+    )
+    delta = jax.lax.map(body, stacked)                    # (nch, chunk, d_b)
+    return delta.reshape(total, d_b)[:k].T                # (d_b, k)
 
 
 @jax.jit

@@ -22,6 +22,26 @@ from ...workflow.transformer import Transformer
 from ..stats import StandardScalerModel
 
 
+def _array_token(a):
+    """Device-cheap content identity for ``eq_key``: shape + dtype +
+    three global moments (a 12-byte pull) instead of serializing the
+    whole array — the default ``tobytes`` key would drag a fitted
+    (d, C) model through d2h just to hash it during fusion/CSE. A
+    collision needs identical shape AND identical f32 sum /
+    sum-of-squares / sum-of-abs; its only consequence is CSE or the
+    fusion cache merging two indistinguishable models."""
+    if a is None:
+        return None
+    arr = jnp.asarray(a)
+    return (
+        arr.shape,
+        str(arr.dtype),
+        float(jnp.sum(arr)),
+        float(jnp.sum(jnp.square(arr))),
+        float(jnp.sum(jnp.abs(arr))),
+    )
+
+
 class LinearMapper(Transformer):
     """out = x_model^T in (+ b), with optional feature scaler
     (reference ``LinearMapper.scala:18-62``)."""
@@ -32,9 +52,26 @@ class LinearMapper(Transformer):
         intercept: Optional[np.ndarray] = None,
         feature_scaler: Optional[StandardScalerModel] = None,
     ):
-        self.weights = np.asarray(weights)
-        self.intercept = None if intercept is None else np.asarray(intercept)
+        # host or device arrays, kept as handed in (see BlockLinearMapper)
+        self.weights = weights
+        self.intercept = intercept
         self.feature_scaler = feature_scaler
+
+    def __getstate__(self):
+        d = super().__getstate__()  # strips per-instance jit caches
+        d["weights"] = np.asarray(self.weights)
+        if d["intercept"] is not None:
+            d["intercept"] = np.asarray(d["intercept"])
+        return d
+
+    def eq_key(self):
+        return (
+            LinearMapper,
+            _array_token(self.weights),
+            _array_token(self.intercept),
+            None if self.feature_scaler is None
+            else self.feature_scaler._cached_eq_key(),
+        )
 
     def apply(self, x):
         if self.feature_scaler is not None:
@@ -57,13 +94,10 @@ class LinearMapEstimator(LabelEstimator):
         ds, labels = ensure_array(ds), ensure_array(labels)
         n = ds.n
         X, Y = ds.data, labels.data
-        x_mean = np.asarray(linalg.distributed_mean(X, n))
-        y_mean = np.asarray(linalg.distributed_mean(Y, n))
-        W = np.asarray(
-            _centered_normal_equations(
-                X, Y, jnp.asarray(x_mean), jnp.asarray(y_mean),
-                ds.mask, float(self.lam or 0.0),
-            )
+        x_mean = linalg.distributed_mean(X, n)
+        y_mean = linalg.distributed_mean(Y, n)
+        W = _centered_normal_equations(
+            X, Y, x_mean, y_mean, ds.mask, float(self.lam or 0.0)
         )
         return LinearMapper(
             W,
@@ -142,23 +176,47 @@ class BlockLinearMapper(Transformer):
         block_size: int,
         intercept: Optional[np.ndarray] = None,
         feature_means: Optional[np.ndarray] = None,
+        weights: Optional[np.ndarray] = None,
     ):
-        self.block_weights = [np.asarray(w) for w in block_weights]
+        # blocks are kept as handed in (host OR device arrays): forcing
+        # np.asarray here would drag freshly-fitted device weights to
+        # host — a multi-second d2h for ImageNet-scale (d x 1000)
+        # models — only for apply() to ship them straight back.
+        # ``weights`` lets a caller that already assembled the full
+        # matrix skip the concat copy.
+        self.block_weights = list(block_weights)
         self.block_size = block_size
-        self.intercept = None if intercept is None else np.asarray(intercept)
-        self.feature_means = (
-            None if feature_means is None else np.asarray(feature_means)
-        )
-        self.weights = np.concatenate(self.block_weights, axis=0)
+        self.intercept = intercept
+        self.feature_means = feature_means
+        if weights is not None:
+            self.weights = weights
+        else:
+            concat = (
+                jnp.concatenate
+                if any(isinstance(w, jax.Array) for w in self.block_weights)
+                else np.concatenate
+            )
+            self.weights = concat(self.block_weights, axis=0)
 
     def eq_key(self):
         return (
             BlockLinearMapper,
             self.block_size,
-            self.weights.tobytes(),
-            None if self.intercept is None else self.intercept.tobytes(),
-            None if self.feature_means is None else self.feature_means.tobytes(),
+            _array_token(self.weights),
+            _array_token(self.intercept),
+            _array_token(self.feature_means),
         )
+
+    def __getstate__(self):
+        # device arrays pickle as host copies (checkpoint/FittedPipeline
+        # serialization); super() strips per-instance jit caches
+        d = super().__getstate__()
+        d["block_weights"] = [np.asarray(w) for w in self.block_weights]
+        d["weights"] = np.asarray(self.weights)
+        for f in ("intercept", "feature_means"):
+            if d[f] is not None:
+                d[f] = np.asarray(d[f])
+        return d
 
     def apply(self, x):
         if self.feature_means is not None:
@@ -233,23 +291,22 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         bounds = [(i, min(d, i + bs)) for i in range(0, d, bs)]
 
         X, Y = ds.data, labels.data
-        x_mean = np.asarray(linalg.distributed_mean(X, n))
-        y_mean = np.asarray(linalg.distributed_mean(Y, n))
+        x_mean = linalg.distributed_mean(X, n)
+        y_mean = linalg.distributed_mean(Y, n)
         Ws = _block_solve(
             X,
             Y,
-            jnp.asarray(x_mean),
-            jnp.asarray(y_mean),
+            x_mean,
+            y_mean,
             ds.mask,
             float(self.lam),
             tuple(bounds),
             self.num_iter,
         )
-        block_ws = [np.asarray(w) for w in Ws]
-        W = np.concatenate(block_ws, axis=0)
+        # blocks stay device-resident (see BlockLinearMapper.__init__)
         intercept = y_mean  # apply() centers x by the means, so b = y_mean
         return BlockLinearMapper(
-            block_ws, bs, intercept=intercept, feature_means=x_mean
+            list(Ws), bs, intercept=intercept, feature_means=x_mean
         )
 
     def cost(self, n, d, k, sparsity, num_machines, cpu_w, mem_w, net_w) -> float:

@@ -88,27 +88,38 @@ class PerClassWeightedLeastSquaresEstimator(LabelEstimator):
         jfm = (w * class_means + (1 - w) * pop_mean).astype(np.float32)
         joint_label_mean = (counts / n) * 2.0 * (1 - w) - 1.0 + 2.0 * w
 
-        models = np.zeros((d, n_classes), np.float32)
+        # per-class solutions accumulate on DEVICE; a per-class
+        # np.asarray would pay n_classes separate d2h transfers
+        cols = []
         for c in range(n_classes):
             onehot_c = _class_indicator(cls_dev, c, mask)
             b_c = mask * np.float32((1 - w) / n) + onehot_c * np.float32(
                 w / counts[c]
             )
             y_c = (L[:, c] - np.float32(joint_label_mean[c])) * mask
-            W_c = _solve_single_class(
-                X,
-                b_c,
-                y_c,
-                jnp.asarray(jfm[c]),
-                jnp.float32(self.lam),
-                bounds,
-                self.num_iter,
+            cols.append(
+                _solve_single_class(
+                    X,
+                    b_c,
+                    y_c,
+                    jnp.asarray(jfm[c]),
+                    jnp.float32(self.lam),
+                    bounds,
+                    self.num_iter,
+                )
             )
-            models[:, c] = np.asarray(W_c)
+        models = jnp.stack(cols, axis=1)  # (d, n_classes)
 
         blocks = [models[lo:hi] for lo, hi in bounds]
-        final_b = joint_label_mean - np.sum(jfm.T * models, axis=0)
-        return BlockLinearMapper(blocks, bs, intercept=final_b.astype(np.float32))
+        final_b = (
+            jnp.asarray(joint_label_mean)
+            - jnp.sum(jnp.asarray(jfm).T * models, axis=0)
+        )
+        # pass the assembled matrix through so the mapper does not
+        # re-concatenate the block views into a second (d, C) copy
+        return BlockLinearMapper(
+            blocks, bs, intercept=final_b.astype(jnp.float32),
+            weights=models)
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
