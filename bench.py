@@ -29,7 +29,8 @@ configs: ``imagenet_rehearsal_images_per_sec_per_chip`` (SIFT->PCA->FV +
 classes), each through the real app DAG on synthetic data with the
 test error recorded in the metric line.
 
-``--solver`` runs only metric 3 (kept for compatibility).
+``--solver``/``--featurize``/``--e2e``/``--imagenet``/``--accuracy``
+run a single section.
 ``KEYSTONE_BENCH_SMALL=1`` shrinks sizes for CPU smoke-testing.
 """
 from __future__ import annotations
@@ -116,20 +117,29 @@ def build_bench(num_filters=1024, patch_size=6, alpha=0.25):
 def featurize_bench():
     n_dev = len(jax.devices())
     batch = 256 if SMALL else 1024
-    imgs = np.random.RandomState(1).rand(batch, 32, 32, 3).astype(np.float32) * 255
-    imgs = jax.device_put(imgs)
+    iters = 3 if SMALL else 64
+    imgs = jax.device_put(
+        (np.random.RandomState(1).rand(batch, 32, 32, 3) * 255)
+        .astype(np.float32))
 
-    fn = build_bench(num_filters=128 if SMALL else 1024)
-    # warmup / compile; np.asarray forces a full host sync (the axon
-    # platform's block_until_ready can return before execution completes)
-    np.asarray(fn(imgs))
-    np.asarray(fn(imgs))
+    one = build_bench(num_filters=128 if SMALL else 1024)
 
-    iters = 3 if SMALL else 10
+    # all iterations in ONE dispatch (a Python loop of per-batch
+    # dispatches measures the dev-tunnel round-trip, not the
+    # featurizer), over ONE uploaded batch perturbed per iteration —
+    # the +i keeps the loop body iteration-dependent so XLA cannot
+    # hoist the featurization out of the lax.map
+    @jax.jit
+    def fn(b):
+        return jax.lax.map(
+            lambda i: one(b + i), jnp.arange(iters, dtype=jnp.float32))
+
+    _fence(fn(imgs))  # warmup / compile
+    _fence(fn(imgs))
+
     start = time.perf_counter()
-    for _ in range(iters):
-        out = fn(imgs)
-    np.asarray(out)
+    out = fn(imgs)
+    _fence(out)
     elapsed = time.perf_counter() - start
 
     per_chip = batch * iters / elapsed / n_dev
@@ -150,8 +160,6 @@ def e2e_bench():
     device, the block solve consumes the device-resident feature matrix,
     and prediction reduces to class ids before the final host sync.
     """
-    from keystone_tpu.nodes.learning import BlockLeastSquaresEstimator
-    from keystone_tpu.parallel.dataset import ArrayDataset
     from keystone_tpu.ops.pallas_kernels import (
         fused_cifar_featurize,
         use_pallas,
@@ -188,33 +196,54 @@ def e2e_bench():
             return jax.vmap(one)(imgs)
 
     y_tr = rng.randint(0, 10, n_train)
-    L = jax.device_put(
-        (-np.ones((n_train, 10)) + 2.0 * np.eye(10)[y_tr]).astype(np.float32))
+    L_host = (-np.ones((n_train, 10)) + 2.0 * np.eye(10)[y_tr]).astype(np.float32)
 
     def batches(n, seed):
+        assert n % batch == 0, "np.stack/reshape below need even batches"
         r = np.random.RandomState(seed)
         for i in range(0, n, batch):
-            m = min(batch, n - i)
-            yield r.rand(m, 32, 32, 3).astype(np.float32) * 255
+            yield r.rand(batch, 32, 32, 3).astype(np.float32) * 255
 
-    train_dev = [jax.device_put(b) for b in batches(n_train, 3)]
-    test_dev = [jax.device_put(b) for b in batches(n_test, 4)]
+    # one host-side stack -> ONE device_put per split (stacking
+    # already-device-put batches would hold two full copies in HBM),
+    # sharded within each batch over the data axis so dividing by
+    # device count below is earned on multi-chip hosts
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from keystone_tpu.parallel.mesh import make_mesh
+
+    sh = NamedSharding(make_mesh(jax.devices()), P(None, "data"))
+    train_dev = jax.device_put(np.stack(list(batches(n_train, 3))), sh)
+    test_dev = jax.device_put(np.stack(list(batches(n_test, 4))), sh)
+    L = jax.device_put(L_host, NamedSharding(sh.mesh, P("data")))
+
+    # the whole train path (featurize every batch -> center -> BCD
+    # solve) stages into ONE jit, and prediction into another: the
+    # estimator's own staged solve core (block_least_squares), without a
+    # dev-tunnel round-trip per batch. lax.map featurizes batch-at-a-
+    # time so HBM holds one batch of conv activations, not all of them.
+    from keystone_tpu.nodes.learning.linear import block_least_squares
+
+    F = num_filters * 2 * 2 * 2
+    bounds = tuple((i, min(F, i + 4096)) for i in range(0, F, 4096))
 
     @jax.jit
-    def predict(imgs, W, b):
-        return jnp.argmax(featurize(imgs) @ W + b, axis=-1)
+    def train_step(imgs_stacked, L):
+        feats = jax.lax.map(featurize, imgs_stacked)
+        X = feats.reshape(n_train, F)
+        Ws, x_mean, y_mean = block_least_squares(
+            X, L, n_train, 0.1, bounds, 1)
+        return jnp.concatenate(list(Ws), axis=0), x_mean, y_mean
 
-    est = BlockLeastSquaresEstimator(4096, 1, 0.1)
+    @jax.jit
+    def predict_all(imgs_stacked, W, x_mean, y_mean):
+        f = jax.lax.map(featurize, imgs_stacked)
+        return jnp.argmax(
+            (f.reshape(-1, F) - x_mean) @ W + y_mean, axis=-1)
 
     def fit_and_predict():
-        feats = jnp.concatenate([featurize(b) for b in train_dev])
-        model = est._fit(
-            ArrayDataset.from_numpy(feats), ArrayDataset.from_numpy(L))
-        W = jnp.concatenate(
-            [jnp.asarray(w) for w in model.block_weights], axis=0)
-        b = jnp.asarray(model.intercept) - jnp.asarray(model.feature_means) @ W
-        preds = [predict(t, W, b) for t in test_dev]
-        return np.asarray(jnp.concatenate(preds))  # host sync: ids only
+        W, x_mean, y_mean = train_step(train_dev, L)
+        return np.asarray(predict_all(test_dev, W, x_mean, y_mean))
 
     # warm EVERYTHING outside the timed region (featurize, the solver's
     # _block_solve at full shapes, predict) — steady-state throughput is
@@ -609,5 +638,9 @@ if __name__ == "__main__":
         accuracy_bench()
     elif "--imagenet" in sys.argv:
         imagenet_rehearsal_bench()
+    elif "--e2e" in sys.argv:
+        e2e_bench()
+    elif "--featurize" in sys.argv:
+        featurize_bench()
     else:
         main()

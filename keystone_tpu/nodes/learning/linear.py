@@ -290,19 +290,9 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         bs = self.block_size
         bounds = [(i, min(d, i + bs)) for i in range(0, d, bs)]
 
-        X, Y = ds.data, labels.data
-        x_mean = linalg.distributed_mean(X, n)
-        y_mean = linalg.distributed_mean(Y, n)
-        Ws = _block_solve(
-            X,
-            Y,
-            x_mean,
-            y_mean,
-            ds.mask,
-            float(self.lam),
-            tuple(bounds),
-            self.num_iter,
-        )
+        Ws, x_mean, y_mean = block_least_squares(
+            ds.data, labels.data, n, float(self.lam), tuple(bounds),
+            self.num_iter, mask=ds.mask)
         # blocks stay device-resident (see BlockLinearMapper.__init__)
         intercept = y_mean  # apply() centers x by the means, so b = y_mean
         return BlockLinearMapper(
@@ -361,3 +351,22 @@ def _block_solve(X, Y, x_mean, y_mean, mask, lam, bounds, num_iter):
     Yc = (Y - y_mean) * m
     blocks = [(X[:, lo:hi] - x_mean[lo:hi]) * m for lo, hi in bounds]
     return linalg.bcd_core(blocks, Yc, jnp.asarray(lam, X.dtype), num_passes=num_iter)
+
+
+def block_least_squares(X, Y, n, lam, bounds, num_iter, mask=None):
+    """Staged, jittable core of ``BlockLeastSquaresEstimator``: sharded
+    column means + mean-centered block coordinate descent. Returns
+    ``(per-block weights, x_mean, y_mean)``; prediction is
+    ``(x - x_mean) @ concat(Ws) + y_mean``. The estimator's ``_fit``
+    routes through this, so callers that stage the solve into a larger
+    jit (e.g. bench.py's end-to-end program) time exactly the
+    production solver path."""
+    if mask is None:
+        mask = jnp.ones(X.shape[0], X.dtype)
+    x_mean = linalg.distributed_mean(X, n)
+    y_mean = linalg.distributed_mean(Y, n)
+    return (
+        _block_solve(X, Y, x_mean, y_mean, mask, lam, bounds, num_iter),
+        x_mean,
+        y_mean,
+    )
