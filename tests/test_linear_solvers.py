@@ -165,3 +165,65 @@ def test_apply_and_evaluate_pad_rows_stay_zero(mesh8):
         data = np.asarray(ds.data)
         assert data.shape[0] > ds.n  # padding actually present
         np.testing.assert_array_equal(data[ds.n:], 0.0)
+
+
+def test_block_least_squares_staged_core_matches_estimator(mesh8):
+    """The public staged core (block_least_squares, what bench.py jits
+    into its end-to-end program) must produce exactly the model the
+    estimator's _fit path returns, including means and intercept."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.nodes.learning.linear import block_least_squares
+
+    A, Y = make_problem(n=160, d=24, k=3, seed=5)
+    bounds = tuple((i, min(24, i + 8)) for i in range(0, 24, 8))
+
+    model = BlockLeastSquaresEstimator(block_size=8, num_iter=2, lam=0.3).fit(
+        A, Y)
+    Ws, x_mean, y_mean = block_least_squares(
+        jnp.asarray(A), jnp.asarray(Y), 160, 0.3, bounds, 2)
+
+    np.testing.assert_allclose(
+        np.asarray(model.weights),
+        np.concatenate([np.asarray(w) for w in Ws], axis=0),
+        rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(model.feature_means), np.asarray(x_mean),
+        rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(model.intercept), np.asarray(y_mean),
+        rtol=1e-5, atol=1e-5)
+    # prediction identity: (x - x_mean) @ W + y_mean == model.apply(x)
+    pred = (A - np.asarray(x_mean)) @ np.concatenate(
+        [np.asarray(w) for w in Ws], axis=0) + np.asarray(y_mean)
+    np.testing.assert_allclose(
+        np.asarray(model(A).numpy()), pred, rtol=1e-4, atol=1e-4)
+
+
+def test_fitted_mapper_eq_key_is_device_cheap():
+    """eq_key must not serialize the full weight matrix (that is a full
+    d2h of a fitted model during fusion/CSE); equal models compare
+    equal, different models differ."""
+    A, Y = make_problem(seed=7)
+    m1 = LinearMapEstimator(lam=0.5).fit(A, Y)
+    m2 = LinearMapEstimator(lam=0.5).fit(A, Y)
+    m3 = LinearMapEstimator(lam=5.0).fit(A, Y)
+    assert m1.eq_key() == m2.eq_key()
+    assert m1.eq_key() != m3.eq_key()
+
+    # the key may carry small host vectors (scaler means) but never the
+    # weight-matrix payload
+    def payload(t):
+        for x in t:
+            if isinstance(x, tuple):
+                yield from payload(x)
+            elif isinstance(x, bytes):
+                yield len(x)
+            elif isinstance(x, np.ndarray):
+                yield x.nbytes
+    assert sum(payload(m1.eq_key())) < m1.weights.size * 4
+
+    b1 = BlockLeastSquaresEstimator(block_size=8, num_iter=1, lam=0.2).fit(A, Y)
+    b2 = BlockLeastSquaresEstimator(block_size=8, num_iter=1, lam=0.2).fit(A, Y)
+    assert b1.eq_key() == b2.eq_key()
+    assert sum(payload(b1.eq_key())) < np.asarray(b1.weights).size * 4
