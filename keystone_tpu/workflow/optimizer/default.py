@@ -11,7 +11,7 @@ from __future__ import annotations
 from typing import Sequence
 
 from .auto_cache import AutoCacheRule
-from .fusion import MapFusionRule
+from .fusion import GatherFusionRule, MapFusionRule
 from .node_rule import NodeOptimizationRule
 from .rule import Batch, FixedPoint, Once, Optimizer
 from .rules import (
@@ -34,7 +34,8 @@ class DefaultOptimizer(Optimizer):
             Batch("node-level optimization", Once(), [NodeOptimizationRule()]),
             Batch("post-splice CSE", FixedPoint(100),
                   [EquivalentNodeMergeRule()]),
-            Batch("map fusion", FixedPoint(1000), [MapFusionRule()]),
+            Batch("map fusion", FixedPoint(1000),
+                  [MapFusionRule(), GatherFusionRule()]),
         ]
 
 

@@ -50,18 +50,29 @@ class FusedTransformer(Transformer):
 
 
 #: The optimizer re-runs on every bind of an unfitted pipeline; reusing
-#: the same FusedTransformer instance for the same stage chain keeps its
+#: the same fused instance for the same stage chain keeps its
 #: per-instance jit cache warm across binds (a fresh instance per
 #: optimize pass would recompile the fused stage every time).
-_fusion_cache: Dict[Tuple, FusedTransformer] = {}
+_fusion_cache: Dict[Tuple, Transformer] = {}
 
 
-def fused_transformer(stages: List[Transformer]) -> FusedTransformer:
-    fused = FusedTransformer(stages)
+def _memoized(fused):
     try:
         return _fusion_cache.setdefault(fused._cached_eq_key(), fused)
     except TypeError:  # unhashable stage key: skip memoization
         return fused
+
+
+def fused_transformer(stages: List[Transformer]) -> FusedTransformer:
+    return _memoized(FusedTransformer(stages))
+
+
+def _consumers_and_sink_deps(graph: Graph):
+    consumers: Dict = {}
+    for nid, deps in graph.dependencies.items():
+        for d in deps:
+            consumers.setdefault(d, set()).add(nid)
+    return consumers, set(graph.sink_dependencies.values())
 
 
 def _fusable(op) -> bool:
@@ -73,17 +84,38 @@ def _fusable(op) -> bool:
     )
 
 
+class FusedGatherTransformer(Transformer):
+    """N branches + the gather zip executed in one jit: ``apply(x)``
+    returns the per-item tuple of branch outputs that
+    ``GatherTransformerOperator`` previously assembled from separately
+    dispatched branch nodes."""
+
+    def __init__(self, branches: List[Transformer]):
+        self.branches = list(branches)
+
+    def eq_key(self):
+        return (FusedGatherTransformer,
+                tuple(b._cached_eq_key() for b in self.branches))
+
+    def apply(self, x):
+        return tuple(b.apply(x) for b in self.branches)
+
+    def label(self) -> str:
+        return ("FusedGather[" +
+                ", ".join(b.label() for b in self.branches) + "]")
+
+
+def fused_gather_transformer(branches: List[Transformer]) -> FusedGatherTransformer:
+    return _memoized(FusedGatherTransformer(branches))
+
+
 class MapFusionRule(Rule):
     """Fuse one (producer, consumer) pair of default-semantics
     transformers per application; a FixedPoint batch drives whole chains
     to a single node."""
 
     def apply(self, graph: Graph) -> Graph:
-        consumers = {}
-        for nid, deps in graph.dependencies.items():
-            for d in deps:
-                consumers.setdefault(d, set()).add(nid)
-        sink_deps = set(graph.sink_dependencies.values())
+        consumers, sink_deps = _consumers_and_sink_deps(graph)
 
         for b in sorted(graph.nodes, key=lambda n: n.id):
             deps = graph.get_dependencies(b)
@@ -99,4 +131,55 @@ class MapFusionRule(Rule):
             g = graph.set_operator(b, fused)
             g = g.set_dependencies(b, graph.get_dependencies(a))
             return g.remove_node(a)
+        return graph
+
+
+class GatherFusionRule(Rule):
+    """Fuse a Gather node with its fusable single-input branches.
+
+    ``gather(branch_1, ..., branch_N)`` otherwise pays one dispatch per
+    branch plus a zip; when every branch is a default-semantics
+    transformer hanging off the SAME upstream node, the whole fan-out
+    collapses into one jit emitting the per-item tuple directly (MNIST's
+    4 FFT branches, TIMIT's 8 cosine branches, ImageNet's
+    gather(SIFT, LCS)). MapFusionRule then composes the fused gather
+    with the downstream combiner and upstream chain as usual.
+    """
+
+    def apply(self, graph: Graph) -> Graph:
+        from ..pipeline import GatherTransformerOperator
+
+        consumers, sink_deps = _consumers_and_sink_deps(graph)
+
+        for gth in sorted(graph.nodes, key=lambda n: n.id):
+            if not isinstance(
+                    graph.get_operator(gth), GatherTransformerOperator):
+                continue
+            deps = graph.get_dependencies(gth)
+            if not deps or not all(isinstance(d, NodeId) for d in deps):
+                continue
+            ops = [graph.get_operator(d) for d in deps]
+            if not all(_fusable(op) for op in ops):
+                continue
+            # every branch must feed only this gather (CSE-merged
+            # duplicate branches appear twice in deps — allowed), and
+            # all branches must hang off one common upstream input
+            srcs = set()
+            ok = True
+            for d in set(deps):
+                if consumers.get(d, set()) != {gth} or d in sink_deps:
+                    ok = False
+                    break
+                bdeps = graph.get_dependencies(d)
+                if len(bdeps) != 1:
+                    ok = False
+                    break
+                srcs.add(bdeps[0])
+            if not ok or len(srcs) != 1:
+                continue
+            g = graph.set_operator(gth, fused_gather_transformer(ops))
+            g = g.set_dependencies(gth, (srcs.pop(),))
+            for d in set(deps):
+                g = g.remove_node(d)
+            return g
         return graph

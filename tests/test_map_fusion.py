@@ -182,3 +182,59 @@ def test_fitted_pipeline_fuses_model_chain():
     fused = _fused_ops_of_bound(fitted.to_pipeline(),
                                 ArrayDataset.from_numpy(np.ones((4, 2))))
     assert len(fused) == 1 and len(fused[0].stages) == 4
+
+
+def test_gather_branches_fuse_to_one_node():
+    """gather(N fusable branches) + the downstream combiner collapse
+    into ONE node (GatherFusionRule + MapFusionRule), with identical
+    batch and datum results."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.nodes.util import VectorCombiner
+    from keystone_tpu.workflow.optimizer.default import DefaultOptimizer
+    from keystone_tpu.workflow.optimizer.fusion import (
+        FusedGatherTransformer,
+    )
+    from keystone_tpu.workflow.pipeline import Pipeline
+
+    branches = [
+        t(lambda x, s=s: x * s, f"scale{s}") >> t(jnp.sin, f"sin{s}")
+        for s in (1.0, 2.0, 3.0)
+    ]
+    pipe = Pipeline.gather(branches) >> VectorCombiner()
+
+    g = DefaultOptimizer().execute(pipe.graph)
+    assert len(g.nodes) == 1
+    (op,) = [g.get_operator(n) for n in g.nodes]
+    assert isinstance(op, FusedTransformer)
+    assert any(isinstance(s, FusedGatherTransformer) for s in op.stages)
+
+    X = np.linspace(0.0, 1.0, 12).reshape(6, 2).astype(np.float32)
+    expect = np.concatenate([np.sin(X * s) for s in (1.0, 2.0, 3.0)], axis=-1)
+    fitted = pipe.fit()
+    out = np.asarray(fitted.apply(ArrayDataset.from_numpy(X)).get().numpy())
+    np.testing.assert_allclose(out, expect, rtol=1e-6, atol=1e-6)
+    one = np.asarray(fitted.apply_datum(X[2]).get())
+    np.testing.assert_allclose(one, expect[2], rtol=1e-6, atol=1e-6)
+
+
+def test_gather_host_branch_not_fused():
+    """A gather with a non-fusable (host-stage) branch keeps its node
+    structure; only all-fusable same-upstream gathers collapse."""
+    from keystone_tpu.nodes.util import VectorCombiner
+    from keystone_tpu.workflow.optimizer.fusion import GatherFusionRule
+    from keystone_tpu.workflow.pipeline import Pipeline
+
+    class HostAdd(HostTransformer):
+        def apply(self, x):
+            return x + 1.0
+
+    host = HostAdd()
+    dev = t(lambda x: x * 2.0, "dev")
+    g = (Pipeline.gather([host, dev]) >> VectorCombiner()).graph
+    assert len(GatherFusionRule().apply(g).nodes) == len(g.nodes)
+
+    # all-fusable control: the same shape with two device branches fuses
+    g2 = (Pipeline.gather([t(lambda x: x + 1.0, "a"), dev])
+          >> VectorCombiner()).graph
+    assert len(GatherFusionRule().apply(g2).nodes) < len(g2.nodes)
