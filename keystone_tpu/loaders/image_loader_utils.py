@@ -98,11 +98,12 @@ def list_archive_paths(data_path: str, process_shard: bool = True) -> List[str]:
     return paths
 
 
-def iter_tar_images(
+def _iter_tar_entries(
     tar_path: str, name_prefix: Optional[str] = None
 ) -> Iterator[tuple]:
-    """Yield (entry_name, decoded_image) for each image file in a tar
-    (reference ``ImageLoaderUtils.loadFile``)."""
+    """Yield (entry_name, raw_bytes) for each matching file in a tar —
+    the single source of mode selection and entry filtering shared by
+    :func:`iter_tar_images` and :func:`load_tar_files`."""
     mode = "r:gz" if tar_path.endswith(".gz") else "r"
     with tarfile.open(tar_path, mode) as tf:
         for entry in tf:
@@ -113,9 +114,28 @@ def iter_tar_images(
             fobj = tf.extractfile(entry)
             if fobj is None:
                 continue
-            img = decode_image(fobj.read())
-            if img is not None:
-                yield entry.name, img
+            yield entry.name, fobj.read()
+
+
+def iter_tar_images(
+    tar_path: str, name_prefix: Optional[str] = None
+) -> Iterator[tuple]:
+    """Yield (entry_name, decoded_image) for each image file in a tar
+    (reference ``ImageLoaderUtils.loadFile``)."""
+    for name, raw in _iter_tar_entries(tar_path, name_prefix):
+        img = decode_image(raw)
+        if img is not None:
+            yield name, img
+
+
+def _loader_threads() -> int:
+    """Decode worker count: the reference got multi-core decode for free
+    from Spark executors; here a thread pool does it (PIL releases the
+    GIL while decoding). ``KEYSTONE_LOADER_THREADS=1`` forces serial."""
+    env = os.environ.get("KEYSTONE_LOADER_THREADS")
+    if env:
+        return max(1, int(env))
+    return min(32, os.cpu_count() or 4)
 
 
 def load_tar_files(
@@ -125,32 +145,60 @@ def load_tar_files(
     name_prefix: Optional[str] = None,
 ) -> HostDataset:
     """Load every image from every archive, applying the label mapping
-    (reference ``ImageLoaderUtils.loadFiles``)."""
+    (reference ``ImageLoaderUtils.loadFiles``).
+
+    Tar IO streams sequentially (that is how tars read); image DECODE
+    runs on a thread pool with a bounded window of in-flight entries, so
+    raw bytes never pile up and item order stays deterministic
+    (archive order, then entry order)."""
+    import collections
+    from concurrent.futures import ThreadPoolExecutor
+
     log = logging.getLogger(__name__)
-    items = []
+    items: list = []
     opened_any = False
-    for path in archive_paths:
-        before = len(items)
-        it = iter_tar_images(path, name_prefix)
-        try:
-            for name, img in it:
+
+    def drain(pending, n):
+        nonlocal opened_any
+        while pending and (len(pending) > n):
+            name, fut = pending.popleft()
+            img = fut.result()
+            if img is not None:
+                # only a decoded image proves the path held real data;
+                # None-decodes must not suppress the final ReadError
                 opened_any = True
                 items.append(image_builder(img, labels_map(name), name))
-            opened_any = True  # readable archive, possibly zero images
-        except (tarfile.ReadError, gzip.BadGzipFile, EOFError, zlib.error) as e:
-            if len(items) == before:
-                # Failed before yielding anything: not a tar (labels.txt,
-                # README, checksums) — skip, matching the reference where
-                # non-archives simply yield no image records.
-                log.warning("Skipping non-archive file %s", path)
-            else:
-                # Truncated/corrupt mid-stream: keep what was read, but
-                # say so — silent partial data is worse than a warning.
-                log.warning(
-                    "Archive %s truncated/corrupt (%s); kept %d items from it",
-                    path, e, len(items) - before,
-                )
-                opened_any = True
+
+    workers = _loader_threads()
+    window = 4 * workers
+    with ThreadPoolExecutor(workers) as pool:
+        for path in archive_paths:
+            before = len(items)
+            pending: collections.deque = collections.deque()
+            try:
+                for name, raw in _iter_tar_entries(path, name_prefix):
+                    pending.append((name, pool.submit(decode_image, raw)))
+                    drain(pending, window)
+                drain(pending, 0)
+                opened_any = True  # readable archive, possibly zero images
+            except (tarfile.ReadError, gzip.BadGzipFile, EOFError,
+                    zlib.error) as e:
+                drain(pending, 0)  # keep entries read before the error
+                if len(items) == before:
+                    # Failed before yielding anything: not a tar
+                    # (labels.txt, README, checksums) — skip, matching
+                    # the reference where non-archives simply yield no
+                    # image records.
+                    log.warning("Skipping non-archive file %s", path)
+                else:
+                    # Truncated/corrupt mid-stream: keep what was read,
+                    # but say so — silent partial data is worse than a
+                    # warning.
+                    log.warning(
+                        "Archive %s truncated/corrupt (%s); kept %d "
+                        "items from it", path, e, len(items) - before,
+                    )
+                    opened_any = True
     if archive_paths and not opened_any:
         raise tarfile.ReadError(
             f"None of {len(archive_paths)} file(s) under the data path could be "
