@@ -88,27 +88,25 @@ class PerClassWeightedLeastSquaresEstimator(LabelEstimator):
         jfm = (w * class_means + (1 - w) * pop_mean).astype(np.float32)
         joint_label_mean = (counts / n) * 2.0 * (1 - w) - 1.0 + 2.0 * w
 
-        # per-class solutions accumulate on DEVICE; a per-class
-        # np.asarray would pay n_classes separate d2h transfers
-        cols = []
-        for c in range(n_classes):
-            onehot_c = _class_indicator(cls_dev, c, mask)
-            b_c = mask * np.float32((1 - w) / n) + onehot_c * np.float32(
-                w / counts[c]
-            )
-            y_c = (L[:, c] - np.float32(joint_label_mean[c])) * mask
-            cols.append(
-                _solve_single_class(
-                    X,
-                    b_c,
-                    y_c,
-                    jnp.asarray(jfm[c]),
-                    jnp.float32(self.lam),
-                    bounds,
-                    self.num_iter,
-                )
-            )
-        models = jnp.stack(cols, axis=1)  # (d, n_classes)
+        # ALL per-class solves in one dispatch: a Python loop would pay
+        # two host round-trips per class (1000+ for ImageNet); lax.map
+        # keeps the per-class working set while the whole sweep
+        # compiles once. Solutions stay on device.
+        models = _solve_all_classes(
+            X,
+            cls_dev,
+            mask,
+            L,
+            jnp.asarray(jfm),
+            jnp.asarray(joint_label_mean.astype(np.float32)),
+            jnp.asarray(counts.astype(np.float32)),
+            jnp.float32(self.lam),
+            jnp.float32(n),
+            jnp.float32(w),
+            bounds,
+            self.num_iter,
+            n_classes,
+        )  # (d, n_classes)
 
         blocks = [models[lo:hi] for lo, hi in bounds]
         final_b = (
@@ -133,6 +131,24 @@ def _label_stats(X, cls, mask, k):
 @jax.jit
 def _class_indicator(cls, c, mask):
     return (cls == c).astype(jnp.float32) * mask
+
+
+@functools.partial(
+    jax.jit, static_argnames=("bounds", "num_iter", "k"))
+def _solve_all_classes(X, cls, mask, L, jfm, joint_label_mean, counts,
+                       lam, n, w, bounds, num_iter, k):
+    """Sweep every class's independent reweighted solve under one
+    ``lax.map``: per-class weights/labels are built on the fly from the
+    class-id vector, so the program is one dispatch regardless of k."""
+
+    def body(c):
+        onehot_c = _class_indicator(cls, c, mask)
+        b_c = mask * ((1.0 - w) / n) + onehot_c * (w / counts[c])
+        y_c = (jnp.take(L, c, axis=1) - joint_label_mean[c]) * mask
+        return _solve_single_class(
+            X, b_c, y_c, jfm[c], lam, bounds, num_iter)
+
+    return jax.lax.map(body, jnp.arange(k)).T  # (d, k)
 
 
 @functools.partial(jax.jit, static_argnames=("bounds", "num_iter"))
