@@ -122,20 +122,20 @@ class GaussianMixtureModelEstimator(Estimator):
     def fit_matrix(self, X: np.ndarray) -> GaussianMixtureModel:
         n, d = X.shape
         k = self.k
-        XSq = X * X
+        # X crosses to device ONCE; XSq derives on device (a host XSq
+        # would double the h2d volume over the dev tunnel)
+        X_dev = jnp.asarray(np.asarray(X, np.float32))
+        XSq_dev = X_dev * X_dev
         mean_global = X.mean(axis=0)
-        var_global = XSq.mean(axis=0) - mean_global**2
+        var_global = (X * X).mean(axis=0) - mean_global**2
 
         if self.initialization_method == KMEANS_PLUS_PLUS_INITIALIZATION:
             km = KMeansPlusPlusEstimator(k, 1, seed=self.seed).fit_matrix(X)
-            assign = np.asarray(
-                jax.vmap(km.apply)(jnp.asarray(X))
-            )
-            mass = assign.sum(axis=0)
-            mass = np.maximum(mass, 1e-12)
+            assign = jax.vmap(km.apply)(X_dev)  # (n, k), stays on device
+            mass = jnp.maximum(jnp.sum(assign, axis=0), 1e-12)
             weights = mass / n
-            means = (assign.T @ X) / mass[:, None]
-            variances = (assign.T @ XSq) / mass[:, None] - means**2
+            means = (assign.T @ X_dev) / mass[:, None]
+            variances = (assign.T @ XSq_dev) / mass[:, None] - means**2
         else:
             rng = np.random.RandomState(self.seed)
             col_min, col_max = X.min(axis=0), X.max(axis=0)
@@ -144,45 +144,72 @@ class GaussianMixtureModelEstimator(Estimator):
             variances = np.full((k, d), 0.1, np.float32) * (col_range**2)
             weights = np.full(k, 1.0 / k, np.float32)
 
-        var_lb = np.maximum(
-            self.small_variance_threshold * var_global,
-            self.absolute_variance_threshold,
+        var_lb_dev = jnp.asarray(
+            np.maximum(
+                self.small_variance_threshold * var_global,
+                self.absolute_variance_threshold,
+            ),
+            jnp.float32,
         )
-        variances = np.maximum(variances, var_lb)
+
+        # E and M both stay on device; only the 8-byte (cost, unbalanced)
+        # pair crosses to host per iteration for the stopping decisions.
+        # The old loop pulled the whole (n, k) responsibility matrix and
+        # ran the M-step in numpy — minutes of d2h at FV-training scale.
+        means = jnp.asarray(means, jnp.float32)
+        variances = jnp.maximum(
+            jnp.asarray(variances, jnp.float32), var_lb_dev)
+        weights = jnp.asarray(weights, jnp.float32)
 
         prev_cost = None
         for it in range(self.max_iterations):
-            q, llh_mean = _e_step(
-                jnp.asarray(X),
-                jnp.asarray(means, jnp.float32),
-                jnp.asarray(variances, jnp.float32),
-                jnp.asarray(weights, jnp.float32),
-                self.weight_threshold,
+            new_means, new_vars, new_weights, llh_mean, unbalanced = _em_iter(
+                X_dev, XSq_dev, means, variances, weights, var_lb_dev,
+                self.weight_threshold, float(self.min_cluster_size),
             )
             cost = float(llh_mean)
             if prev_cost is not None:
                 if (cost - prev_cost) < self.stop_tolerance * abs(prev_cost):
                     break
-            q = np.asarray(q)
-            q_sum = q.sum(axis=0)
-            if (q_sum < self.min_cluster_size).any():
+            if bool(unbalanced):
                 # unbalanced clustering: stop updating (reference :176-178)
                 break
-            weights = q_sum / n
-            means = (q.T @ X) / q_sum[:, None]
-            variances = (q.T @ XSq) / q_sum[:, None] - means**2
-            variances = np.maximum(variances, var_lb)
+            means, variances, weights = new_means, new_vars, new_weights
             prev_cost = cost
 
         return GaussianMixtureModel(
-            means.T, variances.T, weights, self.weight_threshold
+            np.asarray(means).T, np.asarray(variances).T,
+            np.asarray(weights), self.weight_threshold
         )
 
 
 @jax.jit
-def _e_step(X, means, variances, weights, weight_threshold):
+def _em_iter(X, XSq, means, variances, weights, var_lb,
+             weight_threshold, min_cluster_size):
+    """One full EM iteration on device. Returns the UPDATED parameters
+    plus (mean log-likelihood of the CURRENT parameters, unbalanced
+    flag); the host adopts the update only if neither stopping rule
+    fires, preserving the reference's stop-without-updating semantics."""
+    n = X.shape[0]
+    q, llh_mean = _e_step(X, XSq, means, variances, weights,
+                          weight_threshold)
+    q_sum = jnp.sum(q, axis=0)
+    unbalanced = jnp.any(q_sum < min_cluster_size)
+    safe = jnp.maximum(q_sum, 1e-12)
+    new_weights = q_sum / n
+    # HIGHEST matmul precision: E[x^2] - mean^2 is cancellation-prone,
+    # and the default bf16-pass matmul error would swamp small variances
+    hi = jax.lax.Precision.HIGHEST
+    new_means = jnp.matmul(q.T, X, precision=hi) / safe[:, None]
+    new_vars = jnp.maximum(
+        jnp.matmul(q.T, XSq, precision=hi) / safe[:, None]
+        - new_means**2, var_lb)
+    return new_means, new_vars, new_weights, llh_mean, unbalanced
+
+
+@jax.jit
+def _e_step(X, XSq, means, variances, weights, weight_threshold):
     d = X.shape[1]
-    XSq = X * X
     sq_mahl = (
         XSq @ (0.5 / variances).T
         - X @ (means / variances).T

@@ -74,19 +74,22 @@ class KMeansPlusPlusEstimator(Estimator):
 
         means = X[centers].copy()
 
-        # Lloyd's iterations with cost-improvement stopping (reference :125-178)
+        # Lloyd's iterations with cost-improvement stopping (reference
+        # :125-178); means stay device-resident, only the cost scalar
+        # crosses to host per iteration
+        X_dev = jnp.asarray(X)
+        means_dev = jnp.asarray(means)
         prev_cost = None
         for it in range(self.max_iterations):
-            means_j, cost = _lloyd_step(jnp.asarray(X), jnp.asarray(means))
+            new_means, cost = _lloyd_step(X_dev, means_dev)
             cost = float(cost)
-            new_means = np.asarray(means_j)
             if prev_cost is not None:
                 improving = (prev_cost - cost) >= self.stop_tolerance * abs(prev_cost)
                 if not improving:
                     break
-            means = new_means
+            means_dev = new_means
             prev_cost = cost
-        return KMeansModel(means)
+        return KMeansModel(np.asarray(means_dev))
 
 
 @jax.jit
@@ -99,5 +102,11 @@ def _lloyd_step(X, means):
     cost = jnp.mean(jnp.min(sq_dist, axis=1))
     assign = jax.nn.one_hot(jnp.argmin(sq_dist, axis=1), means.shape[0], dtype=X.dtype)
     mass = jnp.sum(assign, axis=0)
-    new_means = (assign.T @ X) / mass[:, None]
+    # an emptied cluster keeps its previous center instead of going NaN
+    # (0/0) and poisoning every later iteration
+    new_means = jnp.where(
+        (mass > 0)[:, None],
+        (assign.T @ X) / jnp.maximum(mass, 1e-12)[:, None],
+        means,
+    )
     return new_means, cost
