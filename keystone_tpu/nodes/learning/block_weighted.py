@@ -162,31 +162,26 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
 
         for pass_idx in range(start_pass, self.num_iter):
             for b, (lo, hi) in enumerate(bounds):
-                Xb = Xcm[:, :, lo:hi]
-                if block_stats[b] is None:
-                    block_stats[b] = _block_stats_cm(
-                        Xb, mask_cm, counts_f, n, w
+                # the whole block step is ONE dispatch; stats and the
+                # population factor come back for reuse on later passes
+                models[b], Rcm, block_stats[b], block_chols[b] = (
+                    _block_pass_cm(
+                        Xcm,
+                        Rcm,
+                        models[b],
+                        mask_cm,
+                        counts_f,
+                        lo,
+                        hi,
+                        n,
+                        w,
+                        lam,
+                        smodel=mesh.shape[MODEL_AXIS],
+                        solver=self.solver,
+                        stats=block_stats[b],
+                        pop_factor=block_chols[b],
                     )
-                pop_mean, pop_cov, joint_means = block_stats[b]
-
-                delta, block_chols[b] = _block_pass_cm(
-                    Xb,
-                    Rcm,
-                    models[b],
-                    pop_mean,
-                    pop_cov,
-                    joint_means,
-                    mask_cm,
-                    counts_f,
-                    n,
-                    jnp.float32(w),
-                    jnp.float32(lam),
-                    smodel=mesh.shape[MODEL_AXIS],
-                    solver=self.solver,
-                    pop_chol=block_chols[b],
                 )
-                models[b] = models[b] + delta
-                Rcm = _update_residual_cm(Rcm, Xb, delta, mask_cm)
             if ckpt is not None and pass_idx + 1 < self.num_iter:
                 # a final-pass checkpoint has no consumer (resume needs
                 # pass+1 < num_iter) — skip the write, and clear the
@@ -296,13 +291,16 @@ def _class_chunk(C_pad: int, d_b: int, smodel: int, S: int = 0) -> int:
     return min(chunk, C_pad)
 
 
-def _block_pass_cm(Xb, Rcm, model, pop_mean, pop_cov, joint_means, mask,
-                   counts, n, w, lam, smodel=1, solver="auto",
-                   pop_chol=None):
+def _block_pass_cm(Xcm, Rcm, model_b, mask, counts, lo, hi, n, w, lam,
+                   smodel=1, solver="auto", stats=None, pop_factor=None):
     """One coordinate-descent step for one block (reference :237-292):
     per-class joint statistics and solves, batched over classes and
     sharded (classes over 'model', slots over 'data'). The O(d_b^2)
-    per-class tensors are built chunk-of-classes at a time.
+    per-class tensors are built chunk-of-classes at a time, and the
+    ENTIRE step — block slice, (first-pass) block statistics, pass
+    globals, all chunk solves, residual update — is one jitted
+    dispatch. The block start index is a dynamic operand, so every
+    equal-width block shares one compiled trace.
 
     ``solver``: per-class system choice. "cholesky" is the direct
     batched factorization of each (d_b, d_b) joint covariance — O(C *
@@ -313,23 +311,21 @@ def _block_pass_cm(Xb, Rcm, model, pop_mean, pop_cov, joint_means, mask,
     solve per class, the MXU-friendly form. "auto" picks woodbury when
     the padded class size is well under the block width (the ImageNet FV
     regime: S ~ 1.3k slots vs d_b = 4096) and lam > 0 (M must be
-    invertible)."""
-    C_pad, S, d_b = Xb.shape
-    k = Rcm.shape[2]
-    res, pop_xtr, residual_mean = _pass_globals(Xb, Rcm, mask, n, k)
+    invertible).
 
+    Returns ``(new_model_b, new_Rcm, stats, pop_factor)`` — the latter
+    two for caller-side caching across passes (block statistics and the
+    population factor are pass-invariant)."""
+    C_pad, S, _ = Xcm.shape
+    d_b = hi - lo
     if solver == "auto":
         solver = (
             "woodbury"
             if (S + 2) * 2 <= d_b and float(lam) > 0.0
             else "cholesky"
         )
-    if solver == "woodbury":
-        if pop_chol is None:
-            pop_chol = _pop_cholesky(pop_cov, w, lam)
-        chunk = _class_chunk(C_pad, d_b, smodel, S=S)
-    else:
-        chunk = _class_chunk(C_pad, d_b, smodel)
+    chunk = _class_chunk(
+        C_pad, d_b, smodel, S=S if solver == "woodbury" else 0)
 
     # uniform chunks: one compiled shape serves every chunk (a ragged
     # tail chunk would cost a second XLA compile); the extra pad classes
@@ -337,28 +333,58 @@ def _block_pass_cm(Xb, Rcm, model, pop_mean, pop_cov, joint_means, mask,
     nch = -(-C_pad // chunk)               # number of chunks
     chunk = -(-C_pad // nch)               # evenly spread classes
     chunk = -(-chunk // smodel) * smodel   # keep 'model'-shardable
-    delta = _block_pass_chunked(
-        Xb, res, mask, counts, joint_means, model, pop_xtr,
-        residual_mean, pop_mean, pop_cov if solver == "cholesky"
-        else pop_chol, w, lam,
-        n=n, k=k, chunk=chunk, nch=nch, solver=solver)
-    # pop_chol returned for caller-side caching: M is pass-invariant, so
-    # multi-pass fits factor it once per block
-    return delta, pop_chol                                # (d_b, k)
+    out = _block_pass_full(
+        Xcm, Rcm, model_b, mask, counts, jnp.int32(lo),
+        jnp.float32(w), jnp.float32(lam), stats, pop_factor,
+        d_b=d_b, n=n, k=Rcm.shape[2], chunk=chunk, nch=nch,
+        solver=solver, with_stats=stats is None)
+    if stats is None:
+        return out
+    # cached passes return only the updated pair; threading the cached
+    # stats through the jit would materialize fresh HBM copies of
+    # pop_cov/joint_means/pop_factor every block step
+    new_model, new_Rcm = out
+    return new_model, new_Rcm, stats, pop_factor
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n", "k", "chunk", "nch", "solver"))
-def _block_pass_chunked(Xb, res, mask, counts, joint_means, model,
-                        pop_xtr, residual_mean, pop_mean, pop_factor,
-                        w, lam, *, n, k, chunk, nch, solver):
-    """All per-class chunk solves of one block pass in ONE dispatch:
-    a Python loop of per-chunk jit calls pays a host round-trip per
-    chunk (seconds of pure latency per pass through a dev tunnel, and
-    needless dispatch overhead anywhere); ``lax.map`` keeps the
-    chunk-at-a-time HBM bound while the whole pass compiles once.
-    ``pop_factor`` is the population Cholesky factor (woodbury) or the
-    population covariance (cholesky)."""
+    jax.jit, static_argnames=("d_b", "n", "k", "chunk", "nch", "solver",
+                              "with_stats"))
+def _block_pass_full(Xcm, Rcm, model_b, mask, counts, start, w, lam,
+                     stats, pop_factor, *, d_b, n, k, chunk, nch,
+                     solver, with_stats):
+    """The whole block step in one program (see ``_block_pass_cm``).
+    ``stats``/``pop_factor`` are ``None`` on a block's first pass
+    (``with_stats=True``) and computed inside; later passes feed the
+    cached values back in. ``pop_factor`` is the population Cholesky
+    factor (woodbury) or the population covariance (cholesky)."""
+    Xb = jax.lax.dynamic_slice_in_dim(Xcm, start, d_b, axis=2)
+    if with_stats:
+        stats = _block_stats_cm(Xb, mask, counts, n, w)
+        pop_cov = stats[1]
+        pop_factor = (
+            _pop_cholesky(pop_cov, w, lam) if solver == "woodbury"
+            else pop_cov)
+    pop_mean, _, joint_means = stats
+    res, pop_xtr, residual_mean = _pass_globals(Xb, Rcm, mask, n, k)
+    delta = _chunked_delta(
+        Xb, res, mask, counts, joint_means, model_b, pop_xtr,
+        residual_mean, pop_mean, pop_factor, w, lam,
+        n=n, k=k, chunk=chunk, nch=nch, solver=solver)
+    new_model = model_b + delta
+    new_Rcm = _update_residual_cm(Rcm, Xb, delta, mask)
+    if with_stats:
+        return new_model, new_Rcm, stats, pop_factor
+    return new_model, new_Rcm
+
+
+def _chunked_delta(Xb, res, mask, counts, joint_means, model,
+                   pop_xtr, residual_mean, pop_mean, pop_factor,
+                   w, lam, *, n, k, chunk, nch, solver):
+    """All per-class chunk solves of one block pass under ``lax.map``:
+    the chunk-at-a-time HBM bound is kept while the whole pass belongs
+    to the enclosing jit (a Python loop of per-chunk dispatches would
+    pay a host round-trip per chunk)."""
     C_pad, S, d_b = Xb.shape
     total = nch * chunk
     if total != C_pad:
