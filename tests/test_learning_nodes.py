@@ -211,3 +211,23 @@ def test_local_least_squares_dual_matches_primal():
     Ac, Yc = (A - Am).astype(np.float64), (Y - Ym).astype(np.float64)
     expect = np.linalg.solve(Ac.T @ Ac + lam * np.eye(d), Ac.T @ Yc)
     np.testing.assert_allclose(model.weights, expect, rtol=2e-2, atol=2e-2)
+
+
+def test_kmeans_emptied_cluster_keeps_center():
+    """A center that captures zero points during Lloyd's must keep its
+    previous position, not divide 0/0 into NaN (which would poison the
+    GMM kmeans++ init path)."""
+    # EXACT duplicates, k=3: two centers must land on identical
+    # coordinates, argmin ties route all mass to the first, and the
+    # duplicate center is guaranteed empty every Lloyd step
+    X = np.repeat(
+        np.array([[0.0, 0.0], [10.0, 10.0]], np.float32), 40, axis=0)
+    import jax
+    import jax.numpy as jnp
+
+    model = KMeansPlusPlusEstimator(3, 25, seed=1).fit(X)
+    assert np.isfinite(np.asarray(model.means)).all()
+    # every point's ASSIGNED CENTER has finite coordinates (the one-hot
+    # itself is always finite, so assert through the means)
+    assign = np.asarray(jax.vmap(model.apply)(jnp.asarray(X)))
+    assert np.isfinite(assign @ np.asarray(model.means)).all()
