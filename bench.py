@@ -12,7 +12,9 @@ Default invocation emits ONE JSON line PER METRIC
    BlockLeastSquares model (blockSize 4096), featurize + predict the
    test set. vs_baseline = value / 10_000.
 3. ``block_ls_solver_tflops`` — one-pass BCD at CIFAR scale (n=50k,
-   d=8192, blockSize 4096). vs_baseline = value / 45 (~f32 MXU peak).
+   d=8192, blockSize 4096), HIGHEST-precision f32 GEMMs (the reference
+   solved in f64). vs_baseline = value / 33 (~achievable peak at that
+   precision: bf16 peak / 6 passes).
 4. ``cifar_randompatch_test_error`` — test error of the REAL
    RandomPatchCifar app (full DAG: patch whitening, fused featurizer,
    StandardScaler, BlockLeastSquares, MaxClassifier). Runs on real
@@ -288,8 +290,10 @@ def solver_bench():
     flops = sum(
         2 * n * A.shape[1] ** 2 + A.shape[1] ** 3 / 3 + 4 * n * A.shape[1] * k
         for A in blocks)
+    # solver GEMMs run at HIGHEST f32 precision (6 bf16 MXU passes;
+    # reference solvers were f64) — achievable peak is ~bf16_peak/6
     _emit("block_ls_solver_tflops", round(flops / dt / 1e12, 2), "TFLOPS",
-          round(flops / dt / 1e12 / 45.0, 4))  # ~f32 MXU peak
+          round(flops / dt / 1e12 / 33.0, 4))
 
 
 # ------------------------------------------------------- accuracy bench

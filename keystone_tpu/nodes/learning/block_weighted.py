@@ -358,21 +358,27 @@ def _block_pass_full(Xcm, Rcm, model_b, mask, counts, start, w, lam,
     (``with_stats=True``) and computed inside; later passes feed the
     cached values back in. ``pop_factor`` is the population Cholesky
     factor (woodbury) or the population covariance (cholesky)."""
-    Xb = jax.lax.dynamic_slice_in_dim(Xcm, start, d_b, axis=2)
-    if with_stats:
-        stats = _block_stats_cm(Xb, mask, counts, n, w)
-        pop_cov = stats[1]
-        pop_factor = (
-            _pop_cholesky(pop_cov, w, lam) if solver == "woodbury"
-            else pop_cov)
-    pop_mean, _, joint_means = stats
-    res, pop_xtr, residual_mean = _pass_globals(Xb, Rcm, mask, n, k)
-    delta = _chunked_delta(
-        Xb, res, mask, counts, joint_means, model_b, pop_xtr,
-        residual_mean, pop_mean, pop_factor, w, lam,
-        n=n, k=k, chunk=chunk, nch=nch, solver=solver)
-    new_model = model_b + delta
-    new_Rcm = _update_residual_cm(Rcm, Xb, delta, mask)
+    # solver-path GEMMs follow the solver precision policy (reference
+    # solvers ran f64; bf16-pass Grams cost ~4e-2 relative solution
+    # error at reference conditioning — see ops/linalg.SOLVER_PRECISION)
+    from ...ops.linalg import solver_precision
+
+    with solver_precision():
+        Xb = jax.lax.dynamic_slice_in_dim(Xcm, start, d_b, axis=2)
+        if with_stats:
+            stats = _block_stats_cm(Xb, mask, counts, n, w)
+            pop_cov = stats[1]
+            pop_factor = (
+                _pop_cholesky(pop_cov, w, lam) if solver == "woodbury"
+                else pop_cov)
+        pop_mean, _, joint_means = stats
+        res, pop_xtr, residual_mean = _pass_globals(Xb, Rcm, mask, n, k)
+        delta = _chunked_delta(
+            Xb, res, mask, counts, joint_means, model_b, pop_xtr,
+            residual_mean, pop_mean, pop_factor, w, lam,
+            n=n, k=k, chunk=chunk, nch=nch, solver=solver)
+        new_model = model_b + delta
+        new_Rcm = _update_residual_cm(Rcm, Xb, delta, mask)
     if with_stats:
         return new_model, new_Rcm, stats, pop_factor
     return new_model, new_Rcm

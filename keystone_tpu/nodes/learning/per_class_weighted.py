@@ -148,7 +148,11 @@ def _solve_all_classes(X, cls, mask, L, jfm, joint_label_mean, counts,
         return _solve_single_class(
             X, b_c, y_c, jfm[c], lam, bounds, num_iter)
 
-    return jax.lax.map(body, jnp.arange(k)).T  # (d, k)
+    # solver-path GEMMs follow linalg's solver precision policy
+    from ...ops.linalg import solver_precision
+
+    with solver_precision():
+        return jax.lax.map(body, jnp.arange(k)).T  # (d, k)
 
 
 @functools.partial(jax.jit, static_argnames=("bounds", "num_iter"))

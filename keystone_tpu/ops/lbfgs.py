@@ -47,6 +47,18 @@ def lbfgs(
     dim = x0.size
     dtype = x0.dtype
 
+    # objective/gradient GEMMs at HIGHEST precision (the reference ran
+    # Breeze/f64 — see ops/linalg.SOLVER_PRECISION); applies to every
+    # matmul traced inside this solve, including value_and_grad
+    from .linalg import solver_precision
+
+    with solver_precision():
+        return _lbfgs_body(value_and_grad, x0, max_iters, m, tol,
+                           ls_max_steps, c1, dim, dtype)
+
+
+def _lbfgs_body(value_and_grad, x0, max_iters, m, tol, ls_max_steps,
+                c1, dim, dtype):
     f0, g0 = value_and_grad(x0)
 
     def line_search(x, f, g, d):

@@ -44,14 +44,19 @@ def _gram_cross_kernel(x_ref, y_ref, gram_ref, cross_ref):
         gram_ref[:] = jnp.zeros_like(gram_ref)
         cross_ref[:] = jnp.zeros_like(cross_ref)
 
+    from .linalg import SOLVER_PRECISION
+
     x = x_ref[:]
+    # these Grams feed Cholesky solves: solver precision policy applies
     gram_ref[:] += jax.lax.dot_general(
         x, x, dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=SOLVER_PRECISION,
     )
     cross_ref[:] += jax.lax.dot_general(
         x, y_ref[:], dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=SOLVER_PRECISION,
     )
 
 
