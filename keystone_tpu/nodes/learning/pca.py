@@ -54,9 +54,12 @@ def _svd_pca(data: jnp.ndarray, dims: int) -> np.ndarray:
 
     @jax.jit
     def run(X):
-        means = jnp.mean(X, axis=0)
-        _, _, vt = jnp.linalg.svd(X - means, full_matrices=False)
-        return vt
+        # true-f32 (see _fit_zca): the "exact" local PCA must not sit
+        # below the randomized one in fidelity
+        with linalg.solver_precision():
+            means = jnp.mean(X, axis=0)
+            _, _, vt = jnp.linalg.svd(X - means, full_matrices=False)
+            return vt
 
     vt = np.asarray(run(data))
     pca = enforce_matlab_sign_convention(vt.T)
@@ -140,16 +143,20 @@ class ApproximatePCAEstimator(Estimator):
 
         @jax.jit
         def run(X, omega):
-            means = jnp.mean(X, axis=0)
-            A = X - means
-            Y = A @ omega
-            Q, _ = jnp.linalg.qr(Y)
-            for _ in range(self.q):
-                Q, _ = jnp.linalg.qr(A.T @ Q)
-                Q, _ = jnp.linalg.qr(A @ Q)
-            B = Q.T @ A
-            _, _, vt = jnp.linalg.svd(B, full_matrices=False)
-            return vt
+            # true-f32 matmuls (see _fit_zca): power iterations at bf16
+            # precision lose the small singular directions they exist
+            # to refine
+            with linalg.solver_precision():
+                means = jnp.mean(X, axis=0)
+                A = X - means
+                Y = A @ omega
+                Q, _ = jnp.linalg.qr(Y)
+                for _ in range(self.q):
+                    Q, _ = jnp.linalg.qr(A.T @ Q)
+                    Q, _ = jnp.linalg.qr(A @ Q)
+                B = Q.T @ A
+                _, _, vt = jnp.linalg.svd(B, full_matrices=False)
+                return vt
 
         vt = np.asarray(run(jnp.asarray(X, jnp.float32), jnp.asarray(omega)))
         pca = enforce_matlab_sign_convention(vt.T)

@@ -41,10 +41,16 @@ class ZCAWhitenerEstimator(Estimator):
 
 @jax.jit
 def _fit_zca(mat, eps):
-    n = mat.shape[0]
-    means = jnp.mean(mat, axis=0)
-    centered = mat - means
-    _, s, vt = jnp.linalg.svd(centered, full_matrices=False)
-    scale = (s * s / (n - 1.0) + eps) ** -0.5
-    W = (vt.T * scale) @ vt
-    return W, means
+    from ...ops.linalg import solver_precision
+
+    # true-f32 matmuls: the reference ran this math in exact f32 on CPU
+    # (PCA.scala uses Float); TPU default bf16 passes would be BELOW
+    # reference precision for the whitener the north-star filters use
+    with solver_precision():
+        n = mat.shape[0]
+        means = jnp.mean(mat, axis=0)
+        centered = mat - means
+        _, s, vt = jnp.linalg.svd(centered, full_matrices=False)
+        scale = (s * s / (n - 1.0) + eps) ** -0.5
+        W = (vt.T * scale) @ vt
+        return W, means

@@ -268,9 +268,11 @@ def tsqr_r(A: jax.Array) -> jax.Array:
     @jax.jit
     def run(A):
         def local(a):
-            r = jnp.linalg.qr(a, mode="r")
-            rs = jax.lax.all_gather(r, "data", axis=0)
-            return jnp.linalg.qr(rs.reshape(-1, d), mode="r")
+            # true-f32 QR: the R factor feeds PCA SVDs (solver policy)
+            with solver_precision():
+                r = jnp.linalg.qr(a, mode="r")
+                rs = jax.lax.all_gather(r, "data", axis=0)
+                return jnp.linalg.qr(rs.reshape(-1, d), mode="r")
 
         return shard_map(
             local,
