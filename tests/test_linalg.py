@@ -93,9 +93,36 @@ def test_tsqr_r_matches_numpy():
     assert np.all(np.diag(R) >= 0)
 
 
-def test_tsqr_short_matrix_fallback():
+def test_tsqr_short_shards_pad_and_stay_distributed():
+    # 10 rows over 8 shards would leave shards shorter than d=6; the
+    # pad-and-mask path zero-pads to 6 rows/shard and stays exact.
     A = np.random.RandomState(0).randn(10, 6).astype(np.float32)
     R = np.asarray(linalg.tsqr_r(ArrayDataset.from_numpy(A).data))
+    assert R.shape == (6, 6)
+    np.testing.assert_allclose(R.T @ R, A.T @ A, rtol=1e-3, atol=1e-3)
+
+
+def test_tsqr_uneven_rows_match_numpy():
+    # n not divisible by the shard count: the zero-pad branch inside
+    # tsqr_r must fire (raw array, not ArrayDataset, which would
+    # pre-pad) and agree with a plain host QR up to the sign convention.
+    import jax.numpy as jnp
+
+    A = np.random.RandomState(1).randn(173, 12).astype(np.float32)
+    R = np.asarray(linalg.tsqr_r(jnp.asarray(A)))
+    assert R.shape == (12, 12)
+    Rnp = np.linalg.qr(A, mode="r")
+    Rnp = Rnp * np.sign(np.diag(Rnp))[:, None]
+    np.testing.assert_allclose(R, Rnp, rtol=2e-3, atol=2e-3)
+    assert np.all(np.diag(R) >= 0)
+
+
+def test_tsqr_wide_matrix_replicated_fallback():
+    # n < d is not tall-skinny; R is (n, d) from the replicated path.
+    A = np.random.RandomState(2).randn(5, 9).astype(np.float32)
+    padded = np.asarray(ArrayDataset.from_numpy(A).data)  # rows padded to shards
+    R = np.asarray(linalg.tsqr_r(ArrayDataset.from_numpy(A).data))
+    assert R.shape == (padded.shape[0], 9) and R.shape[0] < 9
     np.testing.assert_allclose(R.T @ R, A.T @ A, rtol=1e-3, atol=1e-3)
 
 
