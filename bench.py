@@ -49,6 +49,7 @@ SMALL = os.environ.get("KEYSTONE_BENCH_SMALL") == "1"
 
 
 _emitted = 0
+_metrics: dict = {}  # metric name -> emitted line (for the summary line)
 
 
 def _emit(metric, value, unit, vs_baseline, **extra):
@@ -57,6 +58,7 @@ def _emit(metric, value, unit, vs_baseline, **extra):
             "vs_baseline": vs_baseline}
     line.update(extra)
     print(json.dumps(line), flush=True)
+    _metrics[metric] = line
     _emitted += 1
 
 
@@ -615,10 +617,26 @@ def imagenet_rehearsal_bench():
           solve_shape=[n_solve, d_solve, n_classes])
 
 
+def _section_cleanup():
+    """Drop cross-section state so one section's HBM residue (datasets,
+    prefix-cached fitted results) can't starve the next."""
+    import gc
+
+    try:
+        _clear_prefix_state()
+    except Exception:
+        pass
+    gc.collect()
+
+
 def main():
-    """Emit every BASELINE metric, one JSON line each, most important
-    last (the accuracy half of the north star). Sections are isolated so
-    a failure in one still leaves the others' lines on stdout."""
+    """Emit every BASELINE metric, one JSON line each. The LAST line —
+    what the driver parses as the headline — restates the flagship
+    RandomPatchCifar featurization metric (same name as round 1) with
+    every other section's value attached as extra keys, so a single
+    line carries the whole picture. Sections are isolated: a failure in
+    one prints its traceback to stdout and the others still emit."""
+    import sys
     import traceback
 
     for section in (featurize_bench, solver_bench, imagenet_rehearsal_bench,
@@ -626,25 +644,49 @@ def main():
         try:
             section()
         except Exception:
-            traceback.print_exc()
+            # stdout, not stderr: the driver captures stdout, so the
+            # evidence of a failed section survives in BENCH_r*.json
+            traceback.print_exc(file=sys.stdout)
+        _section_cleanup()
     if _emitted == 0:
         # every section failed: fail loudly instead of exiting 0 with an
         # empty metrics stream
         raise SystemExit(1)
 
+    flagship = "cifar_randompatch_images_per_sec_per_chip"
+    flag = _metrics.get(flagship)
+    if flag is not None and len(_metrics) > 1:
+        extra = {"summary": True}
+        for name, line in _metrics.items():
+            if name == flagship:
+                continue
+            extra[name] = line["value"]
+            if name == "cifar_randompatch_test_error" and "dataset" in line:
+                extra["accuracy_dataset"] = line["dataset"]
+        _emit(flagship, flag["value"], flag["unit"], flag["vs_baseline"],
+              **extra)
+
 
 if __name__ == "__main__":
     import sys
 
-    if "--solver" in sys.argv:
-        solver_bench()
-    elif "--accuracy" in sys.argv:
-        accuracy_bench()
-    elif "--imagenet" in sys.argv:
-        imagenet_rehearsal_bench()
-    elif "--e2e" in sys.argv:
-        e2e_bench()
-    elif "--featurize" in sys.argv:
-        featurize_bench()
+    sections = {
+        "--solver": solver_bench,
+        "--accuracy": accuracy_bench,
+        "--imagenet": imagenet_rehearsal_bench,
+        "--e2e": e2e_bench,
+        "--featurize": featurize_bench,
+        "--mnist": mnist_bench,
+        "--timit": timit_bench,
+    }
+    picked = [f for f in sys.argv[1:] if f in sections]
+    unknown = [f for f in sys.argv[1:] if f.startswith("--")
+               and f not in sections]
+    if unknown:
+        raise SystemExit(f"unknown bench flags {unknown}; "
+                         f"known: {sorted(sections)}")
+    if picked:
+        for f in picked:
+            sections[f]()
     else:
         main()

@@ -8,6 +8,8 @@ interconnect.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -49,19 +51,18 @@ class BatchPCATransformer(Transformer):
         return self.pca_mat.T @ x
 
 
+@jax.jit
+def _centered_svd_vt(X):
+    # true-f32 (see _fit_zca): the "exact" local PCA must not sit
+    # below the randomized one in fidelity
+    with linalg.solver_precision():
+        means = jnp.mean(X, axis=0)
+        _, _, vt = jnp.linalg.svd(X - means, full_matrices=False)
+        return vt
+
+
 def _svd_pca(data: jnp.ndarray, dims: int) -> np.ndarray:
-    n = data.shape[0]
-
-    @jax.jit
-    def run(X):
-        # true-f32 (see _fit_zca): the "exact" local PCA must not sit
-        # below the randomized one in fidelity
-        with linalg.solver_precision():
-            means = jnp.mean(X, axis=0)
-            _, _, vt = jnp.linalg.svd(X - means, full_matrices=False)
-            return vt
-
-    vt = np.asarray(run(data))
+    vt = np.asarray(_centered_svd_vt(data))
     pca = enforce_matlab_sign_convention(vt.T)
     return pca[:, :dims]
 
@@ -89,6 +90,11 @@ class PCAEstimator(Estimator):
         return max(cpu_w * flops, mem_w * bytes_scanned) + net_w * network
 
 
+@jax.jit
+def _center_masked(X, means, mask):
+    return (X - means) * mask[:, None].astype(X.dtype)
+
+
 class DistributedPCAEstimator(Estimator):
     """Distributed PCA via TSQR: center by broadcast means, tree-QR to the
     small R factor, local SVD of R (reference DistributedPCA.scala:34-57)."""
@@ -101,12 +107,7 @@ class DistributedPCAEstimator(Estimator):
         n = ds.n
         X = ds.data
         means = linalg.distributed_mean(X, n)
-
-        @jax.jit
-        def center(X, means, mask):
-            return (X - means) * mask[:, None].astype(X.dtype)
-
-        Xc = center(X, means, ds.mask)
+        Xc = _center_masked(X, means, ds.mask)
         R = linalg.tsqr_r(Xc)
         _, _, vt = np.linalg.svd(np.asarray(R))
         pca = enforce_matlab_sign_convention(vt.T.astype(np.float32))
@@ -119,6 +120,23 @@ class DistributedPCAEstimator(Estimator):
         bytes_scanned = n * d
         network = d * d * log2m
         return max(cpu_w * flops, mem_w * bytes_scanned) + net_w * network
+
+
+@functools.partial(jax.jit, static_argnames=("q",))
+def _randomized_svd_vt(X, omega, *, q: int):
+    # true-f32 matmuls (see _fit_zca): power iterations at bf16
+    # precision lose the small singular directions they exist to refine
+    with linalg.solver_precision():
+        means = jnp.mean(X, axis=0)
+        A = X - means
+        Y = A @ omega
+        Q, _ = jnp.linalg.qr(Y)
+        for _ in range(q):
+            Q, _ = jnp.linalg.qr(A.T @ Q)
+            Q, _ = jnp.linalg.qr(A @ Q)
+        B = Q.T @ A
+        _, _, vt = jnp.linalg.svd(B, full_matrices=False)
+        return vt
 
 
 class ApproximatePCAEstimator(Estimator):
@@ -140,25 +158,8 @@ class ApproximatePCAEstimator(Estimator):
         rng = np.random.RandomState(self.seed)
         ell = self.dims + self.p
         omega = rng.randn(X.shape[1], ell).astype(np.float32)
-
-        @jax.jit
-        def run(X, omega):
-            # true-f32 matmuls (see _fit_zca): power iterations at bf16
-            # precision lose the small singular directions they exist
-            # to refine
-            with linalg.solver_precision():
-                means = jnp.mean(X, axis=0)
-                A = X - means
-                Y = A @ omega
-                Q, _ = jnp.linalg.qr(Y)
-                for _ in range(self.q):
-                    Q, _ = jnp.linalg.qr(A.T @ Q)
-                    Q, _ = jnp.linalg.qr(A @ Q)
-                B = Q.T @ A
-                _, _, vt = jnp.linalg.svd(B, full_matrices=False)
-                return vt
-
-        vt = np.asarray(run(jnp.asarray(X, jnp.float32), jnp.asarray(omega)))
+        vt = np.asarray(_randomized_svd_vt(
+            jnp.asarray(X, jnp.float32), jnp.asarray(omega), q=self.q))
         pca = enforce_matlab_sign_convention(vt.T)
         return pca[:, : self.dims]
 

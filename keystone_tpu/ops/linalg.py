@@ -172,8 +172,9 @@ def block_coordinate_descent(
     blocks inside mlmatrix's solver; here applied per block as given —
     callers pass the per-block value).
     """
-    run = jax.jit(functools.partial(bcd_core, num_passes=num_passes))
-    return list(run(tuple(blocks), Y, jnp.asarray(lam, Y.dtype)))
+    run = _bcd_jit_for(get_mesh())
+    return list(run(tuple(blocks), Y, jnp.asarray(lam, Y.dtype),
+                    num_passes=num_passes))
 
 
 def _class_spec(k: int):
@@ -229,6 +230,16 @@ def _bcd_core_body(blocks, Y, lam, *, num_passes: int):
     return Ws
 
 
+@functools.lru_cache(maxsize=None)
+def _bcd_jit_for(mesh):
+    """Jitted bcd_core, one cache per mesh: refits at the same shapes and
+    pass count hit the warm executable (a fresh jit(partial(...)) per fit
+    recompiled), while the trace-time sharding constraints from
+    ``_class_spec`` (which read the ambient mesh) can never leak across
+    meshes."""
+    return jax.jit(bcd_core, static_argnames=("num_passes",))
+
+
 def solve_one_pass_l2(
     blocks: Sequence[jax.Array], Y: jax.Array, lam: float
 ) -> List[jax.Array]:
@@ -272,6 +283,13 @@ def tsqr_r(A: jax.Array) -> jax.Array:
         A = jnp.concatenate([A, jnp.zeros((pad, d), A.dtype)], axis=0)
         A = jax.device_put(A, NamedSharding(mesh, P("data", None)))
 
+    return _fix_r_sign(_tsqr_run(mesh)(A))
+
+
+@functools.lru_cache(maxsize=None)
+def _tsqr_run(mesh):
+    """Jitted TSQR body, one compiled program per mesh (a nested jit
+    here would recompile on every call)."""
     from jax import shard_map
 
     @jax.jit
@@ -281,7 +299,7 @@ def tsqr_r(A: jax.Array) -> jax.Array:
             with solver_precision():
                 r = jnp.linalg.qr(a, mode="r")
                 rs = jax.lax.all_gather(r, "data", axis=0)
-                return jnp.linalg.qr(rs.reshape(-1, d), mode="r")
+                return jnp.linalg.qr(rs.reshape(-1, a.shape[-1]), mode="r")
 
         return shard_map(
             local,
@@ -291,7 +309,7 @@ def tsqr_r(A: jax.Array) -> jax.Array:
             check_vma=False,
         )(A)
 
-    return _fix_r_sign(run(A))
+    return run
 
 
 @jax.jit
@@ -303,12 +321,14 @@ def _fix_r_sign(R: jax.Array) -> jax.Array:
 
 # -- helpers ---------------------------------------------------------------
 
+@jax.jit
+def _sum_cols_div(A, n):
+    return jnp.sum(A, axis=0) / n
+
+
 def distributed_mean(A: jax.Array, n: int) -> jax.Array:
     """Column means of a zero-padded row-sharded matrix with true count n
-    (reference ``MatrixUtils.computeMean``, MatrixUtils.scala:123-133)."""
-
-    @jax.jit
-    def run(A):
-        return jnp.sum(A, axis=0) / n
-
-    return run(A)
+    (reference ``MatrixUtils.computeMean``, MatrixUtils.scala:123-133).
+    ``n`` rides as a traced scalar so one compile serves every count."""
+    dt = A.dtype if jnp.issubdtype(A.dtype, jnp.floating) else jnp.float32
+    return _sum_cols_div(A, jnp.asarray(n, dt))

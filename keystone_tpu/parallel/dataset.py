@@ -103,7 +103,11 @@ class ArrayDataset(Dataset):
 
     # -- transforms -------------------------------------------------------
     def map(self, fn: Callable[[Any], Any]) -> "ArrayDataset":
-        """Apply a per-item pure function, batched via vmap under jit."""
+        """Apply a per-item pure function, batched via vmap under jit.
+
+        ``fn`` must be pure: closure-free functions are traced once per
+        input shape and the compiled program is reused across calls, so
+        mutated globals would not be observed."""
         out = _masked_vmap(fn, self.data, self.n, self.padded_n, self.mesh)
         return ArrayDataset(out, self.n, self.mesh, _already_sharded=True)
 
@@ -226,8 +230,44 @@ def _repad(x: jax.Array, rows: int, mesh: Mesh) -> jax.Array:
     return jax.device_put(jnp.pad(x, pad), batch_sharding(mesh))
 
 
+#: fn -> jit(vmap(fn)): repeated maps of the same function (bound methods
+#: of live nodes, module-level functions) reuse the compiled program
+#: instead of paying a fresh jit wrapper — and a recompile — per call.
+#: Closure-capturing functions are NOT cached: a fresh lambda per call
+#: would get zero reuse while pinning its captured arrays forever, and
+#: re-tracing is what picks up their captured state. Cached functions
+#: must therefore be pure in their module globals (they are traced once
+#: per input shape).
+_VMAP_JIT_CACHE: dict = {}
+
+
+def _vmap_cacheable(fn) -> bool:
+    """Only functions with a stable, reusable identity enter the cache:
+    bound methods of eq_key-hashed operators (equal-config instances
+    share one entry) and module-level named functions. Per-call fresh
+    objects (lambdas, locals, partials) would accumulate dead entries."""
+    inner = getattr(fn, "__func__", fn)  # bound method -> function
+    if getattr(inner, "__closure__", None) is not None:
+        return False
+    self_obj = getattr(fn, "__self__", None)
+    if self_obj is not None:
+        return hasattr(self_obj, "eq_key")
+    qn = getattr(inner, "__qualname__", "<lambda>")
+    return "<locals>" not in qn and "<lambda>" not in qn
+
+
 def _masked_vmap(fn, data, n: int, padded_n: int, mesh: Mesh):
-    out = jax.jit(jax.vmap(fn))(data)
+    jfn = None
+    if _vmap_cacheable(fn):
+        try:
+            jfn = _VMAP_JIT_CACHE.get(fn)
+            if jfn is None:
+                jfn = _VMAP_JIT_CACHE[fn] = jax.jit(jax.vmap(fn))
+        except TypeError:  # unhashable fn
+            jfn = None
+    if jfn is None:
+        jfn = jax.jit(jax.vmap(fn))
+    out = jfn(data)
     return _apply_mask(out, n, mesh) if n < padded_n else out
 
 

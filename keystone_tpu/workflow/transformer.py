@@ -21,6 +21,11 @@ from .pipeline import Chainable, Pipeline
 from .graph import Graph
 
 
+#: eq_key -> jit(vmap(apply)). Keeps node instances (hence their params)
+#: alive for the process lifetime — same trade the fusion memo makes.
+_BATCHED_CACHE: dict = {}
+
+
 class Transformer(TransformerOperator, Chainable):
     def apply(self, x: Any) -> Any:
         """Per-item transform (pure, jax-traceable unless host-only)."""
@@ -32,10 +37,24 @@ class Transformer(TransformerOperator, Chainable):
         return ds.map(self.apply)
 
     def _batched(self) -> Callable:
-        """jit(vmap(apply)), cached per instance to avoid re-tracing."""
+        """jit(vmap(apply)), cached per instance AND globally by eq_key.
+
+        The global memo gives equal-config node instances built in later
+        pipelines the SAME jitted callable, so refitting or rebuilding a
+        pipeline reuses the warm XLA executable instead of recompiling
+        (eq_key is the CSE equality — same key means same semantics, so
+        sharing the compiled program is sound by construction).
+        """
         fn = self.__dict__.get("_batched_fn")
         if fn is None:
-            fn = jax.jit(jax.vmap(self.apply))
+            try:
+                key = self._cached_eq_key()
+                fn = _BATCHED_CACHE.get(key)
+                if fn is None:
+                    fn = jax.jit(jax.vmap(self.apply))
+                    _BATCHED_CACHE[key] = fn
+            except TypeError:  # unhashable eq_key: per-instance only
+                fn = jax.jit(jax.vmap(self.apply))
             self.__dict__["_batched_fn"] = fn
         return fn
 

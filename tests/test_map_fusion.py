@@ -238,3 +238,42 @@ def test_gather_host_branch_not_fused():
     g2 = (Pipeline.gather([t(lambda x: x + 1.0, "a"), dev])
           >> VectorCombiner()).graph
     assert len(GatherFusionRule().apply(g2).nodes) < len(g2.nodes)
+
+
+def test_batched_jit_shared_across_equal_instances():
+    """Equal-config node instances built in later pipelines reuse the
+    SAME jitted callable (the warm XLA executable), so a rebuilt/refit
+    pipeline does not recompile its transformer stages."""
+    from keystone_tpu.nodes.util import ClassLabelIndicatorsFromIntLabels
+
+    a = ClassLabelIndicatorsFromIntLabels(7)
+    b = ClassLabelIndicatorsFromIntLabels(7)
+    c = ClassLabelIndicatorsFromIntLabels(9)
+    assert a is not b
+    assert a._batched() is b._batched()
+    assert a._batched() is not c._batched()
+
+
+def _double_for_vmap_cache_test(x):
+    return x * 2.0
+
+
+def test_masked_vmap_jit_cached_per_function():
+    """ArrayDataset.map with a stable-identity function reuses one jit
+    wrapper instead of building (and compiling) a fresh one per call;
+    per-call fresh objects (lambdas/locals) are NOT cached, so they
+    can't accumulate dead entries."""
+    from keystone_tpu.parallel import dataset as ds_mod
+    from keystone_tpu.parallel.dataset import ArrayDataset
+
+    ds = ArrayDataset.from_numpy(np.arange(16, dtype=np.float32))
+    ds.map(_double_for_vmap_cache_test)
+    jfn = ds_mod._VMAP_JIT_CACHE.get(_double_for_vmap_cache_test)
+    assert jfn is not None
+    ds.map(_double_for_vmap_cache_test)
+    assert ds_mod._VMAP_JIT_CACHE.get(_double_for_vmap_cache_test) is jfn
+
+    before = len(ds_mod._VMAP_JIT_CACHE)
+    ds.map(lambda x: x * 3.0)
+    ds.map(lambda x: x * 3.0)
+    assert len(ds_mod._VMAP_JIT_CACHE) == before
