@@ -596,15 +596,23 @@ def imagenet_rehearsal_bench():
     per_chip = n_imgs / feat_dt / len(jax.devices())
 
     # 1000-class weighted solve at the combined FV dimension; warmed so
-    # the metric is solver time, not XLA compile time
+    # the metric is solver time, not XLA compile time. Inputs are staged
+    # on device OUTSIDE the timed region: a fresh numpy fit would time
+    # the ~5-10 MB/s dev-tunnel upload (80 MB -> ~10-15 s), not the
+    # solver — the production path consumes featurizer output already
+    # on device.
+    from keystone_tpu.parallel.dataset import ArrayDataset
+
     X = rng.randn(n_solve, d_solve).astype(np.float32)
     y = rng.randint(0, n_classes, n_solve)
     L = -np.ones((n_solve, n_classes), np.float32)
     L[np.arange(n_solve), y] = 1.0
+    ds_X, ds_L = ArrayDataset.from_numpy(X), ArrayDataset.from_numpy(L)
+    _fence((ds_X.data, ds_L.data))  # staging fence, untimed
     est = BlockWeightedLeastSquaresEstimator(4096, 1, 6e-5, 0.25)
-    _fence(est.fit(X, L).weights)  # warm
+    _fence(est.fit(ds_X, ds_L).weights)  # warm
     t0 = time.perf_counter()
-    model = est.fit(X, L)
+    model = est.fit(ds_X, ds_L)
     # completion fence only — the weights stay device-resident
     _fence(model.weights)
     solve_dt = time.perf_counter() - t0

@@ -1,4 +1,25 @@
 
+from .core import (  # noqa: E402
+    CenterCornerPatcher,
+    Convolver,
+    Cropper,
+    FusedConvRectifyPool,
+    GrayScaler,
+    ImageExtractor,
+    ImageVectorizer,
+    LabelExtractor,
+    PixelScaler,
+    Pooler,
+    RandomFlipper,
+    RandomImageTransformer,
+    RandomPatcher,
+    SymmetricRectifier,
+    Windower,
+)
+from .multilabel import (  # noqa: E402
+    MultiLabelExtractor,
+    MultiLabeledImageExtractor,
+)
 from .extractors import (  # noqa: E402
     BatchSIFTExtractor,
     LCSExtractor,

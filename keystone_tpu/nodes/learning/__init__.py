@@ -68,5 +68,11 @@ __all__ = [
 
 from .lbfgs import SparseLBFGSwithL2  # noqa: E402
 from .least_squares import LeastSquaresEstimator  # noqa: E402
+from .block_weighted import BlockWeightedLeastSquaresEstimator  # noqa: E402
+from .per_class_weighted import (  # noqa: E402
+    PerClassWeightedLeastSquaresEstimator,
+)
 
-__all__ += ["SparseLBFGSwithL2", "LeastSquaresEstimator"]
+__all__ += ["SparseLBFGSwithL2", "LeastSquaresEstimator",
+            "BlockWeightedLeastSquaresEstimator",
+            "PerClassWeightedLeastSquaresEstimator"]
