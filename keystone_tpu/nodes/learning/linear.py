@@ -22,24 +22,28 @@ from ...workflow.transformer import Transformer
 from ..stats import StandardScalerModel
 
 
+@jax.jit
+def _moments3(a):
+    a32 = a.astype(jnp.float32)
+    return jnp.stack(
+        [jnp.sum(a32), jnp.sum(jnp.square(a32)), jnp.sum(jnp.abs(a32))])
+
+
 def _array_token(a):
     """Device-cheap content identity for ``eq_key``: shape + dtype +
-    three global moments (a 12-byte pull) instead of serializing the
-    whole array — the default ``tobytes`` key would drag a fitted
-    (d, C) model through d2h just to hash it during fusion/CSE. A
-    collision needs identical shape AND identical f32 sum /
-    sum-of-squares / sum-of-abs; its only consequence is CSE or the
-    fusion cache merging two indistinguishable models."""
+    three global moments (ONE dispatch, one 12-byte pull) instead of
+    serializing the whole array — the default ``tobytes`` key would
+    drag a fitted (d, C) model through d2h just to hash it during
+    fusion/CSE, and three separate scalar pulls would pay three
+    dev-tunnel round trips. A collision needs identical shape AND
+    identical f32 sum / sum-of-squares / sum-of-abs; its only
+    consequence is CSE or the fusion cache merging two
+    indistinguishable models."""
     if a is None:
         return None
     arr = jnp.asarray(a)
-    return (
-        arr.shape,
-        str(arr.dtype),
-        float(jnp.sum(arr)),
-        float(jnp.sum(jnp.square(arr))),
-        float(jnp.sum(jnp.abs(arr))),
-    )
+    m = np.asarray(_moments3(arr))
+    return (arr.shape, str(arr.dtype), float(m[0]), float(m[1]), float(m[2]))
 
 
 class LinearMapper(Transformer):

@@ -31,6 +31,7 @@ exact for every Gram/cross-product here; operations needing the true count
 from __future__ import annotations
 
 import functools
+import os
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -47,14 +48,25 @@ def _rep(mesh):
 
 # -- Gram / normal equations ----------------------------------------------
 
-#: Solver-path GEMMs run at HIGHEST matmul precision: the reference ran
-#: its solvers in f64, and on TPU the DEFAULT bf16-pass matmul puts
-#: ~1e-3 relative error into Gram matrices — measured 4e-2 relative
-#: solution error vs f64 at reference conditioning (lambda = 6e-5,
-#: kappa ~ 1e6), vs 3e-4 at HIGHEST. Featurization stays DEFAULT.
+#: Solver-path GEMMs run at HIGHEST matmul precision by default: the
+#: reference ran its solvers in f64, and on TPU the DEFAULT bf16-pass
+#: matmul puts ~1e-3 relative error into Gram matrices — measured
+#: 6.6e-2 relative solution error vs f64 at reference conditioning
+#: (lambda = 6e-5, kappa ~ 1e6), vs 4.1e-4 at HIGHEST (6 bf16 passes)
+#: and 1.7e-3 at HIGH (3 passes, ~1.4x faster; prediction-space error
+#: 1.4e-5 — see PERFORMANCE.md). Featurization stays DEFAULT.
 #: This is THE knob: every solver call site uses solver_precision() or
-#: SOLVER_PRECISION, both derived from the name below.
-SOLVER_PRECISION_NAME = "highest"
+#: SOLVER_PRECISION, both derived from the name below; set
+#: KEYSTONE_SOLVER_PRECISION=high to trade the last digit of parity
+#: for solver throughput.
+SOLVER_PRECISION_NAME = os.environ.get(
+    "KEYSTONE_SOLVER_PRECISION", "highest").strip().lower()
+if SOLVER_PRECISION_NAME not in ("high", "highest"):
+    raise ValueError(
+        f"KEYSTONE_SOLVER_PRECISION={SOLVER_PRECISION_NAME!r} — must be "
+        "'high' or 'highest' (DEFAULT-precision solves measured 6.6e-2 "
+        "relative error vs f64 at reference conditioning; see "
+        "PERFORMANCE.md)")
 SOLVER_PRECISION = jax.lax.Precision(SOLVER_PRECISION_NAME)
 
 

@@ -133,3 +133,23 @@ def test_f32_degradation_regime_is_outside_pipeline_position():
     # and is at least no worse than the raw-feature gap (documentation
     # assert: the raw regime is the one to avoid)
     assert scaled_gap <= raw_gap + 1e-12
+
+
+def test_solver_precision_env_knob():
+    """KEYSTONE_SOLVER_PRECISION overrides the solver matmul precision
+    (PERFORMANCE.md documents the measured HIGH-vs-HIGHEST trade)."""
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from keystone_tpu.ops import linalg; "
+         "print(linalg.SOLVER_PRECISION_NAME, linalg.SOLVER_PRECISION)"],
+        env={**__import__("os").environ,
+             "KEYSTONE_SOLVER_PRECISION": "high",
+             "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, check=True,
+    )
+    name, prec = out.stdout.split()
+    assert name == "high"
+    assert prec == "HIGH"  # str(Precision.HIGH) == "HIGH", not "HIGHEST"
