@@ -131,10 +131,11 @@ def normal_equations(A: jax.Array, Y: jax.Array, lam: float = 0.0) -> jax.Array:
     mesh-sharded input keeps the local-GEMM + all-reduce einsum path
     (pallas_call has no partitioning rule).
     """
-    from .pallas_kernels import use_pallas
+    from .pallas_kernels import gram_fits_vmem, use_pallas
 
     lam_arr = jnp.asarray(lam, A.dtype)
-    if use_pallas() and _single_device_f32(A, Y):
+    if (use_pallas() and _single_device_f32(A, Y)
+            and gram_fits_vmem(A.shape[1], Y.shape[1])):
         return _normal_equations_pallas_jit(A, Y, lam_arr)
     return _normal_equations_jit(A, Y, lam_arr)
 

@@ -110,12 +110,34 @@ def use_pallas() -> bool:
     return HAS_PALLAS and jax.default_backend() == "tpu"
 
 
+#: Empirical VMEM budget for the fused gram kernel, in f32 slots of
+#: (dp + 2*tile) * (dp + kp): the (d, d) + (d, k) accumulators live in
+#: VMEM across the whole grid, plus double-buffered (tile, dp) and
+#: (tile, kp) input blocks. Measured on a v5e-class chip at kp=128:
+#: dp=896 compiles, dp=1024 crashes the TPU compiler with a
+#: scoped-vmem OOM — the budget is the measured-pass footprint.
+_GRAM_VMEM_SLOTS = (896 + 2 * ROW_TILE) * (896 + 128)
+
+
+def gram_fits_vmem(d: int, k: int) -> bool:
+    """True when the fused kernel's VMEM-resident footprint
+    (accumulators + double-buffered input tiles) fits for feature dim d
+    and label dim k (post-padding)."""
+    dp = _round_up(max(d, _LANE), _LANE)
+    kp = _round_up(max(k, _LANE), _LANE)
+    return (dp + 2 * ROW_TILE) * (dp + kp) <= _GRAM_VMEM_SLOTS
+
+
 def gram_cross(X: jax.Array, Y: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """Fused (X^T X, X^T Y): Pallas on TPU, two matmuls elsewhere."""
-    if use_pallas():
+    """Fused (X^T X, X^T Y): Pallas on TPU when the footprint fits
+    VMEM; the einsum fallback keeps the solver precision policy."""
+    if use_pallas() and gram_fits_vmem(X.shape[1], Y.shape[1]):
         return gram_cross_pallas(X, Y)
-    Xt = X.T
-    return Xt @ X, Xt @ Y
+    from .linalg import SOLVER_PRECISION
+
+    G = jnp.einsum("nd,ne->de", X, X, precision=SOLVER_PRECISION)
+    C = jnp.einsum("nd,nk->dk", X, Y, precision=SOLVER_PRECISION)
+    return G, C
 
 
 # -- fused CIFAR featurization ---------------------------------------------

@@ -90,3 +90,25 @@ def test_fused_featurize_whitener_means_parity():
 
     want = np.stack([one(i) for i in imgs])
     np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_gram_vmem_guard_boundary():
+    """The fused gram kernel's (d, d)+(d, k) accumulators are VMEM-
+    resident for the whole grid; beyond the measured budget the TPU
+    compiler crashes with a scoped-vmem OOM, so the wrappers must fall
+    back to the einsum path instead of attempting the kernel."""
+    from keystone_tpu.ops.pallas_kernels import gram_fits_vmem
+
+    assert gram_fits_vmem(512, 16)
+    assert gram_fits_vmem(896, 128)
+    assert not gram_fits_vmem(1024, 16)   # measured compile failure
+    assert not gram_fits_vmem(4096, 10)   # ImageNet-scale solve dims
+    assert not gram_fits_vmem(3072, 10)   # LinearPixels dims
+
+
+def test_gram_vmem_guard_counts_input_tiles():
+    """Small-d / large-k shapes blow VMEM through the streamed Y block,
+    not the accumulators — the budget must count input tiles too."""
+    from keystone_tpu.ops.pallas_kernels import gram_fits_vmem
+
+    assert not gram_fits_vmem(128, 6912)
