@@ -181,8 +181,7 @@ class RandomPatcher(Transformer):
         self.patch_size_y = patch_size_y
         self.seed = seed
 
-    def apply_dataset(self, ds: Dataset) -> Dataset:
-        assert isinstance(ds, ArrayDataset)
+    def _make_batch(self):
         px, py, npp = self.patch_size_x, self.patch_size_y, self.num_patches
         seed = self.seed
 
@@ -204,10 +203,14 @@ class RandomPatcher(Transformer):
 
             return jax.vmap(one)(imgs, keys)
 
-        out = ds.map_batch(batch)
+        return batch
+
+    def apply_dataset(self, ds: Dataset) -> Dataset:
+        assert isinstance(ds, ArrayDataset)
+        out = ds.map_batch(self._cached_jit("random_patch", self._make_batch))
         return ArrayDataset(
             _flatten_leading(out.data),
-            n=ds.n * npp,
+            n=ds.n * self.num_patches,
             mesh=ds.mesh,
             _already_sharded=True,
         )
@@ -320,8 +323,7 @@ class RandomImageTransformer(Transformer):
     def apply(self, img):
         return img
 
-    def apply_dataset(self, ds: Dataset) -> Dataset:
-        assert isinstance(ds, ArrayDataset)
+    def _make_batch(self):
         prob, seed, fn = self.prob, self.seed, self.transform
 
         def batch(imgs):
@@ -331,7 +333,12 @@ class RandomImageTransformer(Transformer):
             return jnp.where(
                 hit.reshape((-1,) + (1,) * (imgs.ndim - 1)), changed, imgs)
 
-        return ds.map_batch(batch)
+        return batch
+
+    def apply_dataset(self, ds: Dataset) -> Dataset:
+        assert isinstance(ds, ArrayDataset)
+        return ds.map_batch(
+            self._cached_jit("random_transform", self._make_batch))
 
 
 class FusedConvRectifyPool(Transformer):

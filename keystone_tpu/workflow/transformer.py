@@ -21,9 +21,11 @@ from .pipeline import Chainable, Pipeline
 from .graph import Graph
 
 
-#: eq_key -> jit(vmap(apply)). Keeps node instances (hence their params)
-#: alive for the process lifetime — same trade the fusion memo makes.
-_BATCHED_CACHE: dict = {}
+#: (tag, eq_key) -> jitted callable: the per-item vmap program
+#: ("batched") plus any bespoke whole-batch programs nodes register via
+#: ``_cached_jit``. Keeps node instances (hence their params) alive for
+#: the process lifetime — same trade the fusion memo makes.
+_JIT_CACHE: dict = {}
 
 
 class Transformer(TransformerOperator, Chainable):
@@ -45,17 +47,28 @@ class Transformer(TransformerOperator, Chainable):
         (eq_key is the CSE equality — same key means same semantics, so
         sharing the compiled program is sound by construction).
         """
-        fn = self.__dict__.get("_batched_fn")
+        return self._cached_jit(
+            "batched", lambda: jax.vmap(self.apply))
+
+    def _cached_jit(self, tag: str, builder: Callable[[], Callable]) -> Callable:
+        """jit(builder()), cached per instance and globally by
+        (tag, eq_key) — the mechanism behind ``_batched``, reusable by
+        nodes with bespoke whole-batch programs (e.g. RandomPatcher) so
+        their executables also survive pipeline rebuilds."""
+        attr = "_jit_" + tag
+        fn = self.__dict__.get(attr)
         if fn is None:
             try:
-                key = self._cached_eq_key()
-                fn = _BATCHED_CACHE.get(key)
-                if fn is None:
-                    fn = jax.jit(jax.vmap(self.apply))
-                    _BATCHED_CACHE[key] = fn
+                key = (tag, self._cached_eq_key())
+                fn = _JIT_CACHE.get(key)
             except TypeError:  # unhashable eq_key: per-instance only
-                fn = jax.jit(jax.vmap(self.apply))
-            self.__dict__["_batched_fn"] = fn
+                key = None
+                fn = None
+            if fn is None:
+                fn = jax.jit(builder())
+                if key is not None:
+                    _JIT_CACHE[key] = fn
+            self.__dict__[attr] = fn
         return fn
 
     # -- operator plumbing -------------------------------------------------
@@ -74,8 +87,8 @@ class Transformer(TransformerOperator, Chainable):
 
     # jitted callables must not leak into pickles
     def __getstate__(self):
-        state = dict(self.__dict__)
-        state.pop("_batched_fn", None)
+        state = {k: v for k, v in self.__dict__.items()
+                 if not k.startswith("_jit_")}
         state.pop("_eq_key_val", None)
         return state
 
