@@ -649,12 +649,22 @@ def main():
 
     for section in (featurize_bench, solver_bench, imagenet_rehearsal_bench,
                     e2e_bench, mnist_bench, timit_bench, accuracy_bench):
-        try:
-            section()
-        except Exception:
-            # stdout, not stderr: the driver captures stdout, so the
-            # evidence of a failed section survives in BENCH_r*.json
-            traceback.print_exc(file=sys.stdout)
+        # one retry: the dev tunnel's compile service throws transient
+        # errors ("response body closed before all bytes were read")
+        # that succeed on a second attempt
+        for attempt in (0, 1):
+            try:
+                section()
+                break
+            except Exception:
+                # stdout, not stderr: the driver captures stdout, so the
+                # evidence of a failed section survives in BENCH_r*.json
+                traceback.print_exc(file=sys.stdout)
+                if attempt == 0:
+                    print(f"retrying section {section.__name__} after "
+                          "failure", flush=True)
+                    _section_cleanup()
+                    time.sleep(5)
         _section_cleanup()
     if _emitted == 0:
         # every section failed: fail loudly instead of exiting 0 with an
