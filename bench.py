@@ -31,8 +31,10 @@ configs: ``imagenet_rehearsal_images_per_sec_per_chip`` (SIFT->PCA->FV +
 classes), each through the real app DAG on synthetic data with the
 test error recorded in the metric line.
 
-``--solver``/``--featurize``/``--e2e``/``--imagenet``/``--accuracy``
-run a single section.
+``--solver``/``--featurize``/``--e2e``/``--imagenet``/``--mnist``/
+``--timit``/``--newsgroups``/``--accuracy`` run a single section
+(``newsgroups_docs_per_sec`` covers the BASELINE text config:
+bigrams + binary TF + CommonSparseFeatures 100k + NaiveBayes).
 ``KEYSTONE_BENCH_SMALL=1`` shrinks sizes for CPU smoke-testing.
 """
 from __future__ import annotations
@@ -520,6 +522,63 @@ def mnist_bench():
           test_error=round(float(test_eval.total_error), 4))
 
 
+def newsgroups_bench():
+    """NewsgroupsPipeline at the reference featurization config
+    (BASELINE.md: bigrams + binary TermFrequency + CommonSparseFeatures
+    100k + NaiveBayes, NewsgroupsPipeline.scala:24-31) on a synthetic
+    20-class corpus: docs/sec through the real app DAG. The featurizer
+    and the sparse NaiveBayes fit are host-stage (tokenize/ngram/count —
+    CPU-bound in the reference's Spark executors too); scoring runs as
+    the padded-COO device einsum. No published baseline; vs_baseline
+    against a 1k docs/sec strawman.
+    """
+    from keystone_tpu.loaders.csv_loader import LabeledData
+    from keystone_tpu.parallel.dataset import ArrayDataset, HostDataset
+    from keystone_tpu.pipelines.text.newsgroups import (
+        NewsgroupsConfig,
+        run,
+    )
+
+    n_classes = 20
+    n_train = 512 if SMALL else 4_096
+    n_test = 128 if SMALL else 1_024
+    words_per_doc = 40
+
+    rng = np.random.RandomState(0)
+    # class-specific vocabularies over a shared common pool
+    common = [f"word{i}" for i in range(2_000)]
+    class_vocab = [[f"c{c}w{i}" for i in range(50)] for c in range(n_classes)]
+
+    def corpus(n, seed):
+        r = np.random.RandomState(seed)
+        y = r.randint(0, n_classes, n)
+        docs = []
+        for i in range(n):
+            own = r.choice(class_vocab[y[i]], words_per_doc // 4)
+            noise = r.choice(common, words_per_doc - len(own))
+            words = np.concatenate([own, noise])
+            r.shuffle(words)
+            docs.append(" ".join(words))
+        return LabeledData(
+            data=HostDataset(docs),
+            labels=ArrayDataset.from_numpy(y.astype(np.int32)),
+        )
+
+    train, test = corpus(n_train, 1), corpus(n_test, 2)
+    config = NewsgroupsConfig(n_grams=2, common_features=100_000)
+
+    run(config, train=train, test=test, num_classes=n_classes)  # warm
+    _clear_prefix_state()
+    t0 = time.perf_counter()
+    _, test_eval = run(config, train=train, test=test,
+                       num_classes=n_classes)
+    dt = time.perf_counter() - t0
+    per_sec = (n_train + n_test) / dt
+    _emit("newsgroups_docs_per_sec", round(per_sec, 1), "docs/sec",
+          round(per_sec / 1_000.0, 4),
+          test_error=round(float(test_eval.total_error), 4))
+
+
 # -------------------------------------------- ImageNet shape rehearsal
 
 
@@ -648,7 +707,8 @@ def main():
     import traceback
 
     for section in (featurize_bench, solver_bench, imagenet_rehearsal_bench,
-                    e2e_bench, mnist_bench, timit_bench, accuracy_bench):
+                    e2e_bench, mnist_bench, timit_bench, newsgroups_bench,
+                    accuracy_bench):
         # one retry: the dev tunnel's compile service throws transient
         # errors ("response body closed before all bytes were read")
         # that succeed on a second attempt
@@ -696,6 +756,7 @@ if __name__ == "__main__":
         "--featurize": featurize_bench,
         "--mnist": mnist_bench,
         "--timit": timit_bench,
+        "--newsgroups": newsgroups_bench,
     }
     picked = [f for f in sys.argv[1:] if f in sections]
     unknown = [f for f in sys.argv[1:] if f.startswith("--")

@@ -26,7 +26,7 @@ from ...nodes.nlp import (
     Trim,
 )
 from ...nodes.stats import TermFrequency
-from ...nodes.util import CommonSparseFeatures, Densify, MaxClassifier
+from ...nodes.util import CommonSparseFeatures, MaxClassifier
 
 
 @dataclass
@@ -55,9 +55,12 @@ def run(config: NewsgroupsConfig, train: Optional[LabeledData] = None,
         featurizer = (
             Trim() >> LowerCase() >> Tokenizer() >> NGramsFeaturizer(orders)
         )
+    # NaiveBayes consumes the SparseVectors directly (the reference fed
+    # MLlib sparse vectors, NewsgroupsPipeline.scala:24-31) — a Densify
+    # here would materialize an (n, 100k) dense matrix for nothing
     predictor = (featurizer >> TermFrequency(lambda x: 1)).and_then(
         CommonSparseFeatures(config.common_features), train.data
-    ) >> Densify()
+    )
     predictor = predictor.and_then(
         NaiveBayesEstimator(num_classes), train.data, train.labels
     ) >> MaxClassifier()

@@ -231,3 +231,38 @@ def test_kmeans_emptied_cluster_keeps_center():
     # itself is always finite, so assert through the means)
     assign = np.asarray(jax.vmap(model.apply)(jnp.asarray(X)))
     assert np.isfinite(assign @ np.asarray(model.means)).all()
+
+
+def test_naive_bayes_sparse_matches_dense(mesh8):
+    """The sparse host path (text pipeline) must produce the same model
+    and scores as the dense device path."""
+    from keystone_tpu.nodes.learning import NaiveBayesEstimator
+    from keystone_tpu.nodes.util.sparse import SparseVector
+    from keystone_tpu.parallel.dataset import ArrayDataset, HostDataset
+
+    rng = np.random.RandomState(0)
+    n, d, k = 48, 30, 4
+    dense = (rng.rand(n, d) < 0.2).astype(np.float32) * rng.randint(
+        1, 4, (n, d))
+    y = rng.randint(0, k, n).astype(np.int32)
+    sparse_items = [
+        SparseVector(np.nonzero(row)[0], row[np.nonzero(row)[0]], d)
+        for row in dense
+    ]
+
+    est = NaiveBayesEstimator(k)
+    m_dense = est.fit(ArrayDataset.from_numpy(dense),
+                      ArrayDataset.from_numpy(y))
+    m_sparse = est.fit(HostDataset(sparse_items),
+                       ArrayDataset.from_numpy(y))
+    np.testing.assert_allclose(m_sparse.pi, m_dense.pi, rtol=1e-5)
+    np.testing.assert_allclose(m_sparse.theta, m_dense.theta, rtol=1e-5)
+
+    dense_scores = m_dense.apply_dataset(
+        ArrayDataset.from_numpy(dense)).numpy()
+    sparse_scores = m_sparse.apply_dataset(HostDataset(sparse_items))
+    np.testing.assert_allclose(
+        np.asarray(sparse_scores.numpy()), dense_scores, rtol=1e-4,
+        atol=1e-4)
+    one = np.asarray(m_sparse.apply(sparse_items[0]))
+    np.testing.assert_allclose(one, dense_scores[0], rtol=1e-4, atol=1e-4)
