@@ -50,11 +50,9 @@ class NaiveBayesModel(Transformer):
         return self.pi + self.theta @ x
 
     def apply_dataset(self, ds: Dataset) -> Dataset:
-        from ...parallel.dataset import HostDataset
-        from ..util.sparse import SparseVector
+        from ..util.sparse import is_sparse_host
 
-        if isinstance(ds, HostDataset) and ds.items and isinstance(
-                ds.items[0], SparseVector):
+        if is_sparse_host(ds):
             return SparseLinearMapper(
                 self.theta.T, intercept=self.pi).apply_dataset(ds)
         return super().apply_dataset(ds)
@@ -94,7 +92,9 @@ class NaiveBayesEstimator(LabelEstimator):
             d = items[0].size
             sums = np.zeros((k, d), np.float64)
             for sv, c in zip(items, y):
-                assert sv.size == d, f"item size {sv.size} != {d}"
+                if sv.size != d:
+                    raise ValueError(
+                        f"item size {sv.size} != {d} (mixed feature spaces)")
                 # SparseVector indices are coalesced-unique, so plain
                 # fancy-index += is exact (and much faster than add.at)
                 sums[c, sv.indices] += sv.values
@@ -124,13 +124,32 @@ def _per_class_sums(X, y, mask, num_classes):
 
 class LogisticRegressionModel(Transformer):
     """argmax-class prediction from a multinomial logistic model
-    (reference LogisticRegressionModel.scala: MLlib model.predict)."""
+    (reference LogisticRegressionModel.scala: MLlib model.predict).
+    Sparse inputs score via index gathers / the padded-COO device
+    einsum, like the MLlib model over sparse vectors."""
 
     def __init__(self, weights: np.ndarray):
         self.weights = np.asarray(weights, dtype=np.float32)  # (d, k)
 
     def apply(self, x):
+        from ..util.sparse import SparseVector
+
+        if isinstance(x, SparseVector):
+            assert x.size == self.weights.shape[0], (
+                f"sparse input size {x.size} != model dim "
+                f"{self.weights.shape[0]}")
+            scores = x.values @ self.weights[x.indices]
+            return jnp.argmax(scores, axis=-1).astype(jnp.int32)
         return jnp.argmax(x @ self.weights, axis=-1).astype(jnp.int32)
+
+    def apply_dataset(self, ds: Dataset) -> Dataset:
+        from ..util.sparse import is_sparse_host
+
+        if is_sparse_host(ds):
+            scores = SparseLinearMapper(self.weights).apply_dataset(ds)
+            return scores.map_batch(
+                lambda s: jnp.argmax(s, axis=-1).astype(jnp.int32))
+        return super().apply_dataset(ds)
 
 
 class LogisticRegressionEstimator(LabelEstimator):
@@ -151,6 +170,10 @@ class LogisticRegressionEstimator(LabelEstimator):
         self.convergence_tol = convergence_tol
 
     def _fit(self, ds: Dataset, labels: Dataset) -> LogisticRegressionModel:
+        from ...parallel.dataset import HostDataset
+
+        if isinstance(ds, HostDataset):
+            return self._fit_sparse(ds, labels)
         assert isinstance(ds, ArrayDataset) and isinstance(labels, ArrayDataset)
         W = _fit_logistic(
             ds.data,
@@ -161,6 +184,25 @@ class LogisticRegressionEstimator(LabelEstimator):
             jnp.asarray(self.reg_param, ds.data.dtype),
             self.num_iters,
             self.convergence_tol,
+        )
+        return LogisticRegressionModel(np.asarray(W))
+
+    def _fit_sparse(self, ds, labels) -> LogisticRegressionModel:
+        """Padded-COO softmax L-BFGS — the sparse text path (reference
+        AmazonReviewsPipeline.scala:25-33 fed MLlib sparse vectors; no
+        (n, d) densification)."""
+        from ..util.sparse import pack_sparse_fit_inputs
+
+        indices, values, d, y = pack_sparse_fit_inputs(ds, labels)
+        n = len(y)
+        coo = ArrayDataset.from_numpy(
+            {"indices": indices, "values": values})
+        yd = ArrayDataset.from_numpy(y.astype(np.int32).ravel())
+        W = _run_sparse_logistic(
+            coo.data["indices"], coo.data["values"], yd.data, coo.mask,
+            d, n, self.num_classes,
+            jnp.asarray(self.reg_param, jnp.float32),
+            self.num_iters, self.convergence_tol,
         )
         return LogisticRegressionModel(np.asarray(W))
 
@@ -185,6 +227,39 @@ def _fit_logistic(X, y, mask, n, num_classes, lam, num_iters, tol):
     res = lbfgs(
         value_and_grad,
         jnp.zeros((d, num_classes), X.dtype),
+        max_iters=num_iters,
+        tol=tol,
+    )
+    return res.x
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("d", "n", "num_classes", "num_iters", "tol"))
+def _run_sparse_logistic(indices, values, y, mask, d, n, num_classes,
+                         lam, num_iters, tol):
+    """Same objective as ``_fit_logistic``, with X as padded COO: logits
+    by gather-einsum, gradient by scatter-add (the SparseLBFGSwithL2
+    layout, ``lbfgs.py::_run_sparse_lbfgs``)."""
+    m = mask.astype(values.dtype)
+    vals = values * m[:, None]
+    onehot = jax.nn.one_hot(y, num_classes, dtype=values.dtype)
+    flat_idx = indices.reshape(-1)
+
+    def value_and_grad(W):
+        logits = jnp.einsum("rs,rsk->rk", vals, W[indices])
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ce = -jnp.sum(onehot * logp, axis=-1) * m
+        loss = jnp.sum(ce) / n + 0.5 * lam * jnp.sum(W * W)
+        G = (jnp.exp(logp) - onehot) * m[:, None]
+        contrib = (vals[:, :, None] * G[:, None, :]).reshape(
+            -1, num_classes)
+        grad = jnp.zeros_like(W).at[flat_idx].add(contrib) / n + lam * W
+        return loss, grad
+
+    res = lbfgs(
+        value_and_grad,
+        jnp.zeros((d, num_classes), jnp.float32),
         max_iters=num_iters,
         tol=tol,
     )

@@ -149,17 +149,14 @@ class SparseLBFGSwithL2(LabelEstimator):
 
     def _fit(self, ds: Dataset, labels: Dataset):
         from .classifiers import SparseLinearMapper
-        from ..util.sparse import SparseVector, sparse_batch
+        from ..util.sparse import pack_sparse_fit_inputs
 
         if isinstance(ds, ArrayDataset):
             raise TypeError(
                 "SparseLBFGSwithL2 expects a host dataset of SparseVectors; "
                 "dense arrays should use DenseLBFGSwithL2")
-        items = ds.collect()
-        assert items and isinstance(items[0], SparseVector), (
-            "SparseLBFGSwithL2 expects SparseVector items")
-        indices, values, d = sparse_batch(items)
-        n = len(items)
+        indices, values, d, y_arr = pack_sparse_fit_inputs(ds, labels)
+        n = len(y_arr)
         if self.fit_intercept:
             # ones column: index d, value 1 in an extra slot per row
             indices = np.concatenate(
@@ -172,13 +169,7 @@ class SparseLBFGSwithL2(LabelEstimator):
 
         coo = ArrayDataset.from_numpy(
             {"indices": indices, "values": values})
-        lab = labels if isinstance(labels, ArrayDataset) else \
-            ArrayDataset.from_numpy(
-                np.asarray(labels.collect(), np.float32))
-        if len(lab) != n:
-            raise ValueError(
-                f"labels ({len(lab)} rows) do not align with data ({n} rows)")
-        Y = lab.data
+        Y = ArrayDataset.from_numpy(np.asarray(y_arr, np.float32)).data
 
         W = _run_sparse_lbfgs(
             coo.data["indices"], coo.data["values"], Y, coo.mask,

@@ -90,9 +90,40 @@ def sparse_batch(items: Sequence[SparseVector], max_nnz: Optional[int] = None,
                 f"item {i} has nnz={it.nnz} > max_nnz={m}; pass "
                 "allow_truncate=True to drop features")
         k = min(it.nnz, m)
+        if it.size != size:
+            raise ValueError(
+                f"item {i} has size {it.size} != {size} (mixed feature "
+                "spaces in one sparse batch)")
         indices[i, :k] = it.indices[:k]
         values[i, :k] = it.values[:k]
     return indices, values, size
+
+
+def is_sparse_host(ds) -> bool:
+    """True for a HostDataset whose items are SparseVectors — the shared
+    dispatch predicate of every sparse-input model path."""
+    return (isinstance(ds, HostDataset) and bool(ds.items)
+            and isinstance(ds.items[0], SparseVector))
+
+
+def pack_sparse_fit_inputs(ds, labels):
+    """Collect a sparse host dataset + labels into aligned arrays for a
+    solver: ``(indices, values, size, y ndarray)``. Validates item types,
+    uniform feature-space size, and feature/label alignment — the shared
+    preamble of SparseLBFGSwithL2 / sparse NaiveBayes / sparse logistic."""
+    items = ds.collect()
+    if not (items and isinstance(items[0], SparseVector)):
+        raise TypeError("sparse fit needs a host dataset of SparseVectors")
+    indices, values, size = sparse_batch(items)
+    if isinstance(labels, ArrayDataset):
+        y = np.asarray(labels.numpy())
+    else:
+        y = np.asarray(labels.collect())
+    if len(items) != len(y):
+        raise ValueError(
+            f"labels ({len(y)} rows) do not align with data "
+            f"({len(items)} rows)")
+    return indices, values, size, y
 
 
 class Sparsify(HostTransformer):

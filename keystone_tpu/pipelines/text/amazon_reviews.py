@@ -18,7 +18,7 @@ from ...loaders.csv_loader import LabeledData
 from ...nodes.learning import LogisticRegressionEstimator
 from ...nodes.nlp import LowerCase, NGramsFeaturizer, Tokenizer, Trim
 from ...nodes.stats import TermFrequency
-from ...nodes.util import CommonSparseFeatures, Densify
+from ...nodes.util import CommonSparseFeatures
 
 
 @dataclass
@@ -48,7 +48,10 @@ def run(config: AmazonReviewsConfig, train: Optional[LabeledData] = None,
         >> TermFrequency(lambda x: 1)
     ).and_then(
         CommonSparseFeatures(config.common_features), train.data
-    ) >> Densify()
+    )
+    # LogisticRegression consumes the SparseVectors directly (the
+    # reference fed MLlib sparse vectors, AmazonReviewsPipeline.scala:
+    # 25-33) — no (n, 100k) densification
     predictor = predictor.and_then(
         LogisticRegressionEstimator(num_classes=2, num_iters=config.num_iters),
         train.data, train.labels,

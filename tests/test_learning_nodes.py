@@ -266,3 +266,39 @@ def test_naive_bayes_sparse_matches_dense(mesh8):
         atol=1e-4)
     one = np.asarray(m_sparse.apply(sparse_items[0]))
     np.testing.assert_allclose(one, dense_scores[0], rtol=1e-4, atol=1e-4)
+
+
+def test_logistic_sparse_matches_dense(mesh8):
+    """Sparse COO logistic regression must converge to the dense path's
+    model (same objective, same optimizer)."""
+    from keystone_tpu.nodes.learning import LogisticRegressionEstimator
+    from keystone_tpu.nodes.util.sparse import SparseVector
+    from keystone_tpu.parallel.dataset import ArrayDataset, HostDataset
+
+    rng = np.random.RandomState(1)
+    n, d, k = 64, 24, 3
+    dense = (rng.rand(n, d) < 0.3).astype(np.float32) * rng.rand(n, d)
+    protos = rng.randn(k, d).astype(np.float32)
+    y = rng.randint(0, k, n).astype(np.int32)
+    dense += protos[y] * 0.5  # separable signal
+    sparse_items = [
+        SparseVector(np.nonzero(row)[0], row[np.nonzero(row)[0]], d)
+        for row in dense
+    ]
+
+    est = LogisticRegressionEstimator(num_classes=k, reg_param=1e-2,
+                                      num_iters=60)
+    m_dense = est.fit(ArrayDataset.from_numpy(dense),
+                      ArrayDataset.from_numpy(y))
+    m_sparse = est.fit(HostDataset(sparse_items),
+                       ArrayDataset.from_numpy(y))
+    np.testing.assert_allclose(
+        m_sparse.weights, m_dense.weights, rtol=1e-3, atol=1e-3)
+
+    dense_pred = np.asarray(m_dense.apply_dataset(
+        ArrayDataset.from_numpy(dense)).numpy())
+    sparse_pred = np.asarray(
+        m_sparse.apply_dataset(HostDataset(sparse_items)).numpy())
+    np.testing.assert_array_equal(sparse_pred, dense_pred)
+    one = int(m_sparse.apply(sparse_items[0]))
+    assert one == dense_pred[0]
