@@ -24,9 +24,15 @@ from ..stats import StandardScalerModel
 
 @jax.jit
 def _moments3(a):
+    # nan-ignoring moments + a non-finite count: finite arrays get the
+    # plain moments (count 0); broken arrays stay distinguishable by
+    # their finite content instead of collapsing to one NaN token
     a32 = a.astype(jnp.float32)
+    finite = jnp.isfinite(a32)
+    z = jnp.where(finite, a32, 0.0)
     return jnp.stack(
-        [jnp.sum(a32), jnp.sum(jnp.square(a32)), jnp.sum(jnp.abs(a32))])
+        [jnp.sum(z), jnp.sum(jnp.square(z)), jnp.sum(jnp.abs(z)),
+         jnp.sum(~finite).astype(jnp.float32)])
 
 
 def _array_token(a):
@@ -43,7 +49,21 @@ def _array_token(a):
         return None
     arr = jnp.asarray(a)
     m = np.asarray(_moments3(arr))
-    return (arr.shape, str(arr.dtype), float(m[0]), float(m[1]), float(m[2]))
+    if m[3] != 0.0:
+        # NaN would poison dict keys (NaN != NaN makes a fitted model
+        # unequal to ITSELF, silently defeating CSE/fusion/jit caches
+        # forever) — the nan-ignoring moments keep the key stable AND
+        # content-distinguishing, and a non-finite fitted array is
+        # worth shouting about: a silently-NaN solve predicts a
+        # constant class.
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "fitted array %s contains %d non-finite values — the solve "
+            "likely failed; check conditioning/lambda",
+            arr.shape, int(m[3]))
+    return (arr.shape, str(arr.dtype),
+            float(m[0]), float(m[1]), float(m[2]), float(m[3]))
 
 
 class LinearMapper(Transformer):

@@ -227,3 +227,36 @@ def test_fitted_mapper_eq_key_is_device_cheap():
     b2 = BlockLeastSquaresEstimator(block_size=8, num_iter=1, lam=0.2).fit(A, Y)
     assert b1.eq_key() == b2.eq_key()
     assert sum(payload(b1.eq_key())) < np.asarray(b1.weights).size * 4
+
+
+def test_nan_weights_token_is_cache_stable(caplog):
+    """A fitted model with non-finite weights must still equal an
+    identically-valued copy (NaN != NaN would make models unequal to
+    themselves, silently defeating CSE/fusion/jit caches), and the
+    non-finite solve must be loudly flagged."""
+    import logging
+
+    from keystone_tpu.nodes.learning.linear import BlockLinearMapper
+
+    W = np.full((4, 3), np.nan, np.float32)
+    with caplog.at_level(logging.WARNING):
+        a = BlockLinearMapper([W], 4)
+        b = BlockLinearMapper([W.copy()], 4)
+        assert a.eq_key() == b.eq_key()
+        assert hash(a) == hash(b)
+    assert any("non-finite" in r.message for r in caplog.records)
+
+
+def test_nan_token_distinguishes_different_broken_models():
+    """Two NaN-containing models with different finite content must NOT
+    collapse to one eq_key (a cache substituting one broken model for
+    another would serve wrong predictions with no error)."""
+    from keystone_tpu.nodes.learning.linear import BlockLinearMapper
+
+    Wa = np.arange(12, dtype=np.float32).reshape(4, 3)
+    Wb = Wa * 2.0
+    Wa[0, 0] = np.nan
+    Wb[0, 0] = np.nan
+    a = BlockLinearMapper([Wa], 4)
+    b = BlockLinearMapper([Wb], 4)
+    assert a.eq_key() != b.eq_key()

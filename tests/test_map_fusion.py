@@ -277,3 +277,55 @@ def test_masked_vmap_jit_cached_per_function():
     ds.map(lambda x: x * 3.0)
     ds.map(lambda x: x * 3.0)
     assert len(ds_mod._VMAP_JIT_CACHE) == before
+
+
+def test_app_rebuild_compiles_nothing(mesh8):
+    """End-to-end pin of PERFORMANCE.md rule 5: rebuilding and refitting
+    an app in the same process must reuse every compiled program."""
+    import io
+    import logging
+
+    import jax
+
+    from keystone_tpu.loaders.csv_loader import LabeledData
+    from keystone_tpu.parallel.dataset import ArrayDataset
+    from keystone_tpu.pipelines.images.mnist.random_fft import (
+        MnistRandomFFTConfig,
+        run,
+    )
+    from keystone_tpu.workflow.env import PipelineEnv
+
+    rng = np.random.RandomState(0)
+    protos = rng.rand(10, 784).astype(np.float32)
+
+    def split(n, seed):
+        r = np.random.RandomState(seed)
+        y = r.randint(0, 10, n)
+        X = np.clip(protos[y] + 0.3 * r.randn(n, 784), 0, 1).astype(
+            np.float32)
+        return LabeledData(ArrayDataset.from_numpy(X),
+                           ArrayDataset.from_numpy(y.astype(np.int32)))
+
+    # well-posed sizes (n > d): an underdetermined solve at tiny lam
+    # NaNs out in f32 and would test the NaN-token path, not reuse
+    train, test = split(1024, 1), split(128, 2)
+    config = MnistRandomFFTConfig(num_ffts=1, block_size=512, lam=1e-2)
+    run(config, train=train, test=test)  # warm build
+    PipelineEnv.get_or_create().clear_state()
+
+    jax.config.update("jax_log_compiles", True)
+    buf = io.StringIO()
+    handler = logging.StreamHandler(buf)
+    loggers = [logging.getLogger("jax._src.interpreters.pxla"),
+               logging.getLogger("jax._src.dispatch")]
+    for lg in loggers:
+        lg.addHandler(handler)
+        lg.setLevel(logging.WARNING)
+    try:
+        run(config, train=train, test=test)
+    finally:
+        jax.config.update("jax_log_compiles", False)
+        for lg in loggers:
+            lg.removeHandler(handler)
+    compiles = [ln for ln in buf.getvalue().splitlines() if "Compiling" in ln]
+    assert not compiles, compiles
