@@ -92,11 +92,39 @@ def cross(A: jax.Array, B: jax.Array, preferred: Optional[jnp.dtype] = None) -> 
 
 
 def ridge_cho_solve(AtA: jax.Array, Atb: jax.Array, lam: float) -> jax.Array:
-    """Solve (AtA + lam*I) W = Atb by Cholesky (replicated on all chips)."""
+    """Solve (AtA + lam*I) W = Atb by Cholesky (replicated on all chips).
+
+    When f32 Cholesky breaks down (kappa beyond ~1/eps_f32: a negative
+    pivot NaNs the whole factor — the regime the reference's f64 solver
+    survived), an eigendecomposition with clamped eigenvalues recovers a
+    finite, more-strongly-regularized solution instead of silently
+    returning NaN weights that predict a constant class."""
     d = AtA.shape[0]
     reg = AtA + lam * jnp.eye(d, dtype=AtA.dtype)
     factor = jax.scipy.linalg.cho_factor(reg, lower=True)
-    return jax.scipy.linalg.cho_solve(factor, Atb)
+    W = jax.scipy.linalg.cho_solve(factor, Atb)
+    return _finite_or_eigh_solve(W, lambda: reg, Atb)
+
+
+def _finite_or_eigh_solve(W, reg_fn, rhs, ok=None):
+    """W when the solve succeeded, else the eigh-clamped solve of
+    reg_fn() @ X = rhs. ``reg_fn`` is traced only inside the fallback
+    branch, so a Gram recompute there costs nothing unless the branch
+    is taken. ``ok`` overrides the success predicate (e.g. a factor-
+    level finiteness check computed once per block). The predicate is
+    replicated, so all devices take the same branch."""
+
+    def fallback(_):
+        with solver_precision():
+            reg = reg_fn()
+            w, V = jnp.linalg.eigh(reg)
+            floor = jnp.maximum(jnp.max(jnp.abs(w)) * 1e-6, 1e-30)
+            wc = jnp.maximum(w, floor)
+            return (V * (1.0 / wc)) @ (V.T @ rhs)
+
+    if ok is None:
+        ok = jnp.all(jnp.isfinite(W))
+    return jax.lax.cond(ok, lambda _: W, fallback, None)
 
 
 @functools.partial(jax.jit, static_argnames=())
@@ -156,7 +184,10 @@ def _dual_solve_jit(A, Y, lam):
         n = A.shape[0]
         K = A @ A.T + lam * jnp.eye(n, dtype=A.dtype)
         factor = jax.scipy.linalg.cho_factor(K, lower=True)
-        return A.T @ jax.scipy.linalg.cho_solve(factor, Y)
+        alpha = jax.scipy.linalg.cho_solve(factor, Y)
+        # same f32 breakdown recovery as ridge_cho_solve
+        alpha = _finite_or_eigh_solve(alpha, lambda: K, Y)
+        return A.T @ alpha
 
 
 # -- Block coordinate descent ---------------------------------------------
@@ -225,10 +256,16 @@ def _bcd_core_body(blocks, Y, lam, *, num_passes: int):
         Y = jax.lax.with_sharding_constraint(Y, y_spec)
     # Precompute per-block Cholesky factors once per solve: the Gram of
     # each block is pass-invariant, so multi-pass BCD reuses factors.
+    # A breakdown (non-finite factor) is detected here, once per block;
+    # broken blocks take the eigh fallback every pass — acceptable in
+    # the exceptional path, and healthy blocks carry no extra buffers.
     factors = []
+    factor_ok = []
     for A in blocks:
         G = gram(A) + lam * jnp.eye(A.shape[1], dtype=dtype)
-        factors.append(jax.scipy.linalg.cho_factor(G, lower=True))
+        L = jax.scipy.linalg.cho_factor(G, lower=True)
+        factors.append(L)
+        factor_ok.append(jnp.all(jnp.isfinite(L[0])))
     Ws = [jnp.zeros((A.shape[1], k), dtype) for A in blocks]
     pred = jnp.zeros_like(Y)
     for _ in range(num_passes):
@@ -238,6 +275,15 @@ def _bcd_core_body(blocks, Y, lam, *, num_passes: int):
             if w_spec is not None:
                 rhs = jax.lax.with_sharding_constraint(rhs, w_spec)
             Wi = jax.scipy.linalg.cho_solve(factors[i], rhs)
+            # f32 Cholesky breakdown recovery (see ridge_cho_solve):
+            # the Gram is recomputed only inside the rarely-taken branch
+            Wi = _finite_or_eigh_solve(
+                Wi,
+                lambda A=A: gram(A) + lam * jnp.eye(
+                    A.shape[1], dtype=dtype),
+                rhs,
+                ok=factor_ok[i],
+            )
             pred = pred + A @ (Wi - Ws[i])
             Ws[i] = Wi
     return Ws
