@@ -434,7 +434,24 @@ def _chunked_delta(Xb, res, mask, counts, joint_means, model,
 def _pop_cholesky(pop_cov, w, lam):
     d_b = pop_cov.shape[0]
     M = (1 - w) * pop_cov + lam * jnp.eye(d_b, dtype=pop_cov.dtype)
-    return jnp.linalg.cholesky(M)
+    L = jnp.linalg.cholesky(M)
+
+    def repair(_):
+        # f32 breakdown recovery (shared clamp policy:
+        # ops/linalg.clamped_eigh — floor scaled so the reconstruction
+        # is safely SPD): re-Cholesky the clamped matrix, with a
+        # guaranteed-finite identity-scaled factor as the last resort
+        # should even that factorization round indefinite.
+        from ...ops.linalg import clamped_eigh
+
+        V, wc = clamped_eigh(M)
+        L2 = jnp.linalg.cholesky((V * wc) @ V.T)
+        L3 = jnp.sqrt(jnp.max(wc)) * jnp.eye(d_b, dtype=M.dtype)
+        return jax.lax.cond(
+            jnp.all(jnp.isfinite(L2)), lambda _: L2, lambda _: L3, None)
+
+    return jax.lax.cond(
+        jnp.all(jnp.isfinite(L)), lambda _: L, repair, None)
 
 
 @functools.partial(jax.jit, static_argnames=("n", "k"))
@@ -491,7 +508,18 @@ def _chunk_solve(Xb, res, mask, counts, joint_means, model_c, pop_xtr_c,
     )
     A = joint_xtx + lam * jnp.eye(d_b, dtype=Xb.dtype)[None]
     chol = jnp.linalg.cholesky(A)                         # SPD: batched Cholesky
-    return jax.scipy.linalg.cho_solve((chol, True), rhs[..., None])[..., 0]
+    sol = jax.scipy.linalg.cho_solve((chol, True), rhs[..., None])[..., 0]
+
+    def repair(_):
+        # f32 breakdown recovery for the whole chunk (rare; shared
+        # clamp policy: ops/linalg.clamped_eigh): batched clamped solve
+        from ...ops.linalg import clamped_eigh
+
+        V, wc = clamped_eigh(A)
+        return jnp.einsum("cde,ce,cfe,cf->cd", V, 1.0 / wc, V, rhs)
+
+    return jax.lax.cond(
+        jnp.all(jnp.isfinite(sol)), lambda _: sol, repair, None)
 
 
 @functools.partial(jax.jit, static_argnames=("n",))

@@ -106,6 +106,20 @@ def ridge_cho_solve(AtA: jax.Array, Atb: jax.Array, lam: float) -> jax.Array:
     return _finite_or_eigh_solve(W, lambda: reg, Atb)
 
 
+def clamped_eigh(reg: jax.Array):
+    """Eigendecomposition of (batched) symmetric ``reg`` with
+    eigenvalues clamped to a floor scaled for f32 reconstruction
+    safety (8*d*eps of the largest magnitude, at least 1e-6 relative):
+    the ONE home of the breakdown-recovery clamp policy, shared by
+    every solver's fallback. Returns ``(V, wc)``."""
+    w, V = jnp.linalg.eigh(reg)
+    d = reg.shape[-1]
+    rel = max(1e-6, 8.0 * d * float(jnp.finfo(reg.dtype).eps))
+    floor = jnp.maximum(
+        jnp.max(jnp.abs(w), axis=-1, keepdims=True) * rel, 1e-30)
+    return V, jnp.maximum(w, floor)
+
+
 def _finite_or_eigh_solve(W, reg_fn, rhs, ok=None):
     """W when the solve succeeded, else the eigh-clamped solve of
     reg_fn() @ X = rhs. ``reg_fn`` is traced only inside the fallback
@@ -116,10 +130,7 @@ def _finite_or_eigh_solve(W, reg_fn, rhs, ok=None):
 
     def fallback(_):
         with solver_precision():
-            reg = reg_fn()
-            w, V = jnp.linalg.eigh(reg)
-            floor = jnp.maximum(jnp.max(jnp.abs(w)) * 1e-6, 1e-30)
-            wc = jnp.maximum(w, floor)
+            V, wc = clamped_eigh(reg_fn())
             return (V * (1.0 / wc)) @ (V.T @ rhs)
 
     if ok is None:

@@ -148,3 +148,26 @@ def test_woodbury_multi_block():
     np.testing.assert_allclose(
         np.asarray(m_chol.weights), np.asarray(m_wood.weights),
         rtol=5e-3, atol=5e-3)
+
+
+def test_weighted_solver_recovers_from_f32_breakdown(mesh8):
+    """Huge-scale rank-deficient features with a tiny regularizer NaN
+    the f32 Cholesky; both weighted-solver paths must recover finite,
+    better-than-chance models (the reference solved this regime in
+    f64)."""
+    rng = np.random.RandomState(0)
+    n, d, k = 96, 192, 6
+    y = rng.randint(0, k, n)
+    protos = rng.randn(k, d).astype(np.float32) * 400.0
+    X = (protos[y] + 40.0 * rng.randn(n, d)).astype(np.float32)
+    L = -np.ones((n, k), np.float32)
+    L[np.arange(n), y] = 1.0
+    for solver in ("cholesky", "woodbury"):
+        est = BlockWeightedLeastSquaresEstimator(
+            d, 1, 1e-4, 0.25, solver=solver)
+        model = est.fit_arrays(X, L)
+        W = np.asarray(model.weights)
+        assert np.all(np.isfinite(W)), solver
+        scores = X @ W + np.asarray(model.intercept)
+        acc = (scores.argmax(1) == y).mean()
+        assert acc > 0.5, (solver, acc)
