@@ -158,14 +158,27 @@ def _solve_all_classes(X, cls, mask, L, jfm, joint_label_mean, counts,
 @functools.partial(jax.jit, static_argnames=("bounds", "num_iter"))
 def _solve_single_class(X, b, y, mu, lam, bounds, num_iter):
     """BCD for one class (reference ReWeightedLeastSquares.scala:37-135)."""
+    from ...ops.linalg import _finite_or_eigh_solve
+
     by = b * y
     Ws = [jnp.zeros((hi - lo,), X.dtype) for lo, hi in bounds]
     factors = []
+    factor_ok = []
+    reg_fns = []  # rebuild A only inside a (rare) fallback branch
+
+    def _make_reg(lo, hi):
+        def reg():
+            Xzm = X[:, lo:hi] - mu[lo:hi]
+            return (Xzm.T @ (Xzm * b[:, None])
+                    + lam * jnp.eye(hi - lo, dtype=X.dtype))
+        return reg
+
     for lo, hi in bounds:
-        Xzm = X[:, lo:hi] - mu[lo:hi]
-        aTa = Xzm.T @ (Xzm * b[:, None])
-        A = aTa + lam * jnp.eye(hi - lo, dtype=X.dtype)
-        factors.append(jax.scipy.linalg.cho_factor(A, lower=True))
+        reg_fn = _make_reg(lo, hi)
+        L = jax.scipy.linalg.cho_factor(reg_fn(), lower=True)
+        factors.append(L)
+        factor_ok.append(jnp.all(jnp.isfinite(L[0])))
+        reg_fns.append(reg_fn)
     # residual r accumulates B .* (X_zm @ W)
     r = jnp.zeros_like(y)
     for _ in range(num_iter):
@@ -175,6 +188,9 @@ def _solve_single_class(X, b, y, mu, lam, bounds, num_iter):
             r_minus = r - b * xw_old
             aTb = Xzm.T @ (by - r_minus)
             W_new = jax.scipy.linalg.cho_solve(factors[i], aTb)
+            # f32 breakdown recovery (ops/linalg shared clamp policy)
+            W_new = _finite_or_eigh_solve(
+                W_new, reg_fns[i], aTb, ok=factor_ok[i])
             r = r + b * (Xzm @ (W_new - Ws[i]))
             Ws[i] = W_new
     return jnp.concatenate(Ws)

@@ -171,3 +171,11 @@ def test_weighted_solver_recovers_from_f32_breakdown(mesh8):
         scores = X @ W + np.asarray(model.intercept)
         acc = (scores.argmax(1) == y).mean()
         assert acc > 0.5, (solver, acc)
+
+    # the per-class reweighted solver shares the failure mode
+    pc = PerClassWeightedLeastSquaresEstimator(d, 1, 1e-4, 0.25)
+    model = pc.fit_arrays(X, L)
+    W = np.asarray(model.weights)
+    assert np.all(np.isfinite(W))
+    scores = X @ W + np.asarray(model.intercept)
+    assert (scores.argmax(1) == y).mean() > 0.5
