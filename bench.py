@@ -47,21 +47,76 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# Persistent XLA compilation cache (verified working through the axon
+# remote-compile tunnel): a prior bench run on this host leaves warm
+# executables on disk, so the driver's timed invocation spends its
+# budget measuring instead of compiling (round-2 failure mode: the
+# MNIST app burned 159.5 s of the budget on cold compiles).
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".xla_cache")
+try:
+    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+except Exception:
+    pass
+
 SMALL = os.environ.get("KEYSTONE_BENCH_SMALL") == "1"
 
+#: Wall-clock budget for the full run. The driver killed round 2's run
+#: (rc=124) somewhere past the ~10 minute mark; staying self-limited
+#: below that means the process always reaches its own exit path and
+#: the lowest-priority sections are the ones sacrificed, explicitly.
+BUDGET_S = float(os.environ.get("KEYSTONE_BENCH_BUDGET_S", "480"))
+_START = time.monotonic()
+
+FLAGSHIP = "cifar_randompatch_images_per_sec_per_chip"
 
 _emitted = 0
 _metrics: dict = {}  # metric name -> emitted line (for the summary line)
+_section_buffer = None  # list while a section runs under _run_section
 
 
 def _emit(metric, value, unit, vs_baseline, **extra):
-    global _emitted
     line = {"metric": metric, "value": value, "unit": unit,
             "vs_baseline": vs_baseline}
     line.update(extra)
+    if _section_buffer is not None:
+        # held until the section completes: a failed attempt's partial
+        # lines never reach stdout, so a retry cannot emit duplicate
+        # metric lines with stale values
+        _section_buffer.append(line)
+    else:
+        _flush_line(line)
+
+
+def _flush_line(line):
+    global _emitted
     print(json.dumps(line), flush=True)
-    _metrics[metric] = line
+    _metrics[line["metric"]] = line
     _emitted += 1
+
+
+def _emit_summary():
+    """Restate the flagship metric with every other section's value as
+    extra keys. Called after EVERY section: the driver parses the LAST
+    stdout JSON line as the headline, so whenever the run is cut short
+    the headline is still the flagship with all evidence so far."""
+    flag = _metrics.get(FLAGSHIP)
+    if flag is None or len(_metrics) < 2:
+        return
+    line = dict(flag)
+    line["summary"] = True
+    for name, other in _metrics.items():
+        if name == FLAGSHIP:
+            continue
+        line[name] = other["value"]
+        if name == "cifar_randompatch_test_error":
+            for key in ("dataset", "linear_pixels_test_error"):
+                if key in other:
+                    line["accuracy_" + key if key == "dataset" else key] = \
+                        other[key]
+    print(json.dumps(line), flush=True)
 
 
 def _fence(tree) -> None:
@@ -275,14 +330,16 @@ def solver_bench():
 
     from keystone_tpu.ops import linalg
 
-    rng = np.random.default_rng(0)
     n, d, k, bs = (5_000, 1024, 10, 512) if SMALL else (50_000, 8192, 10, 4096)
-    # generate per-block directly in f32: avoids a 3 GB f64 host
-    # intermediate and keeps only the block buffers on device
+    # generate ON DEVICE: a host-generated 800 MB block would spend
+    # minutes in the dev tunnel's single-digit-MB/s upload path and eat
+    # the driver's whole bench budget (content is irrelevant here)
+    keys = jax.random.split(jax.random.PRNGKey(0), d // bs + 1)
     blocks = tuple(
-        jnp.asarray(rng.standard_normal((n, bs), dtype=np.float32))
-        for _ in range(d // bs))
-    Y = jnp.asarray(rng.standard_normal((n, k), dtype=np.float32))
+        jax.random.normal(keys[i], (n, bs), jnp.float32)
+        for i in range(d // bs))
+    Y = jax.random.normal(keys[-1], (n, k), jnp.float32)
+    _fence((blocks, Y))  # staging fence, untimed
     run = jax.jit(functools.partial(linalg.bcd_core, num_passes=1))
     _fence(run(blocks, Y, jnp.float32(0.1)))
     iters = 2 if SMALL else 5
@@ -684,6 +741,107 @@ def imagenet_rehearsal_bench():
           solve_shape=[n_solve, d_solve, n_classes])
 
 
+# ----------------------------------------------- loader-in-the-loop bench
+
+
+def loader_bench():
+    """VERDICT r2 weak#5: time the tar -> threaded decode -> device ->
+    SIFT path END TO END on a generated JPEG tar, so the ImageNet-style
+    ingest is measured with the loader in the loop rather than
+    shapes-only. The pipeline is the production shape: tar streams
+    sequentially, PIL decode runs on the loader thread pool
+    (``iter_decoded_chunks``), each chunk is device_put as uint8 (4x
+    smaller than f32 on the wire) and featurized under one async
+    dispatch — JAX overlaps the next chunk's decode with the device
+    work. No published baseline; vs_baseline against a 100 images/sec
+    strawman (reference ImageNetLoader fed cluster executors from HDFS
+    tars, ``ImageLoaderUtils.scala:23-94``).
+
+    Note: on the axon bench chip the host->device link is a dev tunnel
+    at single-digit MB/s, so the uint8 upload — not decode or SIFT — can
+    dominate; the breakdown keys make that attribution visible.
+    """
+    import tarfile as tarmod
+    import tempfile
+
+    from keystone_tpu.loaders.image_loader_utils import iter_decoded_chunks
+    from keystone_tpu.nodes.images.extractors import SIFTExtractor
+
+    n_imgs = 64 if SMALL else 512
+    side = 128
+    chunk = 16 if SMALL else 64
+    tar_path = os.path.join(
+        tempfile.gettempdir(),
+        f"keystone_bench_{os.getuid()}_{n_imgs}_{side}.tar")
+
+    def _tar_valid(path):
+        try:
+            with tarmod.open(path, "r") as tf:
+                return sum(1 for e in tf if e.isfile()) == n_imgs
+        except Exception:
+            return False
+
+    if not (os.path.exists(tar_path) and _tar_valid(tar_path)):
+        from PIL import Image as PILImage
+        import io
+
+        rng = np.random.RandomState(0)
+        base = (rng.rand(side, side, 3) * 255).astype(np.uint8)
+        # atomic publish: a run killed mid-write must not leave a
+        # truncated tar that poisons every later run on this host
+        tmp_path = tar_path + f".tmp{os.getpid()}"
+        with tarmod.open(tmp_path, "w") as tf:
+            for i in range(n_imgs):
+                arr = np.roll(base, 3 * i, axis=0)  # distinct per entry
+                buf = io.BytesIO()
+                PILImage.fromarray(arr).save(buf, format="JPEG", quality=90)
+                data = buf.getvalue()
+                info = tarmod.TarInfo(f"class{i % 10}/img{i:05d}.jpg")
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+        os.replace(tmp_path, tar_path)
+
+    sift = SIFTExtractor(step=8, bin_size=4, num_scales=2, scale_step=1)
+
+    @jax.jit
+    def featurize_chunk(imgs_u8):
+        # NTSC grayscale on device (u8 wire format, f32 compute)
+        f = imgs_u8.astype(jnp.float32) / 255.0
+        gray = (0.299 * f[..., 0] + 0.587 * f[..., 1] + 0.114 * f[..., 2])
+        descs = jax.vmap(sift.apply)(gray)
+        return jnp.sum(descs, axis=(1, 2))  # keep the d2h pull tiny
+
+    def run_pipeline():
+        outs = []
+        for batch in iter_decoded_chunks([tar_path], chunk):
+            arr = np.stack([img for _, img in batch]).astype(np.uint8)
+            if arr.shape[0] != chunk:  # static jit shape: pad the tail
+                pad = np.zeros((chunk - arr.shape[0],) + arr.shape[1:],
+                               np.uint8)
+                arr = np.concatenate([arr, pad])
+            outs.append(featurize_chunk(jax.device_put(arr)))
+        _fence(outs)
+        return len(outs)
+
+    run_pipeline()  # warm: XLA compile + page cache
+
+    # decode-only pass: attribution for the breakdown keys
+    t0 = time.perf_counter()
+    n_decoded = sum(len(b) for b in iter_decoded_chunks([tar_path], chunk))
+    decode_dt = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    run_pipeline()
+    e2e_dt = time.perf_counter() - t0
+
+    per_sec = n_imgs / e2e_dt
+    _emit("tar_loader_sift_images_per_sec", round(per_sec, 1), "images/sec",
+          round(per_sec / 100.0, 4),
+          decode_only_images_per_sec=round(n_decoded / decode_dt, 1),
+          image_side=side, n_images=n_imgs,
+          overlap_efficiency=round(decode_dt / e2e_dt, 3))
+
+
 def _section_cleanup():
     """Drop cross-section state so one section's HBM residue (datasets,
     prefix-cached fitted results) can't starve the next."""
@@ -696,53 +854,83 @@ def _section_cleanup():
     gc.collect()
 
 
-def main():
-    """Emit every BASELINE metric, one JSON line each. The LAST line —
-    what the driver parses as the headline — restates the flagship
-    RandomPatchCifar featurization metric (same name as round 1) with
-    every other section's value attached as extra keys, so a single
-    line carries the whole picture. Sections are isolated: a failure in
-    one prints its traceback to stdout and the others still emit."""
+def _run_section(section):
+    """Run one section with buffered emission and one retry (the dev
+    tunnel's compile service throws transient errors — "response body
+    closed before all bytes were read" — that succeed on a second
+    attempt). Lines reach stdout only when the section completes, so a
+    failed attempt can never leave stale duplicate metric lines."""
+    global _section_buffer
     import sys
     import traceback
 
-    for section in (featurize_bench, solver_bench, imagenet_rehearsal_bench,
-                    e2e_bench, mnist_bench, timit_bench, newsgroups_bench,
-                    accuracy_bench):
-        # one retry: the dev tunnel's compile service throws transient
-        # errors ("response body closed before all bytes were read")
-        # that succeed on a second attempt
-        for attempt in (0, 1):
-            try:
-                section()
-                break
-            except Exception:
-                # stdout, not stderr: the driver captures stdout, so the
-                # evidence of a failed section survives in BENCH_r*.json
-                traceback.print_exc(file=sys.stdout)
-                if attempt == 0:
-                    print(f"retrying section {section.__name__} after "
-                          "failure", flush=True)
-                    _section_cleanup()
-                    time.sleep(5)
+    for attempt in (0, 1):
+        _section_buffer = []
+        try:
+            section()
+            for line in _section_buffer:
+                _flush_line(line)
+            return True
+        except Exception:
+            # stdout, not stderr: the driver captures stdout, so the
+            # evidence of a failed section survives in BENCH_r*.json
+            traceback.print_exc(file=sys.stdout)
+            if attempt == 0:
+                print(f"retrying section {section.__name__} after "
+                      "failure", flush=True)
+                _section_cleanup()
+                time.sleep(5)
+        finally:
+            _section_buffer = None
+    return False
+
+
+def main():
+    """Emit every BASELINE metric, one JSON line each, highest-priority
+    sections first (flagship throughput, solver TFLOPS, accuracy — the
+    round-2 timeout lost everything ordered after the apps). After every
+    section the flagship summary line is re-emitted, so the LAST stdout
+    line — what the driver parses as the headline — is always
+    ``cifar_randompatch_images_per_sec_per_chip`` carrying every value
+    measured so far, no matter where the run is cut off. Sections whose
+    conservative cost estimate exceeds the remaining self-imposed budget
+    are skipped explicitly (lowest priority last => sacrificed first)."""
+    # (section, conservative cost estimate in seconds with a warm
+    # compilation cache; cold compiles can exceed these — the deadline
+    # check before each section is what keeps the total bounded)
+    sections = (
+        (featurize_bench, 40),
+        (solver_bench, 40),
+        (accuracy_bench, 120),
+        (timit_bench, 60),
+        (newsgroups_bench, 40),
+        (loader_bench, 40),
+        (e2e_bench, 60),
+        (imagenet_rehearsal_bench, 60),
+        (mnist_bench, 60),
+    )
+    deadline = _START + BUDGET_S
+    for section, est in sections:
+        remaining = deadline - time.monotonic()
+        if remaining < est:
+            print(json.dumps({
+                "note": f"skipped {section.__name__}: {remaining:.0f}s "
+                        f"of budget left < {est}s estimate"}), flush=True)
+            continue
+        _run_section(section)
         _section_cleanup()
+        _emit_summary()
     if _emitted == 0:
         # every section failed: fail loudly instead of exiting 0 with an
         # empty metrics stream
         raise SystemExit(1)
-
-    flagship = "cifar_randompatch_images_per_sec_per_chip"
-    flag = _metrics.get(flagship)
-    if flag is not None and len(_metrics) > 1:
-        extra = {"summary": True}
-        for name, line in _metrics.items():
-            if name == flagship:
-                continue
-            extra[name] = line["value"]
-            if name == "cifar_randompatch_test_error" and "dataset" in line:
-                extra["accuracy_dataset"] = line["dataset"]
-        _emit(flagship, flag["value"], flag["unit"], flag["vs_baseline"],
-              **extra)
+    # The LAST stdout JSON line must be the flagship (skip notes above
+    # may have printed after the last per-section summary).
+    flag = _metrics.get(FLAGSHIP)
+    if flag is not None and len(_metrics) < 2:
+        print(json.dumps(flag), flush=True)
+    else:
+        _emit_summary()
 
 
 if __name__ == "__main__":
@@ -757,6 +945,7 @@ if __name__ == "__main__":
         "--mnist": mnist_bench,
         "--timit": timit_bench,
         "--newsgroups": newsgroups_bench,
+        "--loader": loader_bench,
     }
     picked = [f for f in sys.argv[1:] if f in sections]
     unknown = [f for f in sys.argv[1:] if f.startswith("--")

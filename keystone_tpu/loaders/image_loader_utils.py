@@ -128,6 +128,64 @@ def iter_tar_images(
             yield name, img
 
 
+def iter_decoded_chunks(
+    archive_paths: Sequence[str],
+    chunk_size: int,
+    name_prefix: Optional[str] = None,
+) -> Iterator[List[tuple]]:
+    """Stream archives as chunks of ``chunk_size`` decoded images, with
+    decode on a thread pool behind a bounded in-flight window.
+
+    This is the loader half of the loader/device pipeline: a consumer
+    that ``device_put``s + dispatches accelerator work per chunk gets
+    decode-compute overlap for free, because JAX dispatch is async and
+    the pool keeps decoding the next window while the device runs the
+    current chunk (the reference got the same overlap from Spark
+    executor threads feeding JNI featurizers,
+    ``ImageLoaderUtils.scala:23-94``). Order is deterministic: archive
+    order, then entry order. Undecodable entries are dropped.
+    """
+    import collections
+    from concurrent.futures import ThreadPoolExecutor
+
+    log = logging.getLogger(__name__)
+    workers = _loader_threads()
+    window = 4 * workers
+    with ThreadPoolExecutor(workers) as pool:
+        pending: collections.deque = collections.deque()
+        out: list = []
+
+        def drain(n):
+            while len(pending) > n:
+                name, fut = pending.popleft()
+                img = fut.result()
+                if img is not None:
+                    out.append((name, img))
+
+        for path in archive_paths:
+            # same per-archive recovery as load_tar_files: non-archives
+            # sitting next to the tars (labels.txt, READMEs — which
+            # list_archive_paths intentionally returns) are skipped, and
+            # a mid-stream truncation keeps what was read, loudly
+            try:
+                for name, raw in _iter_tar_entries(path, name_prefix):
+                    pending.append((name, pool.submit(decode_image, raw)))
+                    drain(window)
+                    while len(out) >= chunk_size:
+                        yield out[:chunk_size]
+                        del out[:chunk_size]
+            except (tarfile.ReadError, gzip.BadGzipFile, EOFError,
+                    zlib.error) as e:
+                drain(0)
+                log.warning(
+                    "Skipping unreadable/truncated archive %s (%s); "
+                    "kept entries read before the error", path, e)
+        drain(0)
+        while out:
+            yield out[:chunk_size]
+            del out[:chunk_size]
+
+
 def _loader_threads() -> int:
     """Decode worker count: the reference got multi-core decode for free
     from Spark executors; here a thread pool does it (PIL releases the
