@@ -128,13 +128,66 @@ def iter_tar_images(
             yield name, img
 
 
+def _pooled_decoded(
+    archive_paths: Sequence[str],
+    name_prefix: Optional[str] = None,
+    on_archive_end: Optional[Callable[[str, Optional[Exception], int], None]] = None,
+) -> Iterator[tuple]:
+    """Yield ``(entry_name, decoded_image)`` from every archive, decode
+    on a thread pool behind a bounded in-flight window — the ONE home of
+    the pool/window/per-archive-recovery machinery shared by
+    :func:`iter_decoded_chunks` and :func:`load_tar_files`.
+
+    Order is deterministic (archive order, then entry order);
+    undecodable entries are dropped. An archive that raises mid-stream
+    (non-archive file, truncation) stops there but keeps what was read.
+    ``on_archive_end(path, error_or_None, n_images_yielded)`` fires per
+    archive so callers implement their own skip/warn/raise policy.
+    """
+    import collections
+    from concurrent.futures import ThreadPoolExecutor
+
+    workers = _loader_threads()
+    window = 4 * workers
+    with ThreadPoolExecutor(workers) as pool:
+        pending: collections.deque = collections.deque()
+
+        def drain(n):
+            out = []
+            while len(pending) > n:
+                name, fut = pending.popleft()
+                img = fut.result()
+                if img is not None:
+                    out.append((name, img))
+            return out
+
+        for path in archive_paths:
+            n_from_archive = 0
+            err: Optional[Exception] = None
+            try:
+                for name, raw in _iter_tar_entries(path, name_prefix):
+                    pending.append((name, pool.submit(decode_image, raw)))
+                    for item in drain(window):
+                        n_from_archive += 1
+                        yield item
+            except (tarfile.ReadError, gzip.BadGzipFile, EOFError,
+                    zlib.error) as e:
+                err = e
+            # archive boundary: drain fully so the per-archive count is
+            # exact (a negligible pipeline bubble once per archive)
+            for item in drain(0):
+                n_from_archive += 1
+                yield item
+            if on_archive_end is not None:
+                on_archive_end(path, err, n_from_archive)
+
+
 def iter_decoded_chunks(
     archive_paths: Sequence[str],
     chunk_size: int,
     name_prefix: Optional[str] = None,
 ) -> Iterator[List[tuple]]:
-    """Stream archives as chunks of ``chunk_size`` decoded images, with
-    decode on a thread pool behind a bounded in-flight window.
+    """Stream archives as chunks of ``chunk_size`` decoded images.
 
     This is the loader half of the loader/device pipeline: a consumer
     that ``device_put``s + dispatches accelerator work per chunk gets
@@ -142,48 +195,26 @@ def iter_decoded_chunks(
     the pool keeps decoding the next window while the device runs the
     current chunk (the reference got the same overlap from Spark
     executor threads feeding JNI featurizers,
-    ``ImageLoaderUtils.scala:23-94``). Order is deterministic: archive
-    order, then entry order. Undecodable entries are dropped.
+    ``ImageLoaderUtils.scala:23-94``). Unreadable/truncated archives are
+    skipped with a warning, keeping entries read before the error.
     """
-    import collections
-    from concurrent.futures import ThreadPoolExecutor
-
     log = logging.getLogger(__name__)
-    workers = _loader_threads()
-    window = 4 * workers
-    with ThreadPoolExecutor(workers) as pool:
-        pending: collections.deque = collections.deque()
-        out: list = []
 
-        def drain(n):
-            while len(pending) > n:
-                name, fut = pending.popleft()
-                img = fut.result()
-                if img is not None:
-                    out.append((name, img))
+    def on_end(path, err, n):
+        if err is not None:
+            log.warning(
+                "Skipping unreadable/truncated archive %s (%s); kept "
+                "%d entries read before the error", path, err, n)
 
-        for path in archive_paths:
-            # same per-archive recovery as load_tar_files: non-archives
-            # sitting next to the tars (labels.txt, READMEs — which
-            # list_archive_paths intentionally returns) are skipped, and
-            # a mid-stream truncation keeps what was read, loudly
-            try:
-                for name, raw in _iter_tar_entries(path, name_prefix):
-                    pending.append((name, pool.submit(decode_image, raw)))
-                    drain(window)
-                    while len(out) >= chunk_size:
-                        yield out[:chunk_size]
-                        del out[:chunk_size]
-            except (tarfile.ReadError, gzip.BadGzipFile, EOFError,
-                    zlib.error) as e:
-                drain(0)
-                log.warning(
-                    "Skipping unreadable/truncated archive %s (%s); "
-                    "kept entries read before the error", path, e)
-        drain(0)
-        while out:
+    out: list = []
+    for item in _pooled_decoded(archive_paths, name_prefix, on_end):
+        out.append(item)
+        while len(out) >= chunk_size:
             yield out[:chunk_size]
             del out[:chunk_size]
+    while out:
+        yield out[:chunk_size]
+        del out[:chunk_size]
 
 
 def _loader_threads() -> int:
@@ -205,58 +236,36 @@ def load_tar_files(
     """Load every image from every archive, applying the label mapping
     (reference ``ImageLoaderUtils.loadFiles``).
 
-    Tar IO streams sequentially (that is how tars read); image DECODE
-    runs on a thread pool with a bounded window of in-flight entries, so
-    raw bytes never pile up and item order stays deterministic
-    (archive order, then entry order)."""
-    import collections
-    from concurrent.futures import ThreadPoolExecutor
-
+    Decode machinery (thread pool, bounded window, deterministic order,
+    per-archive recovery) is shared with :func:`iter_decoded_chunks` via
+    :func:`_pooled_decoded`; this wrapper adds the label mapping plus
+    the skip-vs-truncated warning policy and the nothing-opened error."""
     log = logging.getLogger(__name__)
     items: list = []
     opened_any = False
 
-    def drain(pending, n):
+    def on_end(path, err, n):
         nonlocal opened_any
-        while pending and (len(pending) > n):
-            name, fut = pending.popleft()
-            img = fut.result()
-            if img is not None:
-                # only a decoded image proves the path held real data;
-                # None-decodes must not suppress the final ReadError
-                opened_any = True
-                items.append(image_builder(img, labels_map(name), name))
+        if err is None:
+            opened_any = True  # readable archive, possibly zero images
+        elif n == 0:
+            # Failed before yielding anything: not a tar (labels.txt,
+            # README, checksums) — skip, matching the reference where
+            # non-archives simply yield no image records.
+            log.warning("Skipping non-archive file %s", path)
+        else:
+            # Truncated/corrupt mid-stream: keep what was read, but say
+            # so — silent partial data is worse than a warning.
+            log.warning(
+                "Archive %s truncated/corrupt (%s); kept %d items "
+                "from it", path, err, n)
+            opened_any = True
 
-    workers = _loader_threads()
-    window = 4 * workers
-    with ThreadPoolExecutor(workers) as pool:
-        for path in archive_paths:
-            before = len(items)
-            pending: collections.deque = collections.deque()
-            try:
-                for name, raw in _iter_tar_entries(path, name_prefix):
-                    pending.append((name, pool.submit(decode_image, raw)))
-                    drain(pending, window)
-                drain(pending, 0)
-                opened_any = True  # readable archive, possibly zero images
-            except (tarfile.ReadError, gzip.BadGzipFile, EOFError,
-                    zlib.error) as e:
-                drain(pending, 0)  # keep entries read before the error
-                if len(items) == before:
-                    # Failed before yielding anything: not a tar
-                    # (labels.txt, README, checksums) — skip, matching
-                    # the reference where non-archives simply yield no
-                    # image records.
-                    log.warning("Skipping non-archive file %s", path)
-                else:
-                    # Truncated/corrupt mid-stream: keep what was read,
-                    # but say so — silent partial data is worse than a
-                    # warning.
-                    log.warning(
-                        "Archive %s truncated/corrupt (%s); kept %d "
-                        "items from it", path, e, len(items) - before,
-                    )
-                    opened_any = True
+    for name, img in _pooled_decoded(archive_paths, name_prefix, on_end):
+        # only a decoded image proves the path held real data;
+        # None-decodes must not suppress the final ReadError
+        opened_any = True
+        items.append(image_builder(img, labels_map(name), name))
     if archive_paths and not opened_any:
         raise tarfile.ReadError(
             f"None of {len(archive_paths)} file(s) under the data path could be "

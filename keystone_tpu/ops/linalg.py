@@ -85,6 +85,19 @@ GRAM_SYM_TILE = 512
 #: Only tile when the savings beat the extra HBM reads of A's column
 #: tiles: below ~2k columns the single fused einsum wins.
 _GRAM_SYM_MIN_D = 2048
+#: Cap on the tile grid (T*(T+1)/2 unrolled einsums + ~1.5x the fused
+#: path's peak HBM): beyond 16 tiles the tile width doubles instead,
+#: keeping trace size and memory bounded for very wide A.
+_GRAM_SYM_MAX_TILES = 16
+
+
+def _gram_sym_tile(d: int):
+    """Widest-savings tile for d, honoring the unroll cap; None when no
+    admissible tile divides d (callers fall back to the fused einsum)."""
+    t = GRAM_SYM_TILE
+    while d // t > _GRAM_SYM_MAX_TILES:
+        t *= 2
+    return t if d % t == 0 else None
 
 
 @functools.partial(jax.jit, static_argnames=("preferred",))
@@ -100,8 +113,8 @@ def gram(A: jax.Array, preferred: Optional[jnp.dtype] = None) -> jax.Array:
     einsum, so mirrored entries are exactly the transposed values.
     """
     d = A.shape[1]
-    t = GRAM_SYM_TILE
-    if d < _GRAM_SYM_MIN_D or d % t != 0:
+    t = _gram_sym_tile(d)
+    if d < _GRAM_SYM_MIN_D or t is None:
         return jnp.einsum("nd,ne->de", A, A, preferred_element_type=preferred,
                           precision=SOLVER_PRECISION)
     T = d // t

@@ -171,3 +171,28 @@ def test_bcd_class_columns_shard_over_model_axis():
     ref = ridge_numpy(A, Y, lam)
     for W in (W1, W2):
         assert np.linalg.norm(W - ref) / np.linalg.norm(ref) < 0.05
+
+
+def test_gram_symmetric_tiled_path_matches_full():
+    # d >= _GRAM_SYM_MIN_D with an admissible tile takes the
+    # upper-triangle syrk assembly; must equal the fused einsum exactly
+    # in structure and to f32 tolerance in value, and be symmetric
+    rng = np.random.RandomState(7)
+    A = rng.randn(96, 2048).astype(np.float32)
+    import jax.numpy as jnp
+    G = np.asarray(linalg.gram(jnp.asarray(A)))
+    ref = A.T @ A
+    assert G.shape == (2048, 2048)
+    assert np.array_equal(G, G.T)
+    assert np.allclose(G, ref, rtol=2e-5, atol=2e-4)
+
+
+def test_gram_sym_tile_selection():
+    # cap on the unrolled tile grid: tile widens for very wide A, and
+    # non-divisible widths fall back (None) to the fused einsum
+    from keystone_tpu.ops.linalg import _gram_sym_tile
+
+    assert _gram_sym_tile(4096) == 512       # 8 tiles
+    assert _gram_sym_tile(8192) == 512       # 16 tiles (at the cap)
+    assert _gram_sym_tile(16384) == 1024     # cap doubles the tile
+    assert _gram_sym_tile(2304) is None      # 512 does not divide
