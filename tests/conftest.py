@@ -22,6 +22,11 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running end-to-end test")
+
+
 @pytest.fixture(autouse=True)
 def fresh_env():
     """Reset global pipeline state between tests (the reference stops and

@@ -275,3 +275,53 @@ def test_lda_iris_matches_published_eigenvectors():
             np.allclose(col, want, atol=1e-4)
             or np.allclose(-col, want, atol=1e-4)
         ), f"got {col}, want ±{want}"
+
+
+def test_dense_sift_descriptor_golden_gantrycrane():
+    """Descriptor-level SIFT parity on the real gantrycrane.png fixture
+    (VERDICT r2 next#4; reference anchor: VLFeatSuite golden tests).
+
+    The golden (tests/resources/sift_golden_gantrycrane.npz, generated
+    by tools/make_sift_golden.py — checked in for reproducibility) is an
+    independent NumPy/SciPy implementation of the same vl_phow recipe:
+    scipy convolve1d smoothing and generic bilinear map_coordinates
+    sampling at every bin center, vs the production kernel's XLA convs
+    and shared-fractional-offset strided-slice sampling. Asserts
+    agreement in quantized units across all three scales, including the
+    contrast-threshold zeroing and the min(512 v, 255) quantization."""
+    from PIL import Image
+
+    from keystone_tpu.ops.sift import CONTRAST_THRESHOLD, dense_sift
+
+    g = np.load(os.path.join(RES, "sift_golden_gantrycrane.npz"))
+    want = g["descriptors"].astype(np.float32)  # (128, N) quantized
+    prenorm = g["prenorm"]
+    step, bin_size, num_scales, scale_step = (int(v) for v in g["config"])
+
+    rgb = np.asarray(
+        Image.open(os.path.join(RES, "images/gantrycrane.png"))
+        .convert("RGB"), np.float32) / 255.0
+    gray = 0.299 * rgb[..., 0] + 0.587 * rgb[..., 1] + 0.114 * rgb[..., 2]
+
+    got = np.asarray(dense_sift(
+        gray, step=step, bin_size=bin_size,
+        num_scales=num_scales, scale_step=scale_step))
+    assert got.shape == want.shape, (got.shape, want.shape)
+
+    # descriptors sitting within f32 noise of the contrast threshold can
+    # legitimately flip between zeroed and kept; exclude the borderline
+    solid = np.abs(prenorm - CONTRAST_THRESHOLD) > 1e-4
+    assert solid.sum() > 3000  # the exclusion must stay a sliver
+    diff = np.abs(got[:, solid] - want[:, solid])
+    # f64 golden vs f32 production plus f16 golden storage puts values
+    # within ~1 quantized unit; a real algorithm regression (grid shift,
+    # window change, norm bug) moves many entries by tens of units
+    assert diff.max() <= 2.0, diff.max()
+    assert diff.mean() <= 0.15, diff.mean()
+
+    # the contrast path is genuinely exercised: golden zeroes a visible
+    # fraction, and the kernel zeroes exactly the same solid columns
+    zero_want = (want[:, solid].sum(0) == 0)
+    zero_got = (got[:, solid].sum(0) == 0)
+    assert zero_want.sum() > 100
+    assert np.array_equal(zero_want, zero_got)

@@ -1,0 +1,147 @@
+"""Generate the descriptor-level SIFT golden (VERDICT r2 next#4).
+
+An INDEPENDENT NumPy/SciPy dense-SIFT reference — same algorithm as
+``keystone_tpu/ops/sift.py`` (the vl_phow recipe of the reference's
+``cpp/VLFeat.cxx``: per-scale Gaussian smooth at sigma=bin/6, gradient
+orientation soft-assignment to 8 bins, 4x4 spatial bins with bilinear
+triangle weighting, L2->clamp 0.2->renorm, contrast threshold 0.005,
+quantize min(512 v, 255)) — but computed through a DIFFERENT code path:
+
+* scipy.ndimage.convolve1d for the Gaussian/triangle smoothing (vs XLA
+  ``conv_general_dilated``),
+* generic bilinear ``scipy.ndimage.map_coordinates`` sampling at every
+  bin center (vs the production kernel's shared-fractional-offset
+  pre-interpolation + integer strided slices).
+
+Agreement therefore cross-checks the production kernel's TPU-oriented
+restructurings against a direct implementation of the same math, at
+descriptor level on the real ``gantrycrane.png`` fixture — the closest
+available analogue of the reference's VLFeatSuite golden (the actual
+VLFeat binary is unbuildable in this zero-egress image; this generator
+is checked in so the artifact is reproducible).
+
+Writes tests/resources/sift_golden_gantrycrane.npz.
+"""
+import os
+
+import numpy as np
+from PIL import Image
+from scipy.ndimage import convolve1d, map_coordinates
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NBP, NBO = 4, 8
+MAGNIF = 6.0
+CONTRAST = 0.005
+
+# modest config keeps the artifact small while covering the multi-scale,
+# contrast-threshold and quantization paths
+STEP, BIN, NUM_SCALES, SCALE_STEP = 8, 6, 3, 1
+
+
+def gaussian_taps(sigma):
+    if sigma < 1e-8:
+        return np.ones(1)
+    radius = int(np.ceil(4.0 * sigma))
+    x = np.arange(-radius, radius + 1, dtype=np.float64)
+    k = np.exp(-0.5 * (x / sigma) ** 2)
+    return k / k.sum()
+
+
+def triangle_taps(bin_size):
+    t = np.arange(-(bin_size - 1), bin_size, dtype=np.float64)
+    return np.maximum(0.0, 1.0 - np.abs(t) / bin_size)
+
+
+def keypoint_centers(dim, lo, hi, step, extent):
+    half = extent / 2.0
+    first, last = lo + half, hi - half
+    if last < first:
+        return np.zeros(0)
+    count = int((last - first) // step) + 1
+    return first + step * np.arange(count)
+
+
+def dsift_one_scale(img, step, bin_size, lo):
+    h, w = img.shape
+    taps = gaussian_taps(bin_size / MAGNIF)
+    smoothed = convolve1d(img, taps, axis=0, mode="nearest")
+    smoothed = convolve1d(smoothed, taps, axis=1, mode="nearest")
+
+    gy, gx = np.gradient(smoothed)
+    mag = np.sqrt(gx * gx + gy * gy)
+    ang = np.arctan2(gy, gx) % (2 * np.pi)
+    a = ang * (NBO / (2 * np.pi))
+    lo_bin = np.floor(a).astype(int) % NBO
+    frac = a - np.floor(a)
+    omaps = np.zeros((NBO,) + img.shape)
+    for o in range(NBO):
+        omaps[o] = mag * (np.where(lo_bin == o, 1 - frac, 0)
+                          + np.where((lo_bin + 1) % NBO == o, frac, 0))
+
+    tri = triangle_taps(bin_size)
+    sm = np.stack([
+        convolve1d(convolve1d(m, tri, axis=0, mode="nearest"),
+                   tri, axis=1, mode="nearest")
+        for m in omaps
+    ])
+
+    extent = bin_size * NBP
+    ys = keypoint_centers(h, lo, h - 1, step, extent)
+    xs = keypoint_centers(w, lo, w - 1, step, extent)
+    offs = (np.arange(NBP) - (NBP - 1) / 2.0) * bin_size
+    if len(ys) == 0 or len(xs) == 0:
+        return np.zeros((0, NBP * NBP * NBO), np.float32)
+
+    yy, xx = np.meshgrid(ys, xs, indexing="ij")
+    descs = []
+    for by in offs:
+        for bx in offs:
+            coords = np.stack([(yy + by).ravel(), (xx + bx).ravel()])
+            vals = np.stack([
+                map_coordinates(sm[o], coords, order=1, mode="nearest")
+                for o in range(NBO)
+            ])  # (8, N) — generic bilinear sampling at bin centers
+            descs.append(vals.T)
+    return np.concatenate(descs, axis=1)  # (N, 128)
+
+
+def normalize_quantize(desc):
+    norm = np.linalg.norm(desc, axis=1, keepdims=True)
+    d = np.minimum(desc / np.maximum(norm, 1e-12), 0.2)
+    d = d / np.maximum(np.linalg.norm(d, axis=1, keepdims=True), 1e-12)
+    d = np.where(norm / (NBP * NBP) < CONTRAST, 0.0, d)
+    return np.minimum(512.0 * d, 255.0)
+
+
+def main():
+    img_path = os.path.join(ROOT, "tests/resources/images/gantrycrane.png")
+    rgb = np.asarray(Image.open(img_path).convert("RGB"), np.float64) / 255.0
+    gray = 0.299 * rgb[..., 0] + 0.587 * rgb[..., 1] + 0.114 * rgb[..., 2]
+
+    outs, prenorms = [], []
+    for scale in range(NUM_SCALES):
+        s = STEP + scale * SCALE_STEP
+        bs = BIN + 2 * scale
+        lo = max((1 + NUM_SCALES * 2) - scale * 3, 0)
+        raw = dsift_one_scale(gray, s, bs, lo)
+        prenorms.append(np.linalg.norm(raw, axis=1) / (NBP * NBP))
+        outs.append(normalize_quantize(raw))
+    desc = np.concatenate(outs, axis=0).T.astype(np.float32)  # (128, N)
+    prenorm = np.concatenate(prenorms)
+
+    out_path = os.path.join(
+        ROOT, "tests/resources/sift_golden_gantrycrane.npz")
+    np.savez_compressed(
+        out_path,
+        descriptors=desc.astype(np.float16),  # <=0.125 quantized-unit storage error
+        prenorm=prenorm.astype(np.float32),
+        config=np.asarray([STEP, BIN, NUM_SCALES, SCALE_STEP]),
+    )
+    n_zeroed = int((prenorm < CONTRAST).sum())
+    print(f"golden: {desc.shape} descriptors, {n_zeroed} low-contrast, "
+          f"{os.path.getsize(out_path) / 1024:.0f} KiB -> {out_path}")
+
+
+if __name__ == "__main__":
+    main()
