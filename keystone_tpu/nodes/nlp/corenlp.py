@@ -96,9 +96,13 @@ def english_lemmatize(word: str, pos: Optional[str] = None) -> str:
         return w[:-2]
     if n > 3 and w.endswith("s") and not w.endswith(("ss", "us", "is")):
         return w[:-1]
-    if n > 5 and w.endswith("ying"):
+    # -ing stripping is gerund-only: a POS tag gates it exactly; absent
+    # a tag, the -ing-noun exception list (morning, thing, ...) stands in
+    ing_ok = (pos == "VBG") if pos is not None else (
+        w not in _ING_EXCEPTIONS)  # defined with the tagger below
+    if n > 5 and w.endswith("ying") and ing_ok:
         return w[:-4] + "y"
-    if n > 4 and w.endswith("ing"):
+    if n > 4 and w.endswith("ing") and ing_ok:
         stem = _undouble(w[:-3])
         # a doubled consonant implies the base had no final e (run+ning)
         return stem + "e" if stem == w[:-3] and _needs_e(stem) else stem
@@ -156,6 +160,21 @@ _CLOSED_CLASS = {
     "who": "WP", "what": "WP", "which": "WDT", "where": "WRB",
     "when": "WRB", "why": "WRB", "how": "WRB",
     "there": "EX", "if": "IN", "because": "IN", "while": "IN",
+    "than": "IN", "without": "IN", "outside": "IN", "inside": "IN",
+    "near": "IN", "across": "IN",
+    "then": "RB", "now": "RB", "here": "RB", "just": "RB", "only": "RB",
+    "never": "RB", "always": "RB", "often": "RB", "still": "RB",
+    "already": "RB", "again": "RB", "soon": "RB",
+    "all": "DT", "both": "DT",
+    "many": "JJ", "few": "JJ", "several": "JJ", "such": "JJ",
+    "other": "JJ", "same": "JJ", "own": "JJ",
+}
+
+#: Penn punctuation tags; anything non-alphanumeric not listed is SYM.
+_PUNCT_TAGS = {
+    ".": ".", "!": ".", "?": ".", ",": ",", ";": ":", ":": ":",
+    "--": ":", "-": ":", "(": "(", ")": ")", "``": "``", "''": "''",
+    '"': "''", "'": "''", "$": "$", "&": "CC",
 }
 
 _NUMBER_RE = re.compile(r"^[+-]?(\d+([.,]\d+)*|\d+(st|nd|rd|th))$")
@@ -163,6 +182,19 @@ _NUMBER_RE = re.compile(r"^[+-]?(\d+([.,]\d+)*|\d+(st|nd|rd|th))$")
 _ADJ_SUFFIXES = ("ous", "ful", "ive", "able", "ible", "al", "ic", "less")
 _NOUN_SUFFIXES = ("tion", "sion", "ment", "ness", "ity", "ship", "hood",
                   "ism", "ist", "ance", "ence", "ure", "age")
+#: -ing nouns / non-gerunds (so VBG never fires on them).
+_ING_EXCEPTIONS = {
+    "morning", "evening", "nothing", "something", "anything",
+    "everything", "thing", "king", "ring", "spring", "string", "wing",
+    "sing", "bring",
+}
+#: -en words that are NOT past participles (so VBN never fires).
+_EN_EXCEPTIONS = {
+    "garden", "kitchen", "chicken", "golden", "wooden", "open", "even",
+    "seven", "eleven", "heaven", "oven", "often", "queen", "green",
+    "screen", "between", "men", "women", "children", "citizen", "dozen",
+    "pen", "ten", "then", "when",
+}
 #: -er words that are NOT comparatives (so JJR never fires on them).
 _ER_EXCEPTIONS = {
     "other", "another", "over", "under", "after", "never", "ever",
@@ -188,6 +220,8 @@ class RuleBasedPosModel:
 
     def _tag(self, word: str, sentence_initial: bool) -> str:
         w = word.lower()
+        if not any(c.isalnum() for c in word):
+            return _PUNCT_TAGS.get(word, "SYM")
         if _NUMBER_RE.match(word):
             return "CD"
         if w in _CLOSED_CLASS:
@@ -201,10 +235,12 @@ class RuleBasedPosModel:
             return "NNPS" if plural else "NNP"
         if w.endswith("ly"):
             return "RB"
-        if w.endswith("ing") and len(w) > 4:
+        if w.endswith("ing") and len(w) > 4 and w not in _ING_EXCEPTIONS:
             return "VBG"
-        if (w.endswith("ed") or w.endswith("en")) and len(w) > 3:
-            return "VBD" if w.endswith("ed") else "VBN"
+        if w.endswith("ed") and len(w) > 3:
+            return "VBD"
+        if w.endswith("en") and len(w) > 3 and w not in _EN_EXCEPTIONS:
+            return "VBN"
         if w.endswith(_ADJ_SUFFIXES):
             return "JJ"
         if w.endswith("est") and len(w) > 4:
