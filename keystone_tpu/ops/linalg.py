@@ -141,19 +141,57 @@ def cross(A: jax.Array, B: jax.Array, preferred: Optional[jnp.dtype] = None) -> 
                       precision=SOLVER_PRECISION)
 
 
+#: Collapsed-pivot threshold for `_chol_healthy`, on the SCALE-FREE
+#: ratio L_ii / sqrt(G_ii) (each pivot against its own column mass, so
+#: badly-SCALED but well-conditioned Grams — feature scales spanning
+#: 1e4+ without a StandardScaler — never misfire; a raw min/max pivot
+#: ratio conflates scaling with conditioning). Measured boundaries
+#: (tests/test_linalg.py): exact/near-duplicate columns land at
+#: 2.5e-4..6.7e-4, smooth kappa=3e7 spectra at 2.4e-3, kappa=1e6
+#: (reference conditioning) at 1.1e-2.
+_PIVOT_TAU = 1e-3
+
+
+def _chol_healthy(L: jax.Array, G: jax.Array) -> jax.Array:
+    """Factor-level success predicate for the breakdown fallback: the
+    factor is finite AND no pivot collapsed relative to its own column
+    scale (min_i L_ii / sqrt(G_ii) > _PIVOT_TAU). Near-exact rank
+    deficiency (e.g. duplicate feature columns with lam ~ 0) can hand
+    back a FINITE factor whose last pivot is pure rounding noise — the
+    raw solve then returns finite but wildly oversized weights that
+    bypass a pure isfinite gate (ADVICE r2), a regime the reference's
+    f64 solver handled accurately.
+
+    Scope note (measured): for smoothly ill-conditioned spectra the f32
+    pivots saturate near sqrt(eps) relative scale rather than
+    collapsing, and the solve residual stays ~1e-8 even at kappa ~
+    1e7.5 — Cholesky is backward stable, so the O(kappa * eps) FORWARD
+    error there is inherent to any f32 factorization (eigh included)
+    and is the documented f32-vs-f64 parity boundary (PARITY.md). This
+    gate only catches the collapsed-pivot band below ~1e-3."""
+    dL = jnp.abs(jnp.diagonal(L, axis1=-2, axis2=-1))
+    dG = jnp.sqrt(jnp.maximum(
+        jnp.abs(jnp.diagonal(G, axis1=-2, axis2=-1)), 1e-30))
+    cond_ok = jnp.min(dL / dG, axis=-1) > _PIVOT_TAU
+    return jnp.all(jnp.isfinite(L)) & jnp.all(cond_ok)
+
+
 def ridge_cho_solve(AtA: jax.Array, Atb: jax.Array, lam: float) -> jax.Array:
     """Solve (AtA + lam*I) W = Atb by Cholesky (replicated on all chips).
 
-    When f32 Cholesky breaks down (kappa beyond ~1/eps_f32: a negative
-    pivot NaNs the whole factor — the regime the reference's f64 solver
+    When f32 Cholesky breaks down or comes within a whisker of it
+    (kappa approaching 1/eps_f32: a NaN factor, or a finite factor with
+    a collapsed pivot — the regime the reference's f64 solver
     survived), an eigendecomposition with clamped eigenvalues recovers a
     finite, more-strongly-regularized solution instead of silently
-    returning NaN weights that predict a constant class."""
+    returning NaN/garbage weights that predict a constant class."""
     d = AtA.shape[0]
     reg = AtA + lam * jnp.eye(d, dtype=AtA.dtype)
     factor = jax.scipy.linalg.cho_factor(reg, lower=True)
     W = jax.scipy.linalg.cho_solve(factor, Atb)
-    return _finite_or_eigh_solve(W, lambda: reg, Atb)
+    return _finite_or_eigh_solve(
+        W, lambda: reg, Atb,
+        ok=_chol_healthy(factor[0], reg) & jnp.all(jnp.isfinite(W)))
 
 
 def clamped_eigh(reg: jax.Array):
@@ -246,8 +284,10 @@ def _dual_solve_jit(A, Y, lam):
         K = A @ A.T + lam * jnp.eye(n, dtype=A.dtype)
         factor = jax.scipy.linalg.cho_factor(K, lower=True)
         alpha = jax.scipy.linalg.cho_solve(factor, Y)
-        # same f32 breakdown recovery as ridge_cho_solve
-        alpha = _finite_or_eigh_solve(alpha, lambda: K, Y)
+        # same f32 breakdown/near-breakdown recovery as ridge_cho_solve
+        alpha = _finite_or_eigh_solve(
+            alpha, lambda: K, Y,
+            ok=_chol_healthy(factor[0], K) & jnp.all(jnp.isfinite(alpha)))
         return A.T @ alpha
 
 
@@ -326,7 +366,7 @@ def _bcd_core_body(blocks, Y, lam, *, num_passes: int):
         G = gram(A) + lam * jnp.eye(A.shape[1], dtype=dtype)
         L = jax.scipy.linalg.cho_factor(G, lower=True)
         factors.append(L)
-        factor_ok.append(jnp.all(jnp.isfinite(L[0])))
+        factor_ok.append(_chol_healthy(L[0], G))
     Ws = [jnp.zeros((A.shape[1], k), dtype) for A in blocks]
     pred = jnp.zeros_like(Y)
     for _ in range(num_passes):
@@ -399,6 +439,17 @@ def tsqr_r(A: jax.Array) -> jax.Array:
         # instead of degrading to a replicated QR). Shards shorter than
         # d are fine: their local R is (m, d) and the gathered stack
         # still has >= d rows because n >= d.
+        if jax.process_count() > 1:
+            # The eager concatenate below assumes a fully-addressable
+            # array; on a multi-host mesh it would fail or gather the
+            # global array through one host (ADVICE r2). Dataset-path
+            # inputs are pre-padded to a shard multiple, so only raw
+            # multi-host arrays can reach this branch.
+            raise NotImplementedError(
+                f"tsqr_r: row count {n} is not divisible by the "
+                f"{nshards}-way data axis on a multi-host mesh. Pad the "
+                "input to a shard multiple before calling (ArrayDataset "
+                "ingestion does this automatically).")
         pad = -(-n // nshards) * nshards - n
         A = jnp.concatenate([A, jnp.zeros((pad, d), A.dtype)], axis=0)
         A = jax.device_put(A, NamedSharding(mesh, P("data", None)))

@@ -19,6 +19,7 @@ back to two jnp matmuls (tests exercise the kernel in interpreter mode).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Tuple
 
 import jax
@@ -113,10 +114,42 @@ def use_pallas() -> bool:
 #: Empirical VMEM budget for the fused gram kernel, in f32 slots of
 #: (dp + 2*tile) * (dp + kp): the (d, d) + (d, k) accumulators live in
 #: VMEM across the whole grid, plus double-buffered (tile, dp) and
-#: (tile, kp) input blocks. Measured on a v5e-class chip at kp=128:
-#: dp=896 compiles, dp=1024 crashes the TPU compiler with a
-#: scoped-vmem OOM — the budget is the measured-pass footprint.
-_GRAM_VMEM_SLOTS = (896 + 2 * ROW_TILE) * (896 + 128)
+#: (tile, kp) input blocks. Measured on a v5e-class chip (128 MiB
+#: VMEM) at kp=128: dp=896 compiles, dp=1024 crashes the TPU compiler
+#: with a scoped-vmem OOM — the budget is the measured-pass footprint.
+_GRAM_VMEM_SLOTS_V5E = (896 + 2 * ROW_TILE) * (896 + 128)
+_MEASURED_VMEM_BYTES = 128 * 1024 * 1024  # the chip the budget was measured on
+
+
+def _device_vmem_bytes() -> int:
+    """Reported per-core VMEM of device 0, falling back to the measured
+    v5e value when the platform doesn't expose it (ADVICE r2: a
+    generation with smaller scoped VMEM would OOM below the fixed
+    budget)."""
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        v = stats.get("vmem_size_bytes") or stats.get("vmem_limit_bytes")
+        if v:
+            return int(v)
+    except Exception:
+        pass
+    return _MEASURED_VMEM_BYTES
+
+
+@functools.lru_cache(maxsize=1)
+def _gram_vmem_slots() -> int:
+    """Budget in f32 slots: scaled DOWN proportionally on generations
+    reporting less VMEM than the measured chip (conservative — prevents
+    the scoped-vmem compiler OOM), but never scaled UP past the
+    measured boundary: the dp=1024 compiler crash was measured, and a
+    larger reported VMEM does not prove the scoped-vmem ceiling grew
+    with it. ``KEYSTONE_GRAM_VMEM_SLOTS`` overrides for generations
+    where a bigger budget has been validated by hand."""
+    env = os.environ.get("KEYSTONE_GRAM_VMEM_SLOTS")
+    if env:
+        return int(env)
+    frac = min(1.0, _device_vmem_bytes() / _MEASURED_VMEM_BYTES)
+    return int(_GRAM_VMEM_SLOTS_V5E * frac)
 
 
 def gram_fits_vmem(d: int, k: int) -> bool:
@@ -125,7 +158,7 @@ def gram_fits_vmem(d: int, k: int) -> bool:
     and label dim k (post-padding)."""
     dp = _round_up(max(d, _LANE), _LANE)
     kp = _round_up(max(k, _LANE), _LANE)
-    return (dp + 2 * ROW_TILE) * (dp + kp) <= _GRAM_VMEM_SLOTS
+    return (dp + 2 * ROW_TILE) * (dp + kp) <= _gram_vmem_slots()
 
 
 def gram_cross(X: jax.Array, Y: jax.Array) -> Tuple[jax.Array, jax.Array]:

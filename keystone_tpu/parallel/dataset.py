@@ -237,8 +237,18 @@ def _repad(x: jax.Array, rows: int, mesh: Mesh) -> jax.Array:
 #: would get zero reuse while pinning its captured arrays forever, and
 #: re-tracing is what picks up their captured state. Cached functions
 #: must therefore be pure in their module globals (they are traced once
-#: per input shape).
-_VMAP_JIT_CACHE: dict = {}
+#: per input shape). Bounded LRU (ADVICE r2, shared ``utils.lru``
+#: protocol): bound-method keys pin their node instances, so unbounded
+#: growth leaks host+HBM memory in model-sweep loops.
+from ..utils.lru import LruMemo  # noqa: E402
+
+_VMAP_JIT_CACHE = LruMemo()
+
+
+def clear_vmap_cache() -> None:
+    """Drop the fn -> jit(vmap(fn)) memo (long-lived processes; see also
+    ``workflow.transformer.clear_jit_cache``)."""
+    _VMAP_JIT_CACHE.clear()
 
 
 def _vmap_cacheable(fn) -> bool:
@@ -262,7 +272,8 @@ def _masked_vmap(fn, data, n: int, padded_n: int, mesh: Mesh):
         try:
             jfn = _VMAP_JIT_CACHE.get(fn)
             if jfn is None:
-                jfn = _VMAP_JIT_CACHE[fn] = jax.jit(jax.vmap(fn))
+                jfn = jax.jit(jax.vmap(fn))
+                _VMAP_JIT_CACHE.put(fn, jfn)
         except TypeError:  # unhashable fn
             jfn = None
     if jfn is None:

@@ -1,0 +1,36 @@
+"""Bounded LRU memo for jitted callables — the ONE home of the
+touch/evict/clear protocol shared by the two executable memos
+(``workflow.transformer._JIT_CACHE`` and
+``parallel.dataset._VMAP_JIT_CACHE``; ADVICE r2: entries pin node
+instances and compiled executables, so unbounded growth leaks host+HBM
+memory in model-sweep loops, and two hand-rolled copies of the
+eviction logic would drift)."""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Hashable, Optional
+
+
+class LruMemo:
+    def __init__(self, max_entries: int = 256):
+        self._entries: OrderedDict = OrderedDict()
+        self.max_entries = max_entries
+
+    def get(self, key: Hashable) -> Optional[Any]:
+        """Value for key (LRU-touched), or None. May raise TypeError for
+        unhashable keys — callers treat that as uncacheable."""
+        value = self._entries.get(key)
+        if value is not None:
+            self._entries.move_to_end(key)
+        return value
+
+    def put(self, key: Hashable, value: Any) -> None:
+        self._entries[key] = value
+        while len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
+
+    def clear(self) -> None:
+        self._entries.clear()
+
+    def __len__(self) -> int:
+        return len(self._entries)

@@ -23,9 +23,21 @@ from .graph import Graph
 
 #: (tag, eq_key) -> jitted callable: the per-item vmap program
 #: ("batched") plus any bespoke whole-batch programs nodes register via
-#: ``_cached_jit``. Keeps node instances (hence their params) alive for
-#: the process lifetime — same trade the fusion memo makes.
-_JIT_CACHE: dict = {}
+#: ``_cached_jit``. Entries keep node instances (hence their params)
+#: alive, so the memo is a bounded LRU (``utils.lru.LruMemo``):
+#: content-keyed entries (fitted weights baked in as constants) get
+#: zero reuse across a hyperparameter sweep and would otherwise pin
+#: host+HBM memory for the process lifetime (ADVICE r2).
+#: ``clear_jit_cache`` is the hard reset for long-lived processes.
+from ..utils.lru import LruMemo  # noqa: E402
+
+_JIT_CACHE = LruMemo()
+
+
+def clear_jit_cache() -> None:
+    """Drop all globally memoized jitted programs (long-lived processes;
+    see also ``parallel.dataset.clear_vmap_cache``)."""
+    _JIT_CACHE.clear()
 
 
 class Transformer(TransformerOperator, Chainable):
@@ -67,7 +79,7 @@ class Transformer(TransformerOperator, Chainable):
             if fn is None:
                 fn = jax.jit(builder())
                 if key is not None:
-                    _JIT_CACHE[key] = fn
+                    _JIT_CACHE.put(key, fn)
             self.__dict__[attr] = fn
         return fn
 

@@ -196,3 +196,73 @@ def test_gram_sym_tile_selection():
     assert _gram_sym_tile(8192) == 512       # 16 tiles (at the cap)
     assert _gram_sym_tile(16384) == 1024     # cap doubles the tile
     assert _gram_sym_tile(2304) is None      # 512 does not divide
+
+
+def test_near_breakdown_finite_factor_takes_eigh_fallback():
+    # A near-duplicate column makes the Gram near-exactly-singular: f32
+    # Cholesky returns a FINITE factor whose last pivot collapsed to
+    # rounding noise (the "tiny positive pivot instead of a negative
+    # one" regime ADVICE r2 flagged), and the raw solve produces wild
+    # ~1e5-norm weights. The conditioning gate must route the solve to
+    # the eigh-clamped recovery instead.
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(3)
+    n, d, k = 256, 64, 3
+    A = rng.randn(n, d).astype(np.float32)
+    A[:, -1] = A[:, 0] + 1e-5 * rng.randn(n).astype(np.float32)
+    G = (A.T @ A).astype(np.float32)
+    rhs = rng.randn(d, k).astype(np.float32)
+
+    W = np.asarray(linalg.ridge_cho_solve(
+        jnp.asarray(G), jnp.asarray(rhs), 0.0))
+    assert np.isfinite(W).all()
+
+    V, wc = linalg.clamped_eigh(jnp.asarray(G))
+    expected = np.asarray((V * (1.0 / wc)) @ (V.T @ jnp.asarray(rhs)))
+    assert np.allclose(W, expected, rtol=1e-3, atol=1e-3), (
+        np.abs(W - expected).max())
+    # and the recovery is the point: bounded weights, not the raw
+    # solve's ~1e5-norm blowup
+    assert np.linalg.norm(W) < 1e3, np.linalg.norm(W)
+
+
+def test_healthy_conditioning_keeps_cholesky_path():
+    # kappa ~ 1e4 (well inside reference conditioning) must NOT take the
+    # more-strongly-regularized fallback: the solve stays the accurate
+    # Cholesky result, far from the clamped-eigh answer.
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(4)
+    d, k = 64, 3
+    Q = np.linalg.qr(rng.randn(d, d))[0]
+    eig = np.logspace(0, -4, d)
+    G = ((Q * eig) @ Q.T).astype(np.float32)
+    rhs = rng.randn(d, k).astype(np.float32)
+
+    W = np.asarray(linalg.ridge_cho_solve(
+        jnp.asarray(G), jnp.asarray(rhs), 0.0))
+    W64 = np.linalg.solve(G.astype(np.float64), rhs.astype(np.float64))
+    assert np.abs(W - W64).max() / np.abs(W64).max() < 1e-2
+
+
+def test_badly_scaled_well_conditioned_keeps_cholesky_path():
+    # G = D C D with C well-conditioned and diagonal scales spanning
+    # 1e4: raw-kappa looks ~1e8 but the f32 Cholesky solve is accurate
+    # to ~1e-7 — the scale-free pivot gate must NOT misroute it to the
+    # much-more-regularized eigh fallback (review r3 finding).
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(5)
+    n, d, k = 256, 64, 3
+    B = rng.randn(n, d)
+    C = B.T @ B / n
+    D = np.logspace(0, -4, d)
+    G = ((C * D[None, :]) * D[:, None]).astype(np.float32)
+    rhs = (rng.randn(d, k) * D[:, None]).astype(np.float32)
+
+    W = np.asarray(linalg.ridge_cho_solve(
+        jnp.asarray(G), jnp.asarray(rhs), 0.0))
+    W64 = np.linalg.solve(G.astype(np.float64), rhs.astype(np.float64))
+    rel = np.abs(W - W64).max() / np.abs(W64).max()
+    assert rel < 1e-3, rel
