@@ -518,14 +518,14 @@ def timit_bench():
 
     def split(n, seed):
         # noise sized for genuine class overlap (||proto_i - proto_j||
-        # ~ sqrt(2d) ~ 29.7, sigma 3.0 -> pairwise discriminant ~5
+        # ~ sqrt(2d) ~ 29.7, sigma 4.0 (3.0 saturated to 0.5% error at full size)
         # sigma across 146 competitors): the Bayes error is nonzero and
         # train-size-independent, so the emitted test_error cannot
         # saturate at 0.00% at full scale (VERDICT r2 weak#3) — real
         # TIMIT phone classification sits near ~33% error itself
         r = np.random.RandomState(seed)
         y = r.randint(0, k, n)
-        X = (protos[y] + 3.0 * r.randn(n, d)).astype(np.float32)
+        X = (protos[y] + 4.0 * r.randn(n, d)).astype(np.float32)
         return LabeledData(ArrayDataset.from_numpy(X),
                            ArrayDataset.from_numpy(y.astype(np.int32)))
 
@@ -570,10 +570,10 @@ def mnist_bench():
     # (the old wide U[0,1] protos saturated test_error at 0.00% at full
     # train scale, VERDICT r2 weak#3). The 0.18 spread is empirical:
     # [0,1] clipping plus the sign->FFT->rectify featurization loses
-    # enough of the raw-pixel margin that SMALL-size error lands ~33%
-    # (0.12 gave 55%, 0.07 gave 73%); full-size value is checked
+    # enough margin that the full-size Bayes floor is real
+    # (0.18 and 0.10 both saturated to 0.0 at the full 16384-example size; pairwise discriminant ~2.8 sigma at 0.05); the full-size value is what is checked
     # non-saturated on the bench chip.
-    protos = (0.5 + 0.18 * rng.randn(10, 784)).astype(np.float32)
+    protos = (0.5 + 0.05 * rng.randn(10, 784)).astype(np.float32)
 
     def split(n, seed):
         r = np.random.RandomState(seed)
@@ -950,8 +950,11 @@ def main():
             print(f"# skipped {section.__name__}: {remaining:.0f}s "
                   f"of budget left < {est}s estimate", flush=True)
             continue
+        t_sec = time.monotonic()
         _run_section(section, deadline)
         _section_cleanup()
+        print(f"# {section.__name__} took {time.monotonic() - t_sec:.0f}s",
+              flush=True)
         _emit_summary()
     if _emitted == 0:
         # every section failed: fail loudly instead of exiting 0 with an

@@ -141,6 +141,31 @@ class Convolver(Transformer):
             self.var_constant,
         )
 
+    # fitted-param protocol: the (whitened) filter bank is fitted per
+    # run, so programs built over plain apply() bake it as constants and
+    # recompile on every refit; threading it as arguments lets fused
+    # featurizer chains share one compiled program across refits.
+    def apply_params(self):
+        params = self.__dict__.get("_jit_conv_params")
+        if params is None:
+            means = (None if self.whitener is None
+                     else jnp.asarray(self.whitener.means))
+            params = (jnp.asarray(self.filters), means)
+            self.__dict__["_jit_conv_params"] = params  # _jit_*: unpickled
+        return params
+
+    def apply_with_params(self, params, img):
+        filters, means = params
+        return image_ops.filter_bank_convolve(
+            img, filters, self.conv_size, self.img_channels,
+            self.normalize_patches, means, self.var_constant,
+        )
+
+    def struct_key(self):
+        return (Convolver, self.conv_size, self.img_channels,
+                self.normalize_patches, self.var_constant,
+                self.whitener is None)
+
 
 class Windower(Transformer):
     """Dense sliding-window patch extraction (reference
@@ -411,3 +436,35 @@ class FusedConvRectifyPool(Transformer):
         if isinstance(ds, ArrayDataset) and use_pallas():
             return ds.map_batch(self._fused_batch)
         return super().apply_dataset(ds)
+
+    # fitted-param protocol (off-TPU composed path; the Pallas batch
+    # path already takes filters as arguments): the fitted whitened
+    # filter bank rides as a runtime argument so refits never recompile
+    def apply_params(self):
+        params = self.__dict__.get("_jit_conv_params")
+        if params is None:
+            means = (None if self.whitener_means is None
+                     else jnp.asarray(self.whitener_means))
+            params = (jnp.asarray(self.filters), means)
+            self.__dict__["_jit_conv_params"] = params
+        return params
+
+    def apply_with_params(self, params, img):
+        from ...ops.image_ops import filter_bank_convolve, pool_image
+
+        filters, means = params
+        conv = filter_bank_convolve(
+            img, filters, self.patch_size, self.channels, True, means,
+            self.var_constant)
+        pos = jnp.maximum(0.0, conv - self.alpha)
+        neg = jnp.maximum(0.0, -conv - self.alpha)
+        pooled = pool_image(
+            jnp.concatenate([pos, neg], -1), self.pool_stride,
+            self.pool_size, "identity", "sum")
+        return pooled.reshape(-1)
+
+    def struct_key(self):
+        return (FusedConvRectifyPool, self.filters.shape, self.img_size,
+                self.patch_size, self.channels, self.pool_stride,
+                self.pool_size, self.alpha, self.var_constant,
+                self.whitener_means is None)

@@ -158,7 +158,7 @@ def _solve_all_classes(X, cls, mask, L, jfm, joint_label_mean, counts,
 @functools.partial(jax.jit, static_argnames=("bounds", "num_iter"))
 def _solve_single_class(X, b, y, mu, lam, bounds, num_iter):
     """BCD for one class (reference ReWeightedLeastSquares.scala:37-135)."""
-    from ...ops.linalg import _finite_or_eigh_solve
+    from ...ops.linalg import _chol_healthy, _finite_or_eigh_solve
 
     by = b * y
     Ws = [jnp.zeros((hi - lo,), X.dtype) for lo, hi in bounds]
@@ -175,9 +175,12 @@ def _solve_single_class(X, b, y, mu, lam, bounds, num_iter):
 
     for lo, hi in bounds:
         reg_fn = _make_reg(lo, hi)
-        L = jax.scipy.linalg.cho_factor(reg_fn(), lower=True)
+        G = reg_fn()
+        L = jax.scipy.linalg.cho_factor(G, lower=True)
         factors.append(L)
-        factor_ok.append(jnp.all(jnp.isfinite(L[0])))
+        # shared collapsed-pivot gate: finite-but-garbage factors from
+        # near-exact rank deficiency also take the eigh fallback
+        factor_ok.append(_chol_healthy(L[0], G))
         reg_fns.append(reg_fn)
     # residual r accumulates B .* (X_zm @ W)
     r = jnp.zeros_like(y)

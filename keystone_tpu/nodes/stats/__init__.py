@@ -123,6 +123,15 @@ class CosineRandomFeatures(Transformer):
         return jnp.cos(x @ self.W.T + self.b)
 
 
+@jax.jit
+def _center_scale_batch(X, mean, inv_std):
+    """Whole-batch scaler apply with params as ARGUMENTS (not baked HLO
+    constants): one compiled program serves every fitted scaler, so
+    refitting on new data never recompiles (see
+    ``nodes/learning/linear._affine_apply_batch`` for the rationale)."""
+    return (X - mean) * inv_std
+
+
 class StandardScalerModel(Transformer):
     """(x - mean) [/ std] (reference ``stats/StandardScaler.scala:16-31``)."""
 
@@ -135,6 +144,32 @@ class StandardScalerModel(Transformer):
         if self.std is not None:
             out = out / self.std
         return out
+
+    def apply_dataset(self, ds: Dataset) -> Dataset:
+        if isinstance(ds, ArrayDataset):
+            m, inv = self.apply_params()
+            return ds.map_batch(lambda X: _center_scale_batch(X, m, inv))
+        return super().apply_dataset(ds)
+
+    # fitted-param protocol: fused chains thread these as jit arguments
+    fusion_safe = True
+
+    def apply_params(self):
+        params = self.__dict__.get("_jit_scale_params")
+        if params is None:
+            mean = jnp.asarray(self.mean, jnp.float32)
+            inv = (jnp.ones_like(mean) if self.std is None
+                   else jnp.asarray(1.0 / self.std, jnp.float32))
+            params = (mean, inv)
+            self.__dict__["_jit_scale_params"] = params  # _jit_*: unpickled
+        return params
+
+    def apply_with_params(self, params, x):
+        mean, inv = params
+        return (x - mean) * inv
+
+    def struct_key(self):
+        return (StandardScalerModel, "center_scale")
 
 
 class StandardScaler(Estimator):

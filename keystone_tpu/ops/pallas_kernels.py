@@ -136,7 +136,6 @@ def _device_vmem_bytes() -> int:
     return _MEASURED_VMEM_BYTES
 
 
-@functools.lru_cache(maxsize=1)
 def _gram_vmem_slots() -> int:
     """Budget in f32 slots: scaled DOWN proportionally on generations
     reporting less VMEM than the measured chip (conservative — prevents
@@ -144,12 +143,19 @@ def _gram_vmem_slots() -> int:
     measured boundary: the dp=1024 compiler crash was measured, and a
     larger reported VMEM does not prove the scoped-vmem ceiling grew
     with it. ``KEYSTONE_GRAM_VMEM_SLOTS`` overrides for generations
-    where a bigger budget has been validated by hand."""
+    where a bigger budget has been validated by hand — read live (not
+    cached) so setting it mid-process takes effect; only the device
+    probe is cached."""
     env = os.environ.get("KEYSTONE_GRAM_VMEM_SLOTS")
     if env:
         return int(env)
-    frac = min(1.0, _device_vmem_bytes() / _MEASURED_VMEM_BYTES)
+    frac = min(1.0, _cached_device_vmem() / _MEASURED_VMEM_BYTES)
     return int(_GRAM_VMEM_SLOTS_V5E * frac)
+
+
+@functools.lru_cache(maxsize=1)
+def _cached_device_vmem() -> int:
+    return _device_vmem_bytes()
 
 
 def gram_fits_vmem(d: int, k: int) -> bool:

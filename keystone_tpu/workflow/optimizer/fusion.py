@@ -11,20 +11,89 @@ GEMMs — the HBM-bandwidth win.
 
 Runs after fitting too: `FittedPipeline.apply` re-optimizes its
 transformer-only graph, so fitted model chains (scaler >> linear model
->> argmax) also fuse.
+>> argmax) also fuse. Stages implementing the fitted-param protocol
+(``Transformer.apply_params``/``apply_with_params``) thread their
+fitted arrays through the fused program as runtime ARGUMENTS, so one
+compiled program per chain STRUCTURE serves every refit — fusion and
+the content-free compile property compose instead of trading off.
 
 Only nodes with DEFAULT dataset semantics fuse — anything overriding
 ``apply_dataset`` (whole-batch GEMMs, Windower-style reshapes, host
-stages, Cacher materialization points) keeps its node boundary.
+stages, Cacher materialization points) keeps its node boundary, except
+nodes marked ``fusion_safe`` (whose override is an optimized
+equivalent of the default per-item map).
 """
 from __future__ import annotations
 
 from typing import Dict, List, Tuple
 
+import jax
+
 from ..graph import Graph
 from ..graph_ids import NodeId
-from ..transformer import HostTransformer, Transformer
+from ..transformer import (
+    HostTransformer,
+    Transformer,
+    config_shim,
+    struct_cached_jit,
+)
 from .rule import Rule
+
+
+def _stage_key(s: Transformer):
+    """Per-stage contribution to a fused program's jit key: the
+    content-free struct_key for param-protocol stages (their fitted
+    arrays ride as runtime arguments), the full content-bearing eq_key
+    for baked stages (whose arrays become program constants, so sharing
+    requires identical content)."""
+    if s.apply_params() is not None:
+        return ("params", s.struct_key())
+    return ("baked", s._cached_eq_key())
+
+
+def _param_batched(node, stages: List[Transformer]):
+    """Whole-batch callable for a fused chain/fan-out with every stage's
+    fitted params threaded as jit ARGUMENTS: one compiled program per
+    chain STRUCTURE serves every refit (the same content-free property
+    as ``nodes/learning/linear._affine_apply_batch``, composed through
+    fusion). Returns None when any stage key is unhashable (fall back to
+    the content-keyed path)."""
+    try:
+        key = (type(node), tuple(_stage_key(s) for s in stages))
+        hash(key)
+    except TypeError:
+        return None
+    plist = node.__dict__.get("_jit_fused_params")
+    if plist is None:
+        plist = tuple(s.apply_params() for s in stages)
+        node.__dict__["_jit_fused_params"] = plist  # _jit_*: unpickled
+
+    is_gather = isinstance(node, FusedGatherTransformer)
+
+    def builder():
+        # param stages are captured as array-free config shims so the
+        # hot cached program cannot pin the first refit's fitted arrays;
+        # baked stages keep the live instance (their key includes the
+        # content eq_key, so sharing implies identical arrays anyway)
+        bound = [s if s.apply_params() is None else config_shim(s)
+                 for s in stages]
+
+        def raw(params, X):
+            def item(x):
+                if is_gather:
+                    return tuple(
+                        s.apply_with_params(p, x)
+                        for s, p in zip(bound, params))
+                for s, p in zip(bound, params):
+                    x = s.apply_with_params(p, x)
+                return x
+
+            return jax.vmap(item)(X)
+
+        return raw
+
+    fn = struct_cached_jit(key, builder)
+    return lambda X: fn(plist, X)
 
 
 class FusedTransformer(Transformer):
@@ -44,6 +113,10 @@ class FusedTransformer(Transformer):
         for s in self.stages:
             x = s.apply(x)
         return x
+
+    def _batched(self):
+        fn = _param_batched(self, self.stages)
+        return fn if fn is not None else super()._batched()
 
     def label(self) -> str:
         return "Fused[" + " >> ".join(s.label() for s in self.stages) + "]"
@@ -79,7 +152,8 @@ def _fusable(op) -> bool:
     return (
         isinstance(op, Transformer)
         and not isinstance(op, HostTransformer)
-        and type(op).apply_dataset is Transformer.apply_dataset
+        and (type(op).apply_dataset is Transformer.apply_dataset
+             or op.fusion_safe)  # optimized-but-equivalent overrides
         and not getattr(op, "saveable", False)
     )
 
@@ -99,6 +173,10 @@ class FusedGatherTransformer(Transformer):
 
     def apply(self, x):
         return tuple(b.apply(x) for b in self.branches)
+
+    def _batched(self):
+        fn = _param_batched(self, self.branches)
+        return fn if fn is not None else super()._batched()
 
     def label(self) -> str:
         return ("FusedGather[" +

@@ -40,10 +40,71 @@ def clear_jit_cache() -> None:
     _JIT_CACHE.clear()
 
 
+def config_shim(node: "Transformer") -> "Transformer":
+    """Array-free clone for closure capture by struct-keyed cached
+    programs: the cached entry is hot (shared by every refit by design),
+    so closing over the live node would pin the FIRST refit's fitted
+    arrays in host+HBM memory for the process lifetime. The shim keeps
+    only config attributes — exactly what ``apply_with_params`` may read
+    from self per its contract; an implementation that violates the
+    contract now fails loudly (missing attribute) instead of silently
+    sharing stale weights."""
+    shim = object.__new__(type(node))
+    for k, v in node.__dict__.items():
+        if k.startswith("_jit_") or k == "_eq_key_val":
+            continue
+        if any(hasattr(leaf, "shape") or isinstance(leaf, Transformer)
+               for leaf in jax.tree_util.tree_leaves(v)):
+            continue  # fitted arrays / nested nodes: not config
+        shim.__dict__[k] = v
+    return shim
+
+
+def struct_cached_jit(key: Any, builder: Callable[[], Callable]) -> Callable:
+    """Globally memoized ``jax.jit(builder())`` under an explicit key —
+    the structure-keyed sibling of ``Transformer._cached_jit`` (which
+    keys on content-bearing eq_keys). Used by fusion to share ONE
+    compiled program across refits whose fitted params ride as runtime
+    arguments."""
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(builder())
+        _JIT_CACHE.put(key, fn)
+    return fn
+
+
 class Transformer(TransformerOperator, Chainable):
+    #: Set True on subclasses whose ``apply_dataset`` override is merely
+    #: an optimized equivalent of the default per-item map (so map-chain
+    #: fusion may still fuse through them).
+    fusion_safe = False
+
     def apply(self, x: Any) -> Any:
         """Per-item transform (pure, jax-traceable unless host-only)."""
         raise NotImplementedError
+
+    # -- fitted-param protocol (content-free compiled programs) -----------
+    def apply_params(self) -> Any:
+        """Pytree of FITTED arrays consumed by ``apply_with_params``, or
+        None for stateless/config-only nodes (whose arrays may bake into
+        programs as constants — config is stable across refits). When
+        not None, jitted programs built over ``apply_with_params`` take
+        the params as runtime arguments, so ONE compile serves every
+        refit (in-process and via the persistent compilation cache)."""
+        return None
+
+    def apply_with_params(self, params: Any, x: Any) -> Any:
+        """``apply(x)`` reading fitted arrays from ``params`` (the same
+        pytree ``apply_params`` returns). Must not read array attributes
+        from ``self`` when ``apply_params`` is not None."""
+        return self.apply(x)
+
+    def struct_key(self) -> Any:
+        """Content-free structural identity: equal struct_keys MUST
+        imply identical ``apply_with_params`` behavior given equal
+        params. Default = the content-bearing eq_key, which is always
+        sound (equal content implies equal behavior)."""
+        return self._cached_eq_key()
 
     def apply_dataset(self, ds: Dataset) -> Dataset:
         if isinstance(ds, ArrayDataset):
@@ -58,7 +119,35 @@ class Transformer(TransformerOperator, Chainable):
         pipeline reuses the warm XLA executable instead of recompiling
         (eq_key is the CSE equality — same key means same semantics, so
         sharing the compiled program is sound by construction).
-        """
+
+        Nodes implementing the fitted-param protocol route through a
+        STRUCTURE-keyed program with their params as runtime arguments
+        instead: one compile serves every refit, even with new fitted
+        content (the content-bearing eq_key path would bake the arrays
+        as program constants and recompile per refit)."""
+        params = self.apply_params()
+        if params is not None:
+            try:
+                key = ("param_batched", self.struct_key())
+                hash(key)
+            except TypeError:
+                key = None
+            if key is not None:
+                node = config_shim(self)  # must not pin fitted arrays
+
+                def builder():
+                    # contract: apply_with_params reads NO array attrs
+                    # from the closed-over shim — only config (which the
+                    # struct_key covers), so sharing across equal keys
+                    # is sound
+                    def raw(p, X):
+                        return jax.vmap(
+                            lambda x: node.apply_with_params(p, x))(X)
+
+                    return raw
+
+                fn = struct_cached_jit(key, builder)
+                return lambda X: fn(params, X)
         return self._cached_jit(
             "batched", lambda: jax.vmap(self.apply))
 

@@ -329,3 +329,47 @@ def test_app_rebuild_compiles_nothing(mesh8):
             lg.removeHandler(handler)
     compiles = [ln for ln in buf.getvalue().splitlines() if "Compiling" in ln]
     assert not compiles, compiles
+
+
+def test_fused_chain_shares_program_across_refits(mesh8):
+    # Fitted chains thread params as jit ARGUMENTS: two fused
+    # scaler >> linear-model chains with DIFFERENT fitted content must
+    # share ONE compiled program (content-free key) and still produce
+    # their own correct outputs.
+    import importlib
+
+    import jax.numpy as jnp
+
+    from keystone_tpu.nodes.learning.linear import LinearMapper
+    from keystone_tpu.nodes.stats import StandardScalerModel
+    from keystone_tpu.parallel.dataset import ArrayDataset
+    from keystone_tpu.workflow.optimizer.fusion import FusedTransformer
+
+    tmod = importlib.import_module("keystone_tpu.workflow.transformer")
+    tmod.clear_jit_cache()
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 16).astype(np.float32)
+    ds = ArrayDataset.from_numpy(X)
+
+    def chain(seed):
+        r = np.random.RandomState(seed)
+        mean = r.randn(16).astype(np.float32)
+        std = (0.5 + r.rand(16)).astype(np.float32)
+        W = r.randn(16, 4).astype(np.float32)
+        b = r.randn(4).astype(np.float32)
+        fused = FusedTransformer(
+            [StandardScalerModel(mean, std), LinearMapper(W, intercept=b)])
+        want = ((X - mean) / std) @ W + b
+        return fused, want
+
+    f1, want1 = chain(1)
+    got1 = np.asarray(f1._batched()(jnp.asarray(X)))
+    n_after_first = len(tmod._JIT_CACHE)
+    f2, want2 = chain(2)
+    got2 = np.asarray(f2._batched()(jnp.asarray(X)))
+    assert np.allclose(got1, want1, atol=1e-4)
+    assert np.allclose(got2, want2, atol=1e-4)
+    # second chain (different content) added NO new program
+    assert len(tmod._JIT_CACHE) == n_after_first, (
+        n_after_first, len(tmod._JIT_CACHE))
