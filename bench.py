@@ -68,11 +68,15 @@ def _enable_compilation_cache():
 
 SMALL = os.environ.get("KEYSTONE_BENCH_SMALL") == "1"
 
-#: Wall-clock budget for the full run. The driver killed round 2's run
-#: (rc=124) somewhere past the ~10 minute mark; staying self-limited
-#: below that means the process always reaches its own exit path and
-#: the lowest-priority sections are the ones sacrificed, explicitly.
-BUDGET_S = float(os.environ.get("KEYSTONE_BENCH_BUDGET_S", "480"))
+#: Wall-clock budget for the full run. Round 2's driver kill (rc=124)
+#: came AFTER ~910s of completed sections (featurize/solver/imagenet/
+#: e2e/mnist all emitted), so the driver timeout is >~910s. 780 keeps
+#: >2 minutes of margin under that bound for a section that overruns
+#: its estimate after being admitted (the per-section check bounds
+#: start times, not overruns), so the process always reaches its own
+#: exit path and the lowest-priority sections are the ones sacrificed,
+#: explicitly. A fully warm-cache run measures ~460s total.
+BUDGET_S = float(os.environ.get("KEYSTONE_BENCH_BUDGET_S", "780"))
 _START = time.monotonic()
 
 FLAGSHIP = "cifar_randompatch_images_per_sec_per_chip"
@@ -264,24 +268,31 @@ def e2e_bench():
     y_tr = rng.randint(0, 10, n_train)
     L_host = (-np.ones((n_train, 10)) + 2.0 * np.eye(10)[y_tr]).astype(np.float32)
 
-    def batches(n, seed):
-        assert n % batch == 0, "np.stack/reshape below need even batches"
-        r = np.random.RandomState(seed)
-        for i in range(0, n, batch):
-            yield r.rand(batch, 32, 32, 3).astype(np.float32) * 255
-
-    # one host-side stack -> ONE device_put per split (stacking
-    # already-device-put batches would hold two full copies in HBM),
-    # sharded within each batch over the data axis so dividing by
-    # device count below is earned on multi-chip hosts
+    # images generated ON DEVICE (throughput content is irrelevant): a
+    # host-generated ~300 MB stack rode the dev tunnel, whose bandwidth
+    # swings put 60..500 s of pure upload into this section (the round-3
+    # driver-sim run that blew the budget); only the small label matrix
+    # is uploaded. Batches sharded over the data axis so dividing by
+    # device count below is earned on multi-chip hosts.
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from keystone_tpu.parallel.mesh import make_mesh
 
+    import functools
+
     sh = NamedSharding(make_mesh(jax.devices()), P(None, "data"))
-    train_dev = jax.device_put(np.stack(list(batches(n_train, 3))), sh)
-    test_dev = jax.device_put(np.stack(list(batches(n_test, 4))), sh)
+
+    @functools.partial(jax.jit, static_argnames=("n",), out_shardings=sh)
+    def gen_images(key, n):
+        assert n % batch == 0, (  # silent // truncation would inflate
+            f"{n} images not divisible by batch {batch}")  # the metric
+        return 255.0 * jax.random.uniform(
+            key, (n // batch, batch, 32, 32, 3), jnp.float32)
+
+    train_dev = gen_images(jax.random.PRNGKey(3), n_train)
+    test_dev = gen_images(jax.random.PRNGKey(4), n_test)
     L = jax.device_put(L_host, NamedSharding(sh.mesh, P("data")))
+    _fence((train_dev, test_dev, L))  # staging fence, untimed
 
     # the whole train path (featurize every batch -> center -> BCD
     # solve) stages into ONE jit, and prediction into another: the
@@ -927,18 +938,19 @@ def main():
     measured so far, no matter where the run is cut off. Sections whose
     conservative cost estimate exceeds the remaining self-imposed budget
     are skipped explicitly (lowest priority last => sacrificed first)."""
-    # (section, conservative cost estimate in seconds with a warm
-    # compilation cache; cold compiles can exceed these — the deadline
-    # check before each section is what keeps the total bounded)
+    # (section, cost estimate in seconds: measured warm-cache costs on
+    # the bench chip + margin; cold compiles can exceed these — the
+    # deadline check before each section is what keeps the total
+    # bounded)
     sections = (
-        (featurize_bench, 40),
-        (solver_bench, 40),
-        (accuracy_bench, 120),
-        (timit_bench, 60),
-        (newsgroups_bench, 40),
-        (loader_bench, 40),
-        (e2e_bench, 60),
-        (imagenet_rehearsal_bench, 60),
+        (featurize_bench, 15),
+        (solver_bench, 90),
+        (accuracy_bench, 90),
+        (timit_bench, 200),
+        (newsgroups_bench, 15),
+        (loader_bench, 30),
+        (e2e_bench, 120),
+        (imagenet_rehearsal_bench, 110),
         (mnist_bench, 60),
     )
     deadline = _START + BUDGET_S

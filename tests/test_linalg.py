@@ -266,3 +266,24 @@ def test_badly_scaled_well_conditioned_keeps_cholesky_path():
     W64 = np.linalg.solve(G.astype(np.float64), rhs.astype(np.float64))
     rel = np.abs(W - W64).max() / np.abs(W64).max()
     assert rel < 1e-3, rel
+
+
+def test_bcd_scan_matches_unrolled():
+    # equal-width multi-block solves take the lax.scan body; it must be
+    # numerically identical (same sequential update order) to the
+    # unrolled path, which ragged block lists still use
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(11)
+    n, k = 192, 3
+    X = rng.randn(n, 96).astype(np.float32)
+    Y = rng.randn(n, k).astype(np.float32)
+    blocks = tuple(jnp.asarray(X[:, i:i + 32]) for i in range(0, 96, 32))
+    lam = jnp.float32(0.05)
+    scan_out = linalg._bcd_scan_body(blocks, jnp.asarray(Y), lam,
+                                     num_passes=3)
+    unrolled = linalg._bcd_core_body(blocks, jnp.asarray(Y), lam,
+                                     num_passes=3)
+    for a, b in zip(scan_out, unrolled):
+        assert np.allclose(np.asarray(a), np.asarray(b),
+                           rtol=1e-5, atol=1e-5)
