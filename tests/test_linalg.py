@@ -269,21 +269,32 @@ def test_badly_scaled_well_conditioned_keeps_cholesky_path():
 
 
 def test_bcd_scan_matches_unrolled():
-    # equal-width multi-block solves take the lax.scan body; it must be
-    # numerically identical (same sequential update order) to the
-    # unrolled path, which ragged block lists still use
+    # 4+ equal-width blocks route through bcd_core's lax.scan body (the
+    # dispatch itself is exercised here, not just the body); the scan
+    # result must be numerically identical (same sequential update
+    # order) to the unrolled path, which ragged/small lists still use
     import jax.numpy as jnp
 
     rng = np.random.RandomState(11)
     n, k = 192, 3
-    X = rng.randn(n, 96).astype(np.float32)
+    X = rng.randn(n, 128).astype(np.float32)
     Y = rng.randn(n, k).astype(np.float32)
-    blocks = tuple(jnp.asarray(X[:, i:i + 32]) for i in range(0, 96, 32))
+    blocks = tuple(jnp.asarray(X[:, i:i + 32]) for i in range(0, 128, 32))
     lam = jnp.float32(0.05)
+    # through the public dispatch: 4 equal blocks -> scan body
+    via_core = linalg.bcd_core(blocks, jnp.asarray(Y), lam, num_passes=3)
+    # direct bodies for the equivalence claim
     scan_out = linalg._bcd_scan_body(blocks, jnp.asarray(Y), lam,
                                      num_passes=3)
     unrolled = linalg._bcd_core_body(blocks, jnp.asarray(Y), lam,
                                      num_passes=3)
-    for a, b in zip(scan_out, unrolled):
-        assert np.allclose(np.asarray(a), np.asarray(b),
+    for a, b, c in zip(via_core, scan_out, unrolled):
+        assert np.allclose(np.asarray(a), np.asarray(b), rtol=0, atol=0), \
+            "bcd_core must dispatch 4 equal blocks to the scan body"
+        assert np.allclose(np.asarray(b), np.asarray(c),
                            rtol=1e-5, atol=1e-5)
+    # ragged lists stay on the unrolled path (scan would crash on stack)
+    ragged = (jnp.asarray(X[:, :48]), jnp.asarray(X[:, 48:96]),
+              jnp.asarray(X[:, 96:]), jnp.asarray(X[:, 96:]))
+    out = linalg.bcd_core(ragged, jnp.asarray(Y), lam, num_passes=1)
+    assert len(out) == 4
