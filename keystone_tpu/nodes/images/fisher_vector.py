@@ -59,18 +59,37 @@ class FisherVector(Transformer):
 
     def __init__(self, gmm: GaussianMixtureModel):
         self.gmm = gmm
+        # plain config copy: struct-keyed programs capture an array-free
+        # shim (config_shim drops the nested gmm node), and
+        # apply_with_params may only read config attributes
+        self.weight_threshold = gmm.weight_threshold
 
     def eq_key(self):
         return (FisherVector, id(self.gmm))
 
     def apply(self, x):
+        return self.apply_with_params(self.apply_params(), x)
+
+    # fitted-param protocol (PERFORMANCE.md rule 6): a refitted GMM
+    # codebook never recompiles the FV encoder
+    def apply_params(self):
+        params = self.__dict__.get("_jit_fv_params")
+        if params is None:
+            params = (jnp.asarray(self.gmm.means),
+                      jnp.asarray(self.gmm.variances),
+                      jnp.asarray(self.gmm.weights))
+            self.__dict__["_jit_fv_params"] = params
+        return params
+
+    def apply_with_params(self, params, x):
+        means, variances, weights = params
         return _fisher_vector(
-            x.astype(jnp.float32),
-            jnp.asarray(self.gmm.means),
-            jnp.asarray(self.gmm.variances),
-            jnp.asarray(self.gmm.weights),
-            self.gmm.weight_threshold,
+            x.astype(jnp.float32), means, variances, weights,
+            self.weight_threshold,
         )
+
+    def struct_key(self):
+        return (FisherVector, self.weight_threshold)
 
 
 def _gmm_from_columns(ds: Dataset, k: int,

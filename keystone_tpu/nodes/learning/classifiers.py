@@ -49,6 +49,23 @@ class NaiveBayesModel(Transformer):
             return self.pi + self.theta[:, x.indices] @ x.values
         return self.pi + self.theta @ x
 
+    # fitted-param protocol for the DENSE batch path (sparse inputs go
+    # through the padded-COO apply_dataset override): a refitted model
+    # never recompiles the scoring program (PERFORMANCE.md rule 6)
+    def apply_params(self):
+        params = self.__dict__.get("_jit_nb_params")
+        if params is None:
+            params = (jnp.asarray(self.pi), jnp.asarray(self.theta))
+            self.__dict__["_jit_nb_params"] = params
+        return params
+
+    def apply_with_params(self, params, x):
+        pi, theta = params
+        return pi + theta @ x
+
+    def struct_key(self):
+        return (NaiveBayesModel, "score")
+
     def apply_dataset(self, ds: Dataset) -> Dataset:
         from ..util.sparse import is_sparse_host
 
@@ -141,6 +158,22 @@ class LogisticRegressionModel(Transformer):
             scores = x.values @ self.weights[x.indices]
             return jnp.argmax(scores, axis=-1).astype(jnp.int32)
         return jnp.argmax(x @ self.weights, axis=-1).astype(jnp.int32)
+
+    # fitted-param protocol for the DENSE batch path (sparse inputs go
+    # through the SparseLinearMapper apply_dataset override)
+    def apply_params(self):
+        params = self.__dict__.get("_jit_lr_params")
+        if params is None:
+            params = (jnp.asarray(self.weights),)
+            self.__dict__["_jit_lr_params"] = params
+        return params
+
+    def apply_with_params(self, params, x):
+        (W,) = params
+        return jnp.argmax(x @ W, axis=-1).astype(jnp.int32)
+
+    def struct_key(self):
+        return (LogisticRegressionModel, "argmax")
 
     def apply_dataset(self, ds: Dataset) -> Dataset:
         from ..util.sparse import is_sparse_host

@@ -69,13 +69,27 @@ class GaussianMixtureModel(Transformer):
         return self.means.shape[0]
 
     def apply(self, x):
+        return self.apply_with_params(self.apply_params(), x)
+
+    # fitted-param protocol (PERFORMANCE.md rule 6): refitted mixtures
+    # never recompile the posterior program
+    def apply_params(self):
+        params = self.__dict__.get("_jit_gmm_params")
+        if params is None:
+            params = (jnp.asarray(self.means.T),
+                      jnp.asarray(self.variances.T),
+                      jnp.asarray(self.weights))
+            self.__dict__["_jit_gmm_params"] = params
+        return params
+
+    def apply_with_params(self, params, x):
+        means_t, vars_t, weights = params
         return _posteriors(
-            x[None, :],
-            jnp.asarray(self.means.T),
-            jnp.asarray(self.variances.T),
-            jnp.asarray(self.weights),
-            self.weight_threshold,
+            x[None, :], means_t, vars_t, weights, self.weight_threshold,
         )[0]
+
+    def struct_key(self):
+        return (GaussianMixtureModel, self.weight_threshold)
 
     @staticmethod
     def load(mean_file: str, vars_file: str, weights_file: str) -> "GaussianMixtureModel":

@@ -23,7 +23,19 @@ class KMeansModel(Transformer):
         self.means = np.asarray(means, dtype=np.float32)  # (k, d)
 
     def apply(self, x):
-        means = jnp.asarray(self.means)
+        return self.apply_with_params(self.apply_params(), x)
+
+    # fitted-param protocol (PERFORMANCE.md rule 6): refitting the
+    # centers never recompiles the assignment program
+    def apply_params(self):
+        params = self.__dict__.get("_jit_kmeans_params")
+        if params is None:
+            params = (jnp.asarray(self.means),)
+            self.__dict__["_jit_kmeans_params"] = params
+        return params
+
+    def apply_with_params(self, params, x):
+        (means,) = params
         sq_dist = (
             0.5 * jnp.sum(x * x)
             - x @ means.T
@@ -31,6 +43,9 @@ class KMeansModel(Transformer):
         )
         k = means.shape[0]
         return (jnp.arange(k) == jnp.argmin(sq_dist)).astype(jnp.float32)
+
+    def struct_key(self):
+        return (KMeansModel, "assign")
 
 
 class KMeansPlusPlusEstimator(Estimator):

@@ -30,17 +30,37 @@ def enforce_matlab_sign_convention(pca: np.ndarray) -> np.ndarray:
     return pca * signs
 
 
-class PCATransformer(Transformer):
+class _PcaParamMixin:
+    """Fitted-param protocol shared by the PCA projections: the fitted
+    basis rides as a jit argument, so refits (new PCA on new data)
+    never recompile the apply program (PERFORMANCE.md rule 6)."""
+
+    def apply_params(self):
+        params = self.__dict__.get("_jit_pca_params")
+        if params is None:
+            params = (jnp.asarray(self.pca_mat),)
+            self.__dict__["_jit_pca_params"] = params  # _jit_*: unpickled
+        return params
+
+    def apply_with_params(self, params, x):
+        (pca_mat,) = params
+        return pca_mat.T @ x
+
+    def struct_key(self):
+        return (type(self), "project")
+
+
+class PCATransformer(_PcaParamMixin, Transformer):
     """x -> pca_mat^T x (reference PCA.scala:19-30). pca_mat is (d, k)."""
 
     def __init__(self, pca_mat: np.ndarray):
         self.pca_mat = np.asarray(pca_mat, dtype=np.float32)
 
     def apply(self, x):
-        return self.pca_mat.T @ x
+        return self.apply_with_params(self.apply_params(), x)
 
 
-class BatchPCATransformer(Transformer):
+class BatchPCATransformer(_PcaParamMixin, Transformer):
     """Per-item matrix projection: (d, cols) -> (k, cols)
     (reference PCA.scala:38-43)."""
 
@@ -48,7 +68,7 @@ class BatchPCATransformer(Transformer):
         self.pca_mat = np.asarray(pca_mat, dtype=np.float32)
 
     def apply(self, x):
-        return self.pca_mat.T @ x
+        return self.apply_with_params(self.apply_params(), x)
 
 
 @jax.jit

@@ -19,7 +19,23 @@ class ZCAWhitener(Transformer):
         self.means = np.asarray(means, dtype=np.float32)
 
     def apply(self, x):
-        return (x - self.means) @ self.whitener
+        return self.apply_with_params(self.apply_params(), x)
+
+    # fitted-param protocol (PERFORMANCE.md rule 6): refitting the
+    # whitener never recompiles the apply program
+    def apply_params(self):
+        params = self.__dict__.get("_jit_zca_params")
+        if params is None:
+            params = (jnp.asarray(self.whitener), jnp.asarray(self.means))
+            self.__dict__["_jit_zca_params"] = params
+        return params
+
+    def apply_with_params(self, params, x):
+        W, means = params
+        return (x - means) @ W
+
+    def struct_key(self):
+        return (ZCAWhitener, "whiten")
 
 
 class ZCAWhitenerEstimator(Estimator):

@@ -293,8 +293,13 @@ def test_bcd_scan_matches_unrolled():
             "bcd_core must dispatch 4 equal blocks to the scan body"
         assert np.allclose(np.asarray(b), np.asarray(c),
                            rtol=1e-5, atol=1e-5)
-    # ragged lists stay on the unrolled path (scan would crash on stack)
+    # ragged lists stay on the unrolled path (scan would crash on
+    # stack); values must match a direct unrolled-body call
     ragged = (jnp.asarray(X[:, :48]), jnp.asarray(X[:, 48:96]),
               jnp.asarray(X[:, 96:]), jnp.asarray(X[:, 96:]))
     out = linalg.bcd_core(ragged, jnp.asarray(Y), lam, num_passes=1)
+    ref = linalg._bcd_core_body(ragged, jnp.asarray(Y), lam, num_passes=1)
     assert len(out) == 4
+    for a, b in zip(out, ref):
+        assert np.allclose(np.asarray(a), np.asarray(b),
+                           rtol=1e-5, atol=1e-5)
