@@ -371,22 +371,34 @@ def _bcd_scan_body(blocks, Y, lam, *, num_passes: int):
     dtype = Y.dtype
     k = Y.shape[1]
     bs = blocks[0].shape[1]
+    B = len(blocks)
     y_spec, w_spec = _class_spec(k)
     if y_spec is not None:
         Y = jax.lax.with_sharding_constraint(Y, y_spec)
-    stacked = jnp.stack(blocks)  # (B, n, bs); transient full-X copy
     eye = lam * jnp.eye(bs, dtype=dtype)
 
-    def factor_one(_, A):
-        G = gram(A) + eye
+    # Blocks are selected by index via lax.switch instead of scanning
+    # over jnp.stack(blocks): the stack held a SECOND full copy of the
+    # design matrix in HBM alongside the caller's blocks for the whole
+    # solve, so an ImageNet-scale solve that fit under the unrolled
+    # path could OOM under scan (ADVICE r3). The switch emits B trivial
+    # branches that reference the existing buffers; only one block-sized
+    # operand is live per step, and numerics/order are unchanged.
+    def block_at(i):
+        return jax.lax.switch(i, [lambda j=j: blocks[j] for j in range(B)])
+
+    def factor_one(_, i):
+        G = gram(block_at(i)) + eye
         L, lower = jax.scipy.linalg.cho_factor(G, lower=True)
         return None, (L, _chol_healthy(L, G))
 
-    _, (Ls, oks) = jax.lax.scan(factor_one, None, stacked)
+    idx = jnp.arange(B)
+    _, (Ls, oks) = jax.lax.scan(factor_one, None, idx)
 
     def block_step(carry, xs):
         pred = carry
-        A, L, ok, W_old = xs
+        i, L, ok, W_old = xs
+        A = block_at(i)
         target = Y - pred + A @ W_old
         rhs = cross(A, target)
         if w_spec is not None:
@@ -398,7 +410,7 @@ def _bcd_scan_body(blocks, Y, lam, *, num_passes: int):
         pred = pred + A @ (W - W_old)
         return pred, W
 
-    Ws = jnp.zeros((stacked.shape[0], bs, k), dtype)
+    Ws = jnp.zeros((B, bs, k), dtype)
     pred = jnp.zeros_like(Y)
 
     # outer scan over passes: program size stays independent of the
@@ -406,12 +418,12 @@ def _bcd_scan_body(blocks, Y, lam, *, num_passes: int):
     # whole block_step scan)
     def pass_step(carry, _):
         pred, Ws = carry
-        pred, Ws = jax.lax.scan(block_step, pred, (stacked, Ls, oks, Ws))
+        pred, Ws = jax.lax.scan(block_step, pred, (idx, Ls, oks, Ws))
         return (pred, Ws), None
 
     (pred, Ws), _ = jax.lax.scan(
         pass_step, (pred, Ws), None, length=num_passes)
-    return [Ws[i] for i in range(Ws.shape[0])]
+    return [Ws[i] for i in range(B)]
 
 
 def _bcd_core_body(blocks, Y, lam, *, num_passes: int):

@@ -7,30 +7,46 @@ memory in model-sweep loops, and two hand-rolled copies of the
 eviction logic would drift)."""
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import Any, Hashable, Optional
+
+#: Internal miss marker, distinct from any storable value (including
+#: None). Not exported: ``get`` still returns None for a miss, but a
+#: stored None is disallowed by ``put`` rather than silently treated as
+#: a miss (ADVICE r3).
+_MISS = object()
 
 
 class LruMemo:
     def __init__(self, max_entries: int = 256):
         self._entries: OrderedDict = OrderedDict()
         self.max_entries = max_entries
+        # Loader thread pools share the process with the memos, so the
+        # OrderedDict mutations (move_to_end / popitem) take a lock.
+        self._lock = threading.Lock()
 
     def get(self, key: Hashable) -> Optional[Any]:
         """Value for key (LRU-touched), or None. May raise TypeError for
         unhashable keys — callers treat that as uncacheable."""
-        value = self._entries.get(key)
-        if value is not None:
+        with self._lock:
+            value = self._entries.get(key, _MISS)
+            if value is _MISS:
+                return None
             self._entries.move_to_end(key)
-        return value
+            return value
 
     def put(self, key: Hashable, value: Any) -> None:
-        self._entries[key] = value
-        while len(self._entries) > self.max_entries:
-            self._entries.popitem(last=False)
+        if value is None:  # not an assert: must survive python -O
+            raise ValueError("LruMemo cannot store None (reserved for miss)")
+        with self._lock:
+            self._entries[key] = value
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
 
     def clear(self) -> None:
-        self._entries.clear()
+        with self._lock:
+            self._entries.clear()
 
     def __len__(self) -> int:
         return len(self._entries)

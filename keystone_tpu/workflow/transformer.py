@@ -14,6 +14,7 @@ from __future__ import annotations
 from typing import Any, Callable, Sequence
 
 import jax
+import numpy as np
 
 from ..parallel.dataset import ArrayDataset, Dataset, HostDataset
 from .operators import TransformerOperator
@@ -53,9 +54,23 @@ def config_shim(node: "Transformer") -> "Transformer":
     for k, v in node.__dict__.items():
         if k.startswith("_jit_") or k == "_eq_key_val":
             continue
-        if any(hasattr(leaf, "shape") or isinstance(leaf, Transformer)
-               for leaf in jax.tree_util.tree_leaves(v)):
-            continue  # fitted arrays / nested nodes: not config
+        leaves = jax.tree_util.tree_leaves(v)
+        if any(getattr(leaf, "ndim", 0) > 0 or isinstance(leaf, Transformer)
+               or (isinstance(leaf, jax.Array) and leaf.ndim == 0)
+               for leaf in leaves):
+            # Fitted arrays / nested nodes are not config. 0-d device
+            # arrays count as fitted too: they come out of jitted
+            # computation, and keeping one would bake the first refit's
+            # value into the hot shared program — the loud AttributeError
+            # is the correct failure for a contract violation.
+            continue
+        if any(isinstance(leaf, np.generic) for leaf in leaves):
+            # 0-d HOST numpy scalars ARE config (e.g. np.float32 alpha
+            # from a constructor); dropping them breaks apply_with_params
+            # at trace time far from the construction site (ADVICE r3).
+            # Coerce to Python scalars so the shim stays array-free.
+            v = jax.tree_util.tree_map(
+                lambda leaf: leaf.item() if isinstance(leaf, np.generic) else leaf, v)
         shim.__dict__[k] = v
     return shim
 

@@ -373,3 +373,38 @@ def test_fused_chain_shares_program_across_refits(mesh8):
     # second chain (different content) added NO new program
     assert len(tmod._JIT_CACHE) == n_after_first, (
         n_after_first, len(tmod._JIT_CACHE))
+
+
+def test_config_shim_keeps_scalar_config():
+    """ADVICE r3: 0-d numpy scalars are config, not fitted state — the
+    shim must keep them (coerced to Python scalars) or cached fused
+    programs AttributeError at trace time for numpy-configured nodes."""
+    from keystone_tpu.nodes.learning.linear import LinearMapper
+    from keystone_tpu.workflow.transformer import config_shim
+
+    node = LinearMapper(np.eye(2, dtype=np.float32))
+    node.alpha = np.float32(0.25)          # 0-d numpy scalar config
+    node.names = ("a", "b")                # plain config survives
+    import jax.numpy as jnp
+    node.learned_scale = jnp.float32(2.0).reshape(())  # 0-d DEVICE array: fitted, must drop
+    shim = config_shim(node)
+    assert shim.alpha == 0.25 and isinstance(shim.alpha, float)
+    assert shim.names == ("a", "b")
+    assert not hasattr(shim, "learned_scale")
+    assert not hasattr(shim, "weights") or getattr(
+        shim, "weights", None) is None or np.ndim(shim.weights) == 0
+
+
+def test_lru_memo_rejects_none_and_is_locked():
+    """ADVICE r3: stored None used to read as a miss; now put() refuses
+    None and get/put are lock-protected for the loader thread pools."""
+    from keystone_tpu.utils.lru import LruMemo
+
+    memo = LruMemo(max_entries=2)
+    with pytest.raises(ValueError):
+        memo.put("k", None)
+    memo.put("a", 1)
+    memo.put("b", 2)
+    assert memo.get("a") == 1
+    memo.put("c", 3)  # evicts LRU ("b": "a" was touched)
+    assert memo.get("b") is None and memo.get("a") == 1 and memo.get("c") == 3
