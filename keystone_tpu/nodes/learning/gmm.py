@@ -99,6 +99,14 @@ class GaussianMixtureModel(Transformer):
         weights = np.loadtxt(weights_file, delimiter=",").ravel()
         return GaussianMixtureModel(means, variances, weights)
 
+    def save(self, mean_file: str, vars_file: str, weights_file: str) -> None:
+        """Write the CSV artifacts ``load`` reads (same layout the
+        reference's MATLAB/enceval tooling produced: (d, k) means and
+        variances, a k-vector of weights)."""
+        np.savetxt(mean_file, self.means, delimiter=",")
+        np.savetxt(vars_file, self.variances, delimiter=",")
+        np.savetxt(weights_file, self.weights, delimiter=",")
+
 
 class GaussianMixtureModelEstimator(Estimator):
     """EM for diagonal GMMs (reference GaussianMixtureModelEstimator.scala:

@@ -19,8 +19,10 @@ import numpy as np
 from ....loaders.imagenet import NUM_CLASSES, imagenet_loader
 from ....nodes.images.core import GrayScaler, PixelScaler
 from ....nodes.images.extractors import LCSExtractor, SIFTExtractor
-from ....nodes.images.fisher_vector import GMMFisherVectorEstimator
+from ....nodes.images.fisher_vector import FisherVector, GMMFisherVectorEstimator
 from ....nodes.learning import ColumnPCAEstimator
+from ....nodes.learning.gmm import GaussianMixtureModel
+from ....nodes.learning.pca import BatchPCATransformer
 from ....nodes.learning.block_weighted import (
     BlockWeightedLeastSquaresEstimator,
 )
@@ -58,22 +60,51 @@ class ImageNetSiftLcsFVConfig:
     num_pca_samples: int = 10_000_000
     num_gmm_samples: int = 10_000_000
     block_size: int = 4096
+    # Precomputed-artifact loading (reference ImageNetSiftLcsFV.scala:
+    # 46-70 + config fields :165-170): when set, the branch substitutes
+    # the loaded projection / GMM for its estimator and skips refitting.
+    sift_pca_file: Optional[str] = None
+    sift_gmm_mean_file: Optional[str] = None
+    sift_gmm_var_file: Optional[str] = None
+    sift_gmm_wts_file: Optional[str] = None
+    lcs_pca_file: Optional[str] = None
+    lcs_gmm_mean_file: Optional[str] = None
+    lcs_gmm_var_file: Optional[str] = None
+    lcs_gmm_wts_file: Optional[str] = None
 
 
 def compute_pca_fisher_branch(prefix: Pipeline, training_data: Dataset,
                               config: ImageNetSiftLcsFVConfig,
-                              pca_samples: int, gmm_samples: int) -> Pipeline:
+                              pca_samples: int, gmm_samples: int,
+                              pca_file: Optional[str] = None,
+                              gmm_mean_file: Optional[str] = None,
+                              gmm_var_file: Optional[str] = None,
+                              gmm_wts_file: Optional[str] = None) -> Pipeline:
     """The shared per-branch featurization suffix (reference
-    ``ImageNetSiftLcsFV.scala:29-80``)."""
-    pca_sample = (prefix >> ColumnSampler(pca_samples) >> Cacher())(
-        training_data)
-    pca_branch = prefix.and_then(
-        ColumnPCAEstimator(config.desc_dim).with_data(pca_sample))
+    ``ImageNetSiftLcsFV.scala:29-80``): PCA then GMM Fisher vector, each
+    either fitted from sampled columns or LOADED from CSV artifacts
+    (``pcaFile`` / ``gmmMeanFile`` cases at :46-54 / :57-63). The CSV
+    layouts match ``utils.checkpoint.save_pca`` / ``GaussianMixtureModel``:
+    the PCA file holds the (k, d) projection (transposed on load, as the
+    reference's ``csvread(...).t``), the GMM files hold (k, d) means and
+    variances and a k-vector of weights."""
+    if pca_file is not None:
+        pca_branch = prefix >> BatchPCATransformer(
+            np.loadtxt(pca_file, delimiter=",", ndmin=2).T)
+    else:
+        pca_sample = (prefix >> ColumnSampler(pca_samples) >> Cacher())(
+            training_data)
+        pca_branch = prefix.and_then(
+            ColumnPCAEstimator(config.desc_dim).with_data(pca_sample))
 
-    gmm_sample = (pca_branch >> ColumnSampler(gmm_samples))(training_data)
-    return pca_branch.and_then(
-        GMMFisherVectorEstimator(config.vocab_size).with_data(gmm_sample)
-    ) >> FloatToDouble() >> MatrixVectorizer() >> NormalizeRows() \
+    if gmm_mean_file is not None:
+        fisher = pca_branch >> FisherVector(GaussianMixtureModel.load(
+            gmm_mean_file, gmm_var_file, gmm_wts_file))
+    else:
+        gmm_sample = (pca_branch >> ColumnSampler(gmm_samples))(training_data)
+        fisher = pca_branch.and_then(
+            GMMFisherVectorEstimator(config.vocab_size).with_data(gmm_sample))
+    return fisher >> FloatToDouble() >> MatrixVectorizer() >> NormalizeRows() \
         >> SignedHellingerMapper() >> NormalizeRows()
 
 
@@ -107,9 +138,13 @@ def run(config: ImageNetSiftLcsFVConfig, train=None, test=None,
         config.lcs_stride, config.lcs_border, config.lcs_patch)
 
     sift_branch = compute_pca_fisher_branch(
-        sift_prefix, training_data, config, pca_per_img, gmm_per_img)
+        sift_prefix, training_data, config, pca_per_img, gmm_per_img,
+        config.sift_pca_file, config.sift_gmm_mean_file,
+        config.sift_gmm_var_file, config.sift_gmm_wts_file)
     lcs_branch = compute_pca_fisher_branch(
-        lcs_prefix, training_data, config, pca_per_img, gmm_per_img)
+        lcs_prefix, training_data, config, pca_per_img, gmm_per_img,
+        config.lcs_pca_file, config.lcs_gmm_mean_file,
+        config.lcs_gmm_var_file, config.lcs_gmm_wts_file)
 
     featurizer = Pipeline.gather([sift_branch, lcs_branch]) \
         >> VectorCombiner() >> Cacher()
@@ -141,10 +176,18 @@ def main(argv=None):
     p.add_argument("--mixtureWeight", type=float, default=0.25)
     p.add_argument("--descDim", type=int, default=64)
     p.add_argument("--vocabSize", type=int, default=16)
+    for flag in ("siftPcaFile", "siftGmmMeanFile", "siftGmmVarFile",
+                 "siftGmmWtsFile", "lcsPcaFile", "lcsGmmMeanFile",
+                 "lcsGmmVarFile", "lcsGmmWtsFile"):
+        p.add_argument("--" + flag, default=None)
     a = p.parse_args(argv)
     run(ImageNetSiftLcsFVConfig(
         a.trainLocation, a.testLocation, a.labelPath, a.lam,
-        a.mixtureWeight, a.descDim, a.vocabSize))
+        a.mixtureWeight, a.descDim, a.vocabSize,
+        sift_pca_file=a.siftPcaFile, sift_gmm_mean_file=a.siftGmmMeanFile,
+        sift_gmm_var_file=a.siftGmmVarFile, sift_gmm_wts_file=a.siftGmmWtsFile,
+        lcs_pca_file=a.lcsPcaFile, lcs_gmm_mean_file=a.lcsGmmMeanFile,
+        lcs_gmm_var_file=a.lcsGmmVarFile, lcs_gmm_wts_file=a.lcsGmmWtsFile))
 
 
 if __name__ == "__main__":

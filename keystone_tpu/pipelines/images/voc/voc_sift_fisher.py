@@ -20,12 +20,14 @@ from ....evaluation.mean_average_precision import (
 from ....loaders.voc import NUM_CLASSES, VOCDataPath, VOCLabelPath, voc_loader
 from ....nodes.images.core import GrayScaler, PixelScaler
 from ....nodes.images.extractors import SIFTExtractor
-from ....nodes.images.fisher_vector import GMMFisherVectorEstimator
+from ....nodes.images.fisher_vector import FisherVector, GMMFisherVectorEstimator
 from ....nodes.images.multilabel import (
     MultiLabeledImageExtractor,
     MultiLabelExtractor,
 )
 from ....nodes.learning import BlockLeastSquaresEstimator, ColumnPCAEstimator
+from ....nodes.learning.gmm import GaussianMixtureModel
+from ....nodes.learning.pca import BatchPCATransformer
 from ....nodes.stats import NormalizeRows, SignedHellingerMapper
 from ....nodes.stats.sampling import ColumnSampler
 from ....nodes.util import (
@@ -49,6 +51,13 @@ class SIFTFisherConfig:
     num_pca_samples: int = 1_000_000
     num_gmm_samples: int = 1_000_000
     block_size: int = 4096
+    # Precomputed-artifact loading (reference VOCSIFTFisher.scala:50-76):
+    # when set, the loaded projection / GMM replace their estimators and
+    # the fit is skipped.
+    pca_file: Optional[str] = None
+    gmm_mean_file: Optional[str] = None
+    gmm_var_file: Optional[str] = None
+    gmm_wts_file: Optional[str] = None
 
 
 def run(config: SIFTFisherConfig, train: Optional[Dataset] = None,
@@ -80,21 +89,32 @@ def run(config: SIFTFisherConfig, train: Optional[Dataset] = None,
                          **(sift_kwargs or {}))
     sift_extractor = PixelScaler() >> GrayScaler() >> Cacher() >> sift
 
-    # fit PCA/GMM on sampled branches; the with_data pipeline applies the
+    # fit PCA/GMM on sampled branches, or substitute loaded CSV
+    # artifacts and skip the fit; the with_data pipeline applies the
     # fitted transformer to the runtime path (the reference's
-    # ``pca.fittedTransformer`` composition, VOCSIFTFisher.scala:48-76)
-    pca_sample = (sift_extractor >> ColumnSampler(pca_samples_per_image))(
-        training_data)
-    pca_featurizer = sift_extractor.and_then(
-        ColumnPCAEstimator(config.desc_dim).with_data(pca_sample)
-    ) >> Cacher()
+    # ``pca.fittedTransformer`` composition vs the ``pcaFile``/
+    # ``gmmMeanFile`` cases, VOCSIFTFisher.scala:48-76)
+    if config.pca_file is not None:
+        pca_featurizer = sift_extractor >> BatchPCATransformer(
+            np.loadtxt(config.pca_file, delimiter=",", ndmin=2).T) >> Cacher()
+    else:
+        pca_sample = (sift_extractor >> ColumnSampler(pca_samples_per_image))(
+            training_data)
+        pca_featurizer = sift_extractor.and_then(
+            ColumnPCAEstimator(config.desc_dim).with_data(pca_sample)
+        ) >> Cacher()
 
-    gmm_sample = (pca_featurizer >> ColumnSampler(gmm_samples_per_image))(
-        training_data)
-    fisher_featurizer = pca_featurizer.and_then(
-        GMMFisherVectorEstimator(config.vocab_size).with_data(gmm_sample)
-    ) >> FloatToDouble() >> MatrixVectorizer() >> NormalizeRows() \
-        >> SignedHellingerMapper() >> NormalizeRows() >> Cacher()
+    if config.gmm_mean_file is not None:
+        fisher = pca_featurizer >> FisherVector(GaussianMixtureModel.load(
+            config.gmm_mean_file, config.gmm_var_file, config.gmm_wts_file))
+    else:
+        gmm_sample = (pca_featurizer >> ColumnSampler(
+            gmm_samples_per_image))(training_data)
+        fisher = pca_featurizer.and_then(
+            GMMFisherVectorEstimator(config.vocab_size).with_data(gmm_sample))
+    fisher_featurizer = fisher >> FloatToDouble() >> MatrixVectorizer() \
+        >> NormalizeRows() >> SignedHellingerMapper() >> NormalizeRows() \
+        >> Cacher()
 
     predictor = fisher_featurizer.and_then(
         BlockLeastSquaresEstimator(config.block_size, 1, config.lam),
@@ -124,10 +144,14 @@ def main(argv=None):
     p.add_argument("--scaleStep", type=int, default=0)
     p.add_argument("--numPcaSamples", type=int, default=1_000_000)
     p.add_argument("--numGmmSamples", type=int, default=1_000_000)
+    for flag in ("pcaFile", "gmmMeanFile", "gmmVarFile", "gmmWtsFile"):
+        p.add_argument("--" + flag, default=None)
     a = p.parse_args(argv)
     run(SIFTFisherConfig(
         a.trainLocation, a.testLocation, a.labelPath, a.lam, a.descDim,
-        a.vocabSize, a.scaleStep, a.numPcaSamples, a.numGmmSamples))
+        a.vocabSize, a.scaleStep, a.numPcaSamples, a.numGmmSamples,
+        pca_file=a.pcaFile, gmm_mean_file=a.gmmMeanFile,
+        gmm_var_file=a.gmmVarFile, gmm_wts_file=a.gmmWtsFile))
 
 
 if __name__ == "__main__":

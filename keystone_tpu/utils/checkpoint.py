@@ -40,6 +40,15 @@ def load_pipeline(path: str) -> FittedPipeline:
     return out
 
 
+def save_pca_csv(pca_mat: np.ndarray, path: str) -> None:
+    """Write a PCA projection as the CSV artifact the ImageNet/VOC apps'
+    ``pca_file`` options read (reference ImageNetSiftLcsFV.scala:46-48
+    loads with ``csvread(file).t``): the file holds the TRANSPOSED
+    (k, d) matrix; loading transposes back to the (d, k) ``pca_mat``
+    that ``BatchPCATransformer`` applies."""
+    np.savetxt(path, np.asarray(pca_mat).T, delimiter=",")
+
+
 def save_state(path: str) -> int:
     """Persist the fitted-transformer entries of the global prefix table;
     returns the number of entries saved. (Dataset-valued entries are

@@ -117,3 +117,59 @@ def test_imagenet_sift_lcs_fv_pipeline(mesh8):
     _, err = run(cfg, train=train, test=train, num_classes=2, top_k=1,
                  sift_kwargs=dict(step=12, num_scales=2))
     assert np.isfinite(err)
+
+
+def test_voc_pca_gmm_csv_preload_skips_refit(mesh8, tmp_path, monkeypatch):
+    """VERDICT r3 missing #2 (reference VOCSIFTFisher.scala:50-76): fit
+    once, save the PCA/GMM as CSV artifacts, rerun with the files wired
+    — the estimators must never fit again and the APs must match."""
+    from keystone_tpu.nodes.images.fisher_vector import FisherVector
+    from keystone_tpu.nodes.learning.pca import BatchPCATransformer
+    from keystone_tpu.pipelines.images.voc import voc_sift_fisher as app
+    from keystone_tpu.utils.checkpoint import save_pca_csv
+    from keystone_tpu.workflow.env import PipelineEnv
+    from keystone_tpu.workflow.expression import TransformerExpression
+
+    imgs = _toy_images(8)
+    train = HostDataset([
+        MultiLabeledImage(img, [int(i % 3)], f"im{i}.jpg")
+        for i, img in enumerate(imgs)
+    ])
+    cfg = app.SIFTFisherConfig(
+        lam=0.5, desc_dim=8, vocab_size=2,
+        num_pca_samples=400, num_gmm_samples=400, block_size=256)
+    kw = dict(step=12, num_scales=2)
+    env = PipelineEnv.get_or_create()
+    env.clear_state()
+    _, ap0 = app.run(cfg, train=train, test=train, sift_kwargs=kw)
+
+    # harvest the fitted transformers out of the prefix table
+    pca_mat = gmm = None
+    for expr in env.state.values():
+        if isinstance(expr, TransformerExpression) and expr.computed:
+            node = expr.get()
+            if isinstance(node, BatchPCATransformer):
+                pca_mat = node.pca_mat
+            if isinstance(node, FisherVector):
+                gmm = node.gmm
+    assert pca_mat is not None and gmm is not None
+
+    paths = {k: str(tmp_path / f"{k}.csv")
+             for k in ("pca", "mean", "var", "wts")}
+    save_pca_csv(pca_mat, paths["pca"])
+    gmm.save(paths["mean"], paths["var"], paths["wts"])
+
+    env.clear_state()
+
+    def _no_fit(self, *a, **k):  # any refit is the bug
+        raise AssertionError("estimator fit despite preloaded artifacts")
+
+    monkeypatch.setattr(app.ColumnPCAEstimator, "fit_datasets", _no_fit)
+    monkeypatch.setattr(app.GMMFisherVectorEstimator, "fit_datasets", _no_fit)
+    cfg2 = app.SIFTFisherConfig(
+        lam=0.5, desc_dim=8, vocab_size=2,
+        num_pca_samples=400, num_gmm_samples=400, block_size=256,
+        pca_file=paths["pca"], gmm_mean_file=paths["mean"],
+        gmm_var_file=paths["var"], gmm_wts_file=paths["wts"])
+    _, ap1 = app.run(cfg2, train=train, test=train, sift_kwargs=kw)
+    np.testing.assert_allclose(ap1, ap0, atol=1e-4)
