@@ -1,0 +1,186 @@
+"""Calibrate the auto-solver cost-model weights on THIS chip
+(VERDICT r3 next#2; reference weights were calibrated on 16x EC2
+r3.4xlarge — ``LeastSquaresEstimator.scala:17,26-31`` — and encode a
+2015 CPU-cluster cost surface that has nothing to do with a TPU).
+
+The reference cost form is kept (it is what the solvers' ``cost()``
+methods implement):
+
+    cost = iters * ( max(cpu_w * flops, mem_w * elements_scanned)
+                     + net_w * elements_over_network )
+
+On TPU the three weights have direct hardware meanings:
+
+    cpu_w  = seconds per MXU flop at solver precision (HIGHEST)
+    mem_w  = seconds per f32 element streamed from HBM
+    net_w  = seconds per f32 element over ICI (all-reduce leg)
+
+This tool measures the first two directly (a compute-bound HIGHEST
+Gram for the flop rate; a bandwidth-bound reduction for the stream
+rate), derives the third from the chip generation's published ICI
+bandwidth (not measurable on a single chip; the value only matters
+multi-chip where log2(machines) > 0), then VALIDATES: it times the
+three dense solver options end-to-end at several (n, d) shapes and
+checks the fitted model ranks them like the measurements do.
+
+Data is generated ON DEVICE (the axon tunnel uploads at single-digit
+MB/s) and every timed region ends with a scalar pull (bench.py _fence
+rationale).
+
+Usage: python tools/calibrate_cost_model.py [--small]
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import random
+
+sys.path.insert(0, ".")  # repo root
+
+from keystone_tpu.ops import linalg  # noqa: E402
+from keystone_tpu.parallel.dataset import ArrayDataset  # noqa: E402
+
+SMALL = "--small" in sys.argv
+
+
+def fence(tree):
+    leaves = [x for x in jax.tree_util.tree_leaves(tree)
+              if hasattr(x, "dtype")]
+    float(sum(jnp.sum(x.astype(jnp.float32)) for x in leaves))
+
+
+def timeit(fn, *args, iters=3):
+    fence(fn(*args))  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    fence(out)
+    return (time.perf_counter() - t0) / iters
+
+
+# -- primitive rates -------------------------------------------------------
+
+def measure_flop_rate():
+    """Sustained solver-precision (HIGHEST) MXU rate on a Gram at the
+    solver's own shape class — the rate the cpu term of every solver
+    cost model is charged at."""
+    n, d = (8_192, 1_024) if SMALL else (32_768, 4_096)
+    A = random.normal(random.PRNGKey(0), (n, d), jnp.float32)
+    fence(A)
+    dt = timeit(jax.jit(linalg.gram), A)
+    return 2.0 * n * d * d / dt
+
+
+def measure_stream_rate():
+    """Sustained HBM read rate (f32 elements/s) on a bandwidth-bound
+    reduction over a solver-scale operand."""
+    elems = (32 << 20) if SMALL else (128 << 20)  # 512 MB full-size
+    A = random.normal(random.PRNGKey(1), (elems,), jnp.float32)
+    fence(A)
+
+    @jax.jit
+    def scan_sum(x):
+        return jnp.sum(x)
+
+    dt = timeit(scan_sum, A)
+    return elems / dt
+
+
+#: Published per-chip ICI bandwidth by generation (bytes/s, one
+#: direction). Used for net_w only — a single-chip calibration cannot
+#: measure ICI; on one chip every log2(machines) term is zero anyway.
+_ICI_BYTES_PER_S = {
+    "v4": 3 * 2 * 37.5e9,   # 3 links x 75 GB/s bidirectional
+    "v5 lite": 1600e9 / 8 / 2,  # 1600 Gbps total, half per direction
+    "v5": 4800e9 / 8 / 2,
+    "v6": 4 * 2 * 56.0e9,
+}
+
+
+def derive_net_weight():
+    kind = jax.devices()[0].device_kind.lower()
+    for tag, rate in _ICI_BYTES_PER_S.items():
+        if tag in kind:
+            return 4.0 / rate  # seconds per f32 element
+    return 4.0 / 100e9
+
+
+# -- end-to-end solver timings --------------------------------------------
+
+def solver_options(lam=0.1):
+    from keystone_tpu.nodes.learning.lbfgs import DenseLBFGSwithL2
+    from keystone_tpu.nodes.learning.linear import (
+        BlockLeastSquaresEstimator,
+        LinearMapEstimator,
+    )
+
+    return [
+        ("dense_lbfgs", DenseLBFGSwithL2(lam=lam, num_iterations=20)),
+        ("block_ls", BlockLeastSquaresEstimator(1000, 3, lam=lam)),
+        ("exact", LinearMapEstimator(lam=lam)),
+    ]
+
+
+def time_solvers(n, d, k=10):
+    X = random.normal(random.PRNGKey(2), (n, d), jnp.float32)
+    Y = random.normal(random.PRNGKey(3), (n, k), jnp.float32)
+    fence((X, Y))
+    ds = ArrayDataset(X, n)
+    labels = ArrayDataset(Y, n)
+    out = {}
+    for name, solver in solver_options():
+        dt = timeit(lambda: solver._fit(ds, labels), iters=2)
+        out[name] = dt
+        print(f"  n={n} d={d} {name:12s} {dt * 1e3:9.1f} ms", flush=True)
+    return out
+
+
+def predicted_ranking(n, d, k, cpu_w, mem_w, net_w):
+    costs = {
+        name: solver.cost(n, d, k, 1.0, 1, cpu_w, mem_w, net_w)
+        for name, solver in solver_options()
+    }
+    return sorted(costs, key=costs.get), costs
+
+
+def main():
+    print(f"device: {jax.devices()[0].device_kind}", flush=True)
+    flop_rate = measure_flop_rate()
+    stream_rate = measure_stream_rate()
+    cpu_w = 1.0 / flop_rate
+    mem_w = 1.0 / stream_rate
+    net_w = derive_net_weight()
+    print(f"MXU rate (HIGHEST gram): {flop_rate / 1e12:.2f} TFLOPS "
+          f"-> cpu_w = {cpu_w:.3e} s/flop", flush=True)
+    print(f"HBM stream rate: {stream_rate * 4 / 1e9:.1f} GB/s "
+          f"-> mem_w = {mem_w:.3e} s/elem", flush=True)
+    print(f"ICI (spec-derived): net_w = {net_w:.3e} s/elem", flush=True)
+
+    shapes = [(65_536, 256), (65_536, 1_024), (32_768, 4_096)]
+    if SMALL:
+        shapes = [(8_192, 256), (8_192, 1_024)]
+    agree = True
+    for n, d in shapes:
+        measured = time_solvers(n, d)
+        m_rank = sorted(measured, key=measured.get)
+        p_rank, p_costs = predicted_ranking(n, d, 10, cpu_w, mem_w, net_w)
+        ok = m_rank[0] == p_rank[0]
+        agree = agree and ok
+        print(f"  -> measured fastest: {m_rank[0]}, model picks: "
+              f"{p_rank[0]}  {'OK' if ok else 'MISMATCH'}", flush=True)
+        print(f"     predicted costs: "
+              + ", ".join(f"{k2}={v:.3f}s" for k2, v in p_costs.items()),
+              flush=True)
+    print()
+    print("ship these as the TPU defaults in "
+          "keystone_tpu/nodes/learning/least_squares.py:", flush=True)
+    print(f"DEFAULT_CPU_WEIGHT = {cpu_w:.3e}", flush=True)
+    print(f"DEFAULT_MEM_WEIGHT = {mem_w:.3e}", flush=True)
+    print(f"DEFAULT_NETWORK_WEIGHT = {net_w:.3e}", flush=True)
+    print(f"model-vs-measurement agreement: {agree}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
