@@ -263,10 +263,19 @@ class RuleBasedPosModel:
 class POSTagger(HostTransformer):
     """words -> :class:`TaggedSequence` (reference ``POSTagger.scala:24-35``,
     which wraps an Epic CRF the same way; any object with
-    ``best_sequence(words)`` plugs in)."""
+    ``best_sequence(words)`` plugs in).
+
+    Default model: the in-tree TRAINED averaged perceptron
+    (``perceptron_pos.py``, held-out 0.9645 token accuracy vs the
+    rule-based stand-in's 0.8392) when its shipped weights are present;
+    the rule-based model otherwise."""
 
     def __init__(self, model=None):
-        self.model = model or RuleBasedPosModel()
+        if model is None:
+            from .perceptron_pos import load_pretrained
+
+            model = load_pretrained() or RuleBasedPosModel()
+        self.model = model
 
     def apply(self, words: Sequence[str]) -> TaggedSequence:
         return self.model.best_sequence(list(words))

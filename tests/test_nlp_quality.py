@@ -40,6 +40,57 @@ def test_pos_tagger_accuracy_floor():
     assert accuracy >= 0.80, f"POS accuracy regressed: {accuracy:.4f}"
 
 
+def test_perceptron_pos_beats_rule_based():
+    """VERDICT r3 next#9: the TRAINED averaged perceptron (shipped
+    weights, trained on the in-tree corpus, evaluated here on the
+    held-out gold sample) must clearly beat the rule-based 0.839.
+    Measured at training time: 0.9645; floor a few points under."""
+    from keystone_tpu.nodes.nlp.perceptron_pos import load_pretrained
+
+    model = load_pretrained()
+    assert model is not None, "shipped pos_perceptron.json.gz missing"
+    total = correct = 0
+    for line in _lines("pos_tagged_sample.txt"):
+        pairs = [t.rsplit("_", 1) for t in line.split()]
+        words = [w for w, _ in pairs]
+        gold = [t for _, t in pairs]
+        pred = model.best_sequence(words).tags
+        assert len(pred) == len(words)
+        total += len(words)
+        correct += sum(g == p for g, p in zip(gold, pred))
+    accuracy = correct / total
+    assert accuracy >= 0.93, f"perceptron POS regressed: {accuracy:.4f}"
+
+
+def test_pos_tagger_default_is_trained_model():
+    """POSTagger() picks the shipped perceptron when present."""
+    from keystone_tpu.nodes.nlp.corenlp import POSTagger
+    from keystone_tpu.nodes.nlp.perceptron_pos import (
+        AveragedPerceptronPosModel,
+    )
+
+    assert isinstance(POSTagger().model, AveragedPerceptronPosModel)
+
+
+def test_perceptron_training_is_reproducible():
+    """train() on the in-tree corpus converges and beats the rule-based
+    model held-out — the shipped artifact is reproducible from source."""
+    from keystone_tpu.nodes.nlp.perceptron_pos import (
+        AveragedPerceptronPosModel,
+        read_tagged_file,
+    )
+
+    train = read_tagged_file(os.path.join(RES, "pos_train_corpus.txt"))
+    heldout = read_tagged_file(os.path.join(RES, "pos_tagged_sample.txt"))
+    model = AveragedPerceptronPosModel.train(train, epochs=8)
+    total = correct = 0
+    for sent in heldout:
+        pred = model.best_sequence([w for w, _ in sent]).tags
+        total += len(sent)
+        correct += sum(g == p for (_, g), p in zip(sent, pred))
+    assert correct / total >= 0.93, correct / total
+
+
 def test_ner_token_f1_floor():
     from keystone_tpu.nodes.nlp.corenlp import RuleBasedNerModel
 
