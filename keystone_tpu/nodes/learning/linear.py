@@ -158,11 +158,14 @@ class LinearMapEstimator(LabelEstimator):
         ds, labels = ensure_array(ds), ensure_array(labels)
         n = ds.n
         X, Y = ds.data, labels.data
-        x_mean = linalg.distributed_mean(X, n)
-        y_mean = linalg.distributed_mean(Y, n)
-        W = _centered_normal_equations(
-            X, Y, x_mean, y_mean, ds.mask, float(self.lam or 0.0)
-        )
+        # ONE dispatch for means + centering + normal equations: the
+        # split form cost three jit round-trips per fit, which dominated
+        # the measured solve time at small d (tools/calibrate_cost_model
+        # finding, round 4) — on the tunneled bench chip 2 extra
+        # dispatches cost more than the d=256 solve itself
+        x_mean, y_mean, W = _means_and_normal_equations(
+            X, Y, ds.mask, jnp.asarray(n, X.dtype),
+            float(self.lam or 0.0))
         return LinearMapper(
             W,
             intercept=y_mean,
@@ -236,6 +239,17 @@ def _centered_normal_equations(X, Y, x_mean, y_mean, mask, lam):
     Xc = (X - x_mean) * m
     Yc = (Y - y_mean) * m
     return linalg.ridge_cho_solve(linalg.gram(Xc), linalg.cross(Xc, Yc), lam)
+
+
+@jax.jit
+def _means_and_normal_equations(X, Y, mask, n, lam):
+    """Column means + centered ridge normal equations as one program
+    (one device dispatch per fit; see ``LinearMapEstimator._fit``)."""
+    m = mask[:, None].astype(X.dtype)
+    x_mean = jnp.sum(X * m, axis=0) / n
+    y_mean = jnp.sum(Y * m, axis=0) / n
+    W = _centered_normal_equations.__wrapped__(X, Y, x_mean, y_mean, mask, lam)
+    return x_mean, y_mean, W
 
 
 @jax.jit
