@@ -266,9 +266,9 @@ class POSTagger(HostTransformer):
     ``best_sequence(words)`` plugs in).
 
     Default model: the in-tree TRAINED averaged perceptron
-    (``perceptron_pos.py``, held-out 0.9645 token accuracy vs the
-    rule-based stand-in's 0.8392) when its shipped weights are present;
-    the rule-based model otherwise."""
+    (``perceptron_pos.py``, shipped-artifact held-out 0.9527 token
+    accuracy vs the rule-based stand-in's 0.8392) when its shipped
+    weights are present; the rule-based model otherwise."""
 
     def __init__(self, model=None):
         if model is None:

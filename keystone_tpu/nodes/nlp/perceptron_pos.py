@@ -13,7 +13,7 @@ and inference need nothing beyond the standard library.
 
 Shipped weights: ``data/pos_perceptron.json.gz``, trained by
 ``tools/train_pos.py`` on the in-tree hand-tagged corpus
-(``tests/resources/pos_train_corpus.txt``, 130 sentences authored for
+(``tests/resources/pos_train_corpus.txt``, 219 sentences authored for
 this purpose) and evaluated on the held-out gold sample
 (``tests/resources/pos_tagged_sample.txt``) — the train/eval split is
 by-file with deliberately divergent vocabulary, so the shipped accuracy

@@ -86,8 +86,14 @@ def compute_pca_fisher_branch(prefix: Pipeline, training_data: Dataset,
     (``pcaFile`` / ``gmmMeanFile`` cases at :46-54 / :57-63). The CSV
     layouts match ``utils.checkpoint.save_pca`` / ``GaussianMixtureModel``:
     the PCA file holds the (k, d) projection (transposed on load, as the
-    reference's ``csvread(...).t``), the GMM files hold (k, d) means and
-    variances and a k-vector of weights."""
+    reference's ``csvread(...).t``), the GMM files hold (d, k) means and
+    variances (``GaussianMixtureModel`` column-per-component layout) and
+    a k-vector of weights."""
+    gmm_files = (gmm_mean_file, gmm_var_file, gmm_wts_file)
+    if any(f is not None for f in gmm_files) and None in gmm_files:
+        raise ValueError(
+            "GMM preload needs all three files (mean, var, wts); got "
+            f"mean={gmm_mean_file!r} var={gmm_var_file!r} wts={gmm_wts_file!r}")
     if pca_file is not None:
         pca_branch = prefix >> BatchPCATransformer(
             np.loadtxt(pca_file, delimiter=",", ndmin=2).T)

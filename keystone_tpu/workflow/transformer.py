@@ -41,6 +41,15 @@ def clear_jit_cache() -> None:
     _JIT_CACHE.clear()
 
 
+def _is_host_scalar(leaf):
+    # np.generic AND 0-d np.ndarray (np.array(x)): both are 0-d host
+    # values a node may legitimately store as config, and both would
+    # otherwise ride into the hot shared program as retained ndarrays
+    # (ADVICE r3 + r4).
+    return isinstance(leaf, np.generic) or (
+        isinstance(leaf, np.ndarray) and leaf.ndim == 0)
+
+
 def config_shim(node: "Transformer") -> "Transformer":
     """Array-free clone for closure capture by struct-keyed cached
     programs: the cached entry is hot (shared by every refit by design),
@@ -64,13 +73,13 @@ def config_shim(node: "Transformer") -> "Transformer":
             # value into the hot shared program — the loud AttributeError
             # is the correct failure for a contract violation.
             continue
-        if any(isinstance(leaf, np.generic) for leaf in leaves):
+        if any(_is_host_scalar(leaf) for leaf in leaves):
             # 0-d HOST numpy scalars ARE config (e.g. np.float32 alpha
             # from a constructor); dropping them breaks apply_with_params
             # at trace time far from the construction site (ADVICE r3).
             # Coerce to Python scalars so the shim stays array-free.
             v = jax.tree_util.tree_map(
-                lambda leaf: leaf.item() if isinstance(leaf, np.generic) else leaf, v)
+                lambda leaf: leaf.item() if _is_host_scalar(leaf) else leaf, v)
         shim.__dict__[k] = v
     return shim
 

@@ -387,8 +387,10 @@ def test_config_shim_keeps_scalar_config():
     node.names = ("a", "b")                # plain config survives
     import jax.numpy as jnp
     node.learned_scale = jnp.float32(2.0).reshape(())  # 0-d DEVICE array: fitted, must drop
+    node.beta = np.array(1.5, dtype=np.float64)  # 0-d HOST ndarray config (ADVICE r4)
     shim = config_shim(node)
     assert shim.alpha == 0.25 and isinstance(shim.alpha, float)
+    assert shim.beta == 1.5 and isinstance(shim.beta, float)
     assert shim.names == ("a", "b")
     assert not hasattr(shim, "learned_scale")
     assert not hasattr(shim, "weights") or getattr(
