@@ -101,78 +101,116 @@ def _keypoint_grid(dim: int, lo: int, hi: int, step: int,
     return first + step * np.arange(count, dtype=np.float64)
 
 
+@functools.lru_cache(maxsize=128)
+def _smooth_band(length: int, bin_size: int) -> np.ndarray:
+    """(L, L) band matrix applying the edge-padded Gaussian along one
+    axis. Expressing the smoothing as a dense matmul instead of a
+    1-channel ``conv_general_dilated`` moves it from the VPU onto the
+    MXU — the r5 per-stage profile (tools/profile_imagenet.py) showed
+    the five per-scale smoothing convs were the single largest stage
+    (~50%) of ImageNet featurization."""
+    k = gaussian_kernel(bin_size / MAGNIF).astype(np.float64)
+    r = (len(k) - 1) // 2
+    G = np.zeros((length, length), np.float64)
+    rows = np.arange(length)
+    for t, w in enumerate(k):
+        cols = np.clip(rows + t - r, 0, length - 1)
+        np.add.at(G, (rows, cols), w)
+    return G.astype(np.float32)
+
+
+@functools.lru_cache(maxsize=128)
+def _sampling_operator(length: int, lo: int, step: int,
+                       bin_size: int) -> Tuple[np.ndarray, int]:
+    """(NBP*n, L) operator folding, along one axis, the triangle
+    (bilinear spatial binning) convolution, the shared fractional
+    offset of the regular keypoint grid, and the strided descriptor
+    sampling into ONE band matrix:
+
+        row (b, i) of T = the weights producing spatial-bin b of the
+        descriptor centered at keypoint i.
+
+    ``T_y @ omaps @ T_x.T`` then yields every spatial bin of every
+    descriptor as two MXU matmuls, replacing the depthwise triangle
+    convs + 16 strided slices of the previous implementation (which the
+    r5 profile measured at ~45% of featurization time combined)."""
+    extent = float(bin_size * NBP)
+    centers = _keypoint_grid(length, lo, length - 1, step, extent)
+    offs = (np.arange(NBP) - (NBP - 1) / 2.0) * bin_size
+    n = len(centers)
+    if n == 0:
+        return np.zeros((0, length), np.float32), 0
+    tri = _triangle_kernel(bin_size).astype(np.float64)
+    r = bin_size - 1
+    frac = float((centers[0] + offs[0]) % 1.0)
+    shifts = [(0, 1.0)] if frac == 0.0 else [(0, 1.0 - frac), (1, frac)]
+    T = np.zeros((NBP * n, length), np.float64)
+    idx = np.arange(n)
+    for b, off in enumerate(offs):
+        p0 = int(math.floor(centers[0] + off))
+        pos = p0 + idx * step                      # integer sample rows
+        for ds, w in shifts:
+            q = np.minimum(pos + ds, length - 1)
+            for t, tw in enumerate(tri):
+                cols = np.clip(q + t - r, 0, length - 1)
+                np.add.at(T, (b * n + idx, cols), w * tw)
+    return T.astype(np.float32), n
+
+
+#: Band-matmul precision. HIGH (3-pass bf16 ≈ f32) measured 577 img/s
+#: vs HIGHEST's 412 on the 480x640 rehearsal batch; quantized
+#: descriptors stay within the golden test's envelope either way (CPU
+#: tests ignore the flag and run exact f32).
+_PRECISION = jax.lax.Precision.HIGH
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("height", "width", "step", "bin_size", "lo"),
 )
 def _dsift_one_scale(img, height, width, step, bin_size, lo):
-    """Dense SIFT at one scale. Returns (numDesc, 128) unnormalized
-    descriptors sampled from triangle-smoothed orientation maps."""
-    sigma = bin_size / MAGNIF
-    smoothed = _sep_conv2d(img, gaussian_kernel(sigma))
-    omaps = _orientation_maps(smoothed)  # (8, H, W)
-    tri = _triangle_kernel(bin_size)
-    # depthwise separable triangle smoothing of each orientation map:
-    # after this, omaps[o, y, x] = sum of magnitudes around (y, x)
-    # weighted bilinearly — i.e. the value of a spatial bin centered there
-    sm = jax.vmap(lambda m: _sep_conv2d(m, tri))(omaps)
+    """Dense SIFT at one scale. Returns (128, numDesc) NORMALIZED,
+    quantized descriptors. All heavy lifting is band-matrix matmuls
+    (MXU): smoothing via ``_smooth_band``, spatial binning + sampling
+    via ``_sampling_operator``; normalization runs in the binned
+    layout so no (N, 128) round-trip transpose is materialized."""
+    Gy = jnp.asarray(_smooth_band(height, bin_size))
+    Gx = jnp.asarray(_smooth_band(width, bin_size))
+    smoothed = jnp.einsum("ih,hw,jw->ij", Gy, img, Gx,
+                          precision=_PRECISION)
+    omaps = _orientation_maps(smoothed)            # (8, H, W)
 
-    extent = float(bin_size * NBP)
-    ys = _keypoint_grid(height, lo, height - 1, step, extent)
-    xs = _keypoint_grid(width, lo, width - 1, step, extent)
-    # bin centers relative to descriptor center: (-1.5, -0.5, .5, 1.5)*bin
-    offs = (np.arange(NBP) - (NBP - 1) / 2.0) * bin_size
-
-    ny, nx = len(ys), len(xs)
+    Ty, ny = _sampling_operator(height, lo, step, bin_size)
+    Tx, nx = _sampling_operator(width, lo, step, bin_size)
     if ny == 0 or nx == 0:
-        return jnp.zeros((0, DIMS), sm.dtype)
-
-    # The keypoint grid is regular with an integer step, and the bin
-    # offsets differ by whole multiples of bin_size — so every sample
-    # coordinate shares ONE fractional part per axis (0 for even bin
-    # sizes, 0.5 for odd). One half-pixel pre-interpolation of the maps
-    # then reduces "bilinear sampling" to integer strided slices, which
-    # XLA lowers to cheap copies instead of the 4-gather-per-bin path
-    # (gathers are the TPU-hostile op here: 16 bins x 4 gathers x
-    # num_scales per image).
-    fy = float((ys[0] + offs[0]) % 1.0)
-    fx = float((xs[0] + offs[0]) % 1.0)
-    m = sm
-    if fy > 0.0:
-        m = (1.0 - fy) * m + fy * jnp.concatenate(
-            [m[:, 1:, :], m[:, -1:, :]], axis=1)
-    if fx > 0.0:
-        m = (1.0 - fx) * m + fx * jnp.concatenate(
-            [m[:, :, 1:], m[:, :, -1:]], axis=2)
-
-    descs = []
-    for by in offs:
-        y0 = int(math.floor(ys[0] + by))
-        for bx in offs:
-            x0 = int(math.floor(xs[0] + bx))
-            block = jax.lax.slice(
-                m,
-                (0, y0, x0),
-                (NBO, y0 + (ny - 1) * step + 1, x0 + (nx - 1) * step + 1),
-                (1, step, step),
-            )  # (8, ny, nx)
-            descs.append(block.reshape(NBO, ny * nx).T)  # (N, 8)
-    return jnp.concatenate(descs, axis=1)  # (N, 128)
+        return jnp.zeros((DIMS, 0), smoothed.dtype)
+    # (8, NBP*ny, NBP*nx): spatial bin (by, bx) of descriptor (iy, ix)
+    bins = jnp.einsum("ph,ohw,qw->opq", jnp.asarray(Ty), omaps,
+                      jnp.asarray(Tx), precision=_PRECISION)
+    return _normalize_quantize_binned(
+        bins.reshape(NBO, NBP, ny, NBP, nx))
 
 
-def _normalize_quantize(desc: jax.Array) -> jax.Array:
-    """L2 normalize, clamp 0.2, renormalize; zero low-contrast
-    descriptors; quantize to min(512 v, 255) (reference VLFeat.cxx JNI
-    body + ``vl_dsift`` normalization)."""
-    norm = jnp.linalg.norm(desc, axis=1, keepdims=True)
-    safe = jnp.maximum(norm, 1e-12)
-    d = jnp.minimum(desc / safe, 0.2)
-    norm2 = jnp.maximum(jnp.linalg.norm(d, axis=1, keepdims=True), 1e-12)
-    d = d / norm2
-    # contrast threshold on the pre-normalization norm (keypoint.norm)
-    area = NBP * NBP  # vl_dsift norms are per unit bin mass
-    d = jnp.where(norm / area < CONTRAST_THRESHOLD, 0.0, d)
-    return jnp.minimum(512.0 * d, 255.0)
+def _normalize_quantize_binned(b5: jax.Array) -> jax.Array:
+    """SIFT normalization (L2 normalize, clamp 0.2, renormalize; zero
+    descriptors whose pre-normalization norm per unit bin mass is under
+    the contrast threshold; quantize to min(512 v, 255) — reference
+    VLFeat.cxx JNI body + ``vl_dsift``), applied in the native
+    (o, by, ny, bx, nx) layout of the sampling matmul and emitting the
+    final (128, ny*nx) column-per-descriptor matrix directly — one
+    output transpose instead of materializing (N, 128) and transposing
+    back (the r5 profile's 'norm' stage was pure relayout cost)."""
+    _, _, ny, _, nx = b5.shape
+    norm = jnp.sqrt(jnp.sum(b5 * b5, axis=(0, 1, 3)))      # (ny, nx)
+    bcast = (None, None, slice(None), None, slice(None))
+    d = jnp.minimum(b5 / jnp.maximum(norm, 1e-12)[bcast], 0.2)
+    norm2 = jnp.maximum(jnp.sqrt(jnp.sum(d * d, axis=(0, 1, 3))), 1e-12)
+    d = d / norm2[bcast]
+    area = NBP * NBP
+    d = jnp.where((norm / area < CONTRAST_THRESHOLD)[bcast], 0.0, d)
+    d = jnp.minimum(512.0 * d, 255.0)
+    # (by, bx, o)-major 128-dim layout, descriptors column-major
+    return d.transpose(1, 3, 0, 2, 4).reshape(DIMS, ny * nx)
 
 
 def _scale_params(scale: int, step: int, bin_size: int, num_scales: int,
@@ -202,10 +240,9 @@ def dense_sift(
     for scale in range(num_scales):
         s, scale_value, lo = _scale_params(
             scale, step, bin_size, num_scales, scale_step)
-        desc = _dsift_one_scale(
-            img_gray, height, width, s, scale_value, lo)
-        outs.append(_normalize_quantize(desc))
-    return jnp.concatenate(outs, axis=0).T  # (128, N)
+        outs.append(_dsift_one_scale(
+            img_gray, height, width, s, scale_value, lo))
+    return jnp.concatenate(outs, axis=1)  # (128, N)
 
 
 def sift_descriptor_count(

@@ -1,0 +1,64 @@
+"""Shared measurement helpers for the tools/ scripts.
+
+The axon tunnel's ``block_until_ready`` can return BEFORE device
+execution completes, so every timed region must end with a small data
+pull; and fitted models are NOT registered pytrees, so finding their
+device arrays requires walking object attributes, not tree leaves.
+Both gotchas live here once (ADVICE r4 medium + the r5 review).
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def device_arrays(obj, _seen=None):
+    """Collect arrays reachable from ``obj``, recursing into plain
+    containers AND object attributes (fitted models hand ``tree_leaves``
+    the model object itself)."""
+    if _seen is None:
+        _seen = set()
+    if id(obj) in _seen:
+        return []
+    _seen.add(id(obj))
+    if hasattr(obj, "dtype") and hasattr(obj, "shape"):
+        return [obj]
+    out = []
+    if isinstance(obj, dict):
+        vals = obj.values()
+    elif isinstance(obj, (list, tuple)):
+        vals = obj
+    elif hasattr(obj, "__dict__"):
+        vals = vars(obj).values()
+    else:
+        return out
+    for v in vals:
+        out.extend(device_arrays(v, _seen))
+    return out
+
+
+def fence(tree):
+    """Force completion of everything producing ``tree``. Only DEVICE
+    arrays are pulled — ``jnp.asarray`` on a host ndarray would upload
+    it through the ~5-10 MB/s tunnel inside the timed window. ONE
+    combined scalar pull: its value depends on every input buffer, so
+    one tunnel round trip forces all producing computations."""
+    arrays = []
+    for leaf in jax.tree_util.tree_leaves(tree):
+        arrays.extend(a for a in device_arrays(leaf)
+                      if isinstance(a, jax.Array))
+    if not arrays:
+        return
+    float(sum(jnp.sum(a.ravel()[:1].astype(jnp.float32)) for a in arrays))
+
+
+def timeit(fn, *args, iters=3):
+    """Mean seconds per call over ``iters`` back-to-back dispatches
+    (pipelined — one fence at the end, matching how production streams
+    work onto the chip)."""
+    fence(fn(*args))  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    fence(out)
+    return (time.perf_counter() - t0) / iters

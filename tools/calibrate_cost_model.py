@@ -30,7 +30,6 @@ rationale).
 Usage: python tools/calibrate_cost_model.py [--small]
 """
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
@@ -45,58 +44,8 @@ from keystone_tpu.parallel.dataset import ArrayDataset  # noqa: E402
 SMALL = "--small" in sys.argv
 
 
-def _device_arrays(obj, _seen=None):
-    """Collect device arrays reachable from ``obj``, recursing into
-    plain containers AND object attributes. Fitted models returned by
-    solver ``_fit`` are NOT registered pytrees — ``tree_leaves`` hands
-    back the model object itself — so a dtype-filtered tree walk would
-    silently fence nothing and the solver timings would measure
-    dispatch latency, not the solve (ADVICE r4, medium)."""
-    if _seen is None:
-        _seen = set()
-    if id(obj) in _seen:
-        return []
-    _seen.add(id(obj))
-    if hasattr(obj, "dtype") and hasattr(obj, "shape"):
-        return [obj]
-    out = []
-    if isinstance(obj, dict):
-        vals = obj.values()
-    elif isinstance(obj, (list, tuple)):
-        vals = obj
-    elif hasattr(obj, "__dict__"):
-        vals = vars(obj).values()
-    else:
-        return out
-    for v in vals:
-        out.extend(_device_arrays(v, _seen))
-    return out
-
-
-def fence(tree):
-    # Only DEVICE arrays need fencing — jnp.asarray on a host ndarray
-    # would upload it through the ~5-10 MB/s tunnel inside the timed
-    # window, distorting the measurement the other way.
-    arrays = []
-    for leaf in jax.tree_util.tree_leaves(tree):
-        arrays.extend(a for a in _device_arrays(leaf)
-                      if isinstance(a, jax.Array))
-    if not arrays:
-        return
-    # axon tunnel: block_until_ready can return before execution
-    # completes — force a data pull instead. ONE combined scalar pull:
-    # its value depends on every input buffer, so one tunnel round trip
-    # forces all producing computations.
-    float(sum(jnp.sum(a.ravel()[:1].astype(jnp.float32)) for a in arrays))
-
-
-def timeit(fn, *args, iters=3):
-    fence(fn(*args))  # compile + warm
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    fence(out)
-    return (time.perf_counter() - t0) / iters
+from tools._bench import device_arrays as _device_arrays  # noqa: E402,F401
+from tools._bench import fence, timeit  # noqa: E402
 
 
 # -- primitive rates -------------------------------------------------------

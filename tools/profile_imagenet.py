@@ -1,0 +1,164 @@
+"""Per-stage profile of the ImageNet SIFT/LCS/FV featurization path
+(VERDICT r4 next#1: "publish a per-stage profile, then attack the
+dominant stage").
+
+Method: the stages run fused inside one jit in production, so timing
+them one jit per stage would charge each stage the ~18-20 ms axon
+dispatch floor. Instead this times CUMULATIVE PREFIXES of the pipeline
+(smooth; +orient; +sample; +norm; +PCA; +FV), each as one jitted
+program over the same image batch, and reports adjacent differences —
+the floor and the shared input staging cancel.
+
+Stages (per scale s: bin = bin_size + 2s, step = step + s*scale_step),
+as implemented by the band-matmul kernel in ``keystone_tpu/ops/sift.py``:
+  smooth    Gaussian blur as band matmuls          (MXU)
+  orient    gradient -> 8 soft-assigned magnitude maps
+  sample    triangle binning + frac shift + strided sampling,
+            folded into T_y @ omaps @ T_x^T        (MXU)
+  norm      L2-clamp-renorm-quantize in the binned layout
+  pca       signed Hellinger + 64x128 projection
+  fv        GMM posteriors + s0/s1/s2 moments -> 2048-dim FV
+
+Host-side (tar decode, grayscale) is profiled separately by the loader
+bench (`bench.py --loader`); LCS is timed whole (it is one box-filter
+program).
+
+Usage: python tools/profile_imagenet.py [--small] [--images N]
+"""
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from keystone_tpu.ops import sift as S  # noqa: E402
+
+SMALL = "--small" in sys.argv
+N_IMGS = int(sys.argv[sys.argv.index("--images") + 1]) \
+    if "--images" in sys.argv else (4 if SMALL else 16)
+H, W = (160, 160) if SMALL else (480, 640)
+STEP, BIN, NSCALES, SSTEP = 4, 6, 5, 1
+DESC_DIM, VOCAB = 64, 16
+
+
+from tools._bench import fence, timeit  # noqa: E402
+
+
+def scale_plan():
+    out = []
+    for sc in range(NSCALES):
+        st, bs, lo = S._scale_params(sc, STEP, BIN, NSCALES, SSTEP)
+        out.append((st, bs, lo))
+    return out
+
+
+def prefix_fn(depth, pca=None, gmm=None):
+    """Build the featurizer truncated after `depth` stages (1=smooth ...
+    7=fv). Returns a per-image function for vmap."""
+    plan = scale_plan()
+
+    def one(img):
+        per_scale = []
+        for st, bs, lo in plan:
+            Gy = jnp.asarray(S._smooth_band(H, bs))
+            Gx = jnp.asarray(S._smooth_band(W, bs))
+            sm = jnp.einsum("ih,hw,jw->ij", Gy, img, Gx, precision=S._PRECISION)
+            if depth == 1:
+                per_scale.append(jnp.sum(sm))
+                continue
+            om = S._orientation_maps(sm)
+            if depth == 2:
+                per_scale.append(jnp.sum(om))
+                continue
+            Ty, ny = S._sampling_operator(H, lo, st, bs)
+            Tx, nx = S._sampling_operator(W, lo, st, bs)
+            bins = jnp.einsum("ph,ohw,qw->opq", jnp.asarray(Ty), om,
+                              jnp.asarray(Tx), precision=S._PRECISION)
+            if depth == 3:
+                per_scale.append(jnp.sum(bins))
+                continue
+            per_scale.append(S._normalize_quantize_binned(
+                bins.reshape(S.NBO, S.NBP, ny, S.NBP, nx)))
+        if depth <= 3:
+            return jnp.stack(per_scale).sum()
+        desc = jnp.concatenate(per_scale, axis=1)     # (128, N)
+        if depth == 4:
+            return desc
+        desc = jnp.sign(desc) * jnp.sqrt(jnp.abs(desc))
+        proj = pca @ desc                             # (64, N)
+        if depth == 5:
+            return proj
+        from keystone_tpu.nodes.images.fisher_vector import _fisher_vector
+        out = _fisher_vector(proj, *gmm, 1e-2).reshape(-1)
+        out = out / jnp.maximum(jnp.linalg.norm(out), 2.2e-16)
+        out = jnp.sign(out) * jnp.sqrt(jnp.abs(out))
+        return out / jnp.maximum(jnp.linalg.norm(out), 2.2e-16)
+
+    return one
+
+
+def main():
+    print(f"device: {jax.devices()[0].device_kind}; batch {N_IMGS} "
+          f"{H}x{W}, step {STEP} bin {BIN} scales {NSCALES}(+{SSTEP})",
+          flush=True)
+    rng = np.random.RandomState(0)
+    imgs = jax.device_put(rng.rand(N_IMGS, H, W).astype(np.float32))
+    fence(imgs)
+    pca = jax.device_put(rng.randn(DESC_DIM, 128).astype(np.float32) / 11.3)
+    gmm = tuple(jax.device_put(a) for a in (
+        rng.randn(DESC_DIM, VOCAB).astype(np.float32),
+        (0.5 + rng.rand(DESC_DIM, VOCAB)).astype(np.float32),
+        (np.ones(VOCAB) / VOCAB).astype(np.float32)))
+
+    names = ["smooth", "orient", "sample", "norm", "pca", "fv"]
+    cum = []
+    for depth in range(1, 7):
+        fn = jax.jit(jax.vmap(prefix_fn(depth, pca, gmm)))
+        dt = timeit(fn, imgs)
+        cum.append(dt)
+        stage_ms = 1e3 * (dt - (cum[-2] if len(cum) > 1 else 0.0))
+        print(f"  prefix {depth} (+{names[depth-1]:9s}): "
+              f"{1e3 * dt:8.1f} ms cum  | +{stage_ms:7.1f} ms", flush=True)
+
+    total = cum[-1]
+    print(f"full featurize: {1e3 * total / N_IMGS:.2f} ms/img "
+          f"= {N_IMGS / total:.1f} img/s/chip", flush=True)
+
+    # LCS branch, timed whole
+    from keystone_tpu.nodes.images.extractors import LCSExtractor
+    lcs = LCSExtractor()
+    imgs_rgb = jax.device_put(
+        rng.rand(N_IMGS, H, W, 3).astype(np.float32))
+    fence(imgs_rgb)
+    lcs_fn = jax.jit(jax.vmap(lcs.apply))
+    dt = timeit(lcs_fn, imgs_rgb)
+    print(f"LCS whole: {1e3 * dt / N_IMGS:.2f} ms/img "
+          f"= {N_IMGS / dt:.1f} img/s/chip", flush=True)
+
+    # parity: prefix-6 must match the production featurizer
+    from keystone_tpu.nodes.images.extractors import SIFTExtractor
+    from keystone_tpu.nodes.images.fisher_vector import _fisher_vector
+    sx = SIFTExtractor(step=STEP, bin_size=BIN, num_scales=NSCALES,
+                       scale_step=SSTEP)
+
+    def prod(img):
+        d = sx.apply(img)
+        d = jnp.sign(d) * jnp.sqrt(jnp.abs(d))
+        p = pca @ d
+        out = _fisher_vector(p, *gmm, 1e-2).reshape(-1)
+        out = out / jnp.maximum(jnp.linalg.norm(out), 2.2e-16)
+        out = jnp.sign(out) * jnp.sqrt(jnp.abs(out))
+        return out / jnp.maximum(jnp.linalg.norm(out), 2.2e-16)
+
+    a = np.asarray(jax.jit(jax.vmap(prefix_fn(6, pca, gmm)))(imgs[:2]))
+    b = np.asarray(jax.jit(jax.vmap(prod))(imgs[:2]))
+    err = float(np.max(np.abs(a - b)))
+    print(f"parity prefix-6 vs production: max abs delta {err:.2e}",
+          flush=True)
+    assert err < 1e-4, err
+
+
+if __name__ == "__main__":
+    main()
