@@ -79,13 +79,19 @@ class DenseLBFGSwithL2(LabelEstimator):
             )
         return LinearMapper(np.asarray(W))
 
-    def cost(self, n, d, k, sparsity, num_machines, cpu_w, mem_w, net_w) -> float:
-        """Reference cost model (LBFGS.scala:175-191)."""
+    def cost(self, n, d, k, sparsity, num_machines, cpu_w, mem_w, net_w,
+             lat_w=0.0) -> float:
+        """Reference cost model (LBFGS.scala:175-191) plus the TPU
+        dispatch-latency term: L-BFGS is inherently iterative, one
+        serial device round per iteration (measured ~375 ms fixed cost
+        for 20 iterations at tiny compute on the axon chip, r5
+        calibration). ``lat_w=0`` reproduces the reference surface."""
         flops = n * d * k / num_machines
         bytes_scanned = n * d / num_machines
         network = 2.0 * d * k * np.log2(max(num_machines, 1))
         return self.num_iterations * (
             max(cpu_w * flops, mem_w * bytes_scanned) + net_w * network
+            + lat_w
         )
 
 
@@ -183,14 +189,18 @@ class SparseLBFGSwithL2(LabelEstimator):
             return SparseLinearMapper(W[:-1], intercept=W[-1])
         return SparseLinearMapper(W)
 
-    def cost(self, n, d, k, sparsity, num_machines, cpu_w, mem_w, net_w) -> float:
-        """Reference cost model (LBFGS.scala:264-280)."""
+    def cost(self, n, d, k, sparsity, num_machines, cpu_w, mem_w, net_w,
+             lat_w=0.0) -> float:
+        """Reference cost model (LBFGS.scala:264-280) plus the TPU
+        dispatch-latency term (one serial round per iteration; see
+        ``DenseLBFGSwithL2.cost``)."""
         flops = n * sparsity * d * k / num_machines
         bytes_scanned = n * d * sparsity / num_machines
         network = 2.0 * d * k * np.log2(max(num_machines, 1))
         return self.num_iterations * (
             self.sparse_overhead * max(cpu_w * flops, mem_w * bytes_scanned)
             + net_w * network
+            + lat_w
         )
 
 

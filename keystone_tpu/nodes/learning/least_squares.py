@@ -6,10 +6,22 @@ Sparsify -> SparseLBFGS, Densify -> BlockLeastSquares(1000, 3), and
 Densify -> exact normal equations by evaluating each solver's cost model
 at the observed workload shape (n, d, k, sparsity, num_machines).
 
-The default weights are the reference's empirical calibration on
-16x r3.4xlarge (``LeastSquaresEstimator.scala:17,26-31``); on TPU the
-cost terms are reinterpreted as MXU-flops / HBM-bytes / ICI-bytes per
-chip, and the constructor accepts recalibrated weights.
+The DEFAULT weights are TPU-calibrated on the bench chip (r5,
+``tools/calibrate_cost_model.py``): seconds per solver-precision MXU
+flop (floor-cancelled HIGHEST-gram rate), seconds per f32 element
+streamed from HBM (floor-cancelled reduction), seconds per f32 element
+over ICI (spec-derived; only matters multi-chip), and — the TPU-first
+extension — seconds per serial device dispatch round (``lat_w``). The
+latency term exists because on TPU the compute terms alone mis-rank
+every small-d solve: measured end-to-end, BlockLS(1000,3) beats the
+exact solver at (65536, 256) 38 ms vs 198 ms purely on dispatch
+structure (the scan-based BCD is ONE program; the exact path is ~10
+serial rounds), which no (cpu, mem) pair can express.
+
+The reference's empirical calibration on 16x r3.4xlarge
+(``LeastSquaresEstimator.scala:17,26-31``) is kept as
+``REFERENCE_EC2_WEIGHTS`` for parity experiments; with those weights
+and ``lat_weight=0`` the choice surface is the reference's exactly.
 """
 from __future__ import annotations
 
@@ -24,9 +36,29 @@ from ..util.sparse import SparseVector, Sparsify
 from .lbfgs import DenseLBFGSwithL2, SparseLBFGSwithL2
 from .linear import BlockLeastSquaresEstimator, LinearMapEstimator
 
-DEFAULT_CPU_WEIGHT = 3.8e-4
-DEFAULT_MEM_WEIGHT = 2.9e-1
-DEFAULT_NETWORK_WEIGHT = 1.32
+#: TPU-calibrated (r5 bench chip, TPU v5 lite behind the axon tunnel;
+#: ship block printed by ``python tools/calibrate_cost_model.py``,
+#: 2026-07-31, model-vs-measurement agreement 3/3 shapes). cpu:
+#: floor-cancelled HIGHEST-precision gram rate; mem: floor-cancelled
+#: HBM reduction stream; net: ICI spec; lat: measured per-dispatch-
+#: round latency. The tunnel puts real run-to-run variance on the cpu/
+#: mem primitive rates (the ranking is robust to it — the choice
+#: surface at solver shapes is dominated by the lat and mem terms);
+#: re-run the tool on other deployments.
+DEFAULT_CPU_WEIGHT = 5.090e-15
+DEFAULT_MEM_WEIGHT = 3.543e-11
+DEFAULT_NETWORK_WEIGHT = 4.0e-11
+DEFAULT_LAT_WEIGHT = 1.442e-2
+
+#: The reference's EC2 calibration (LeastSquaresEstimator.scala:17,
+#: 26-31) — documented fallback, not the default: it encodes a 2015
+#: CPU-cluster cost surface.
+REFERENCE_EC2_WEIGHTS = {
+    "cpu_weight": 3.8e-4,
+    "mem_weight": 2.9e-1,
+    "network_weight": 1.32,
+    "lat_weight": 0.0,
+}
 
 
 def estimate_sparsity(sample: Dataset) -> float:
@@ -68,6 +100,7 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         mem_weight: float = DEFAULT_MEM_WEIGHT,
         network_weight: float = DEFAULT_NETWORK_WEIGHT,
         num_iterations: int = 20,
+        lat_weight: float = DEFAULT_LAT_WEIGHT,
     ):
         self.lam = lam
         self.num_machines = num_machines
@@ -75,6 +108,7 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         self.mem_weight = mem_weight
         self.network_weight = network_weight
         self.num_iterations = num_iterations
+        self.lat_weight = lat_weight
 
     @property
     def options(self) -> Sequence[Tuple[object, NodeChoice]]:
@@ -119,7 +153,8 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         machines = self.num_machines or num_machines
         costs = [
             (solver.cost(n, d, k, sparsity, machines, self.cpu_weight,
-                         self.mem_weight, self.network_weight), i)
+                         self.mem_weight, self.network_weight,
+                         lat_w=self.lat_weight), i)
             for i, (solver, _) in enumerate(self.options)
         ]
         _, best = min(costs)

@@ -172,12 +172,25 @@ class LinearMapEstimator(LabelEstimator):
             feature_scaler=StandardScalerModel(x_mean),
         )
 
-    def cost(self, n, d, k, sparsity, num_machines, cpu_w, mem_w, net_w) -> float:
-        """Reference cost model (LinearMapper.scala:100-115)."""
+    #: Serial device round-trips per fit (center / gram / factorize /
+    #: solve / intercept plus eigendecomposition host syncs), measured
+    #: shape-independent at ~180 ms on the axon chip (r5 calibration:
+    #: 184/163/198 ms across n=1k..65k at tiny compute).
+    DISPATCH_ROUNDS = 10
+
+    def cost(self, n, d, k, sparsity, num_machines, cpu_w, mem_w, net_w,
+             lat_w=0.0) -> float:
+        """Reference cost model (LinearMapper.scala:100-115) extended
+        with a dispatch-latency term (``lat_w`` seconds per serial
+        device round): on TPU the compute terms alone mis-rank small-d
+        solves, where per-round dispatch latency dominates (r5
+        calibration, tools/calibrate_cost_model.py). ``lat_w=0``
+        reproduces the reference surface exactly."""
         flops = n * d * (d + k) / num_machines
         bytes_scanned = n * d / num_machines + d * d
         network = d * (d + k)
-        return max(cpu_w * flops, mem_w * bytes_scanned) + net_w * network
+        return (max(cpu_w * flops, mem_w * bytes_scanned) + net_w * network
+                + lat_w * self.DISPATCH_ROUNDS)
 
     @staticmethod
     def compute_cost(
@@ -428,14 +441,23 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             list(Ws), bs, intercept=intercept, feature_means=x_mean
         )
 
-    def cost(self, n, d, k, sparsity, num_machines, cpu_w, mem_w, net_w) -> float:
-        """Reference cost model (BlockLinearMapper.scala:268-282)."""
+    #: The scan-based BCD stages the whole multi-pass solve into ONE
+    #: program (ops/linalg.py), so rounds do not scale with
+    #: num_iter x num_blocks: measured ~51-65 ms fixed on the axon chip
+    #: at 1..4 blocks x 3 passes (r5 calibration).
+    DISPATCH_ROUNDS = 3
+
+    def cost(self, n, d, k, sparsity, num_machines, cpu_w, mem_w, net_w,
+             lat_w=0.0) -> float:
+        """Reference cost model (BlockLinearMapper.scala:268-282) plus
+        the TPU dispatch-latency term (see ``LinearMapEstimator.cost``);
+        ``lat_w=0`` reproduces the reference surface exactly."""
         flops = n * d * (self.block_size + k) / num_machines
         bytes_scanned = n * d / num_machines + d * k
         network = 2.0 * (d * (self.block_size + k)) * np.log2(max(num_machines, 1))
         return self.num_iter * (
             max(cpu_w * flops, mem_w * bytes_scanned) + net_w * network
-        )
+        ) + lat_w * self.DISPATCH_ROUNDS
 
     @staticmethod
     def compute_cost(

@@ -101,13 +101,19 @@ class PCAEstimator(Estimator):
     def compute_pca(self, X: np.ndarray) -> np.ndarray:
         return _svd_pca(jnp.asarray(X, jnp.float32), self.dims)
 
-    def cost(self, n, d, k, sparsity, num_machines, cpu_w, mem_w, net_w) -> float:
-        """Reference cost model (PCA.scala:~213-226): all data moves to one
-        machine."""
+    #: gather + one big host SVD: two serial rounds.
+    DISPATCH_ROUNDS = 2
+
+    def cost(self, n, d, k, sparsity, num_machines, cpu_w, mem_w, net_w,
+             lat_w=0.0) -> float:
+        """Reference cost model (PCA.scala:~213-226): all data moves to
+        one machine. ``lat_w`` is the TPU dispatch-latency extension
+        (see ``LinearMapEstimator.cost``); 0 reproduces the reference."""
         flops = n * d * d
         bytes_scanned = n * d
         network = n * d
-        return max(cpu_w * flops, mem_w * bytes_scanned) + net_w * network
+        return (max(cpu_w * flops, mem_w * bytes_scanned) + net_w * network
+                + lat_w * self.DISPATCH_ROUNDS)
 
 
 @jax.jit
@@ -133,13 +139,20 @@ class DistributedPCAEstimator(Estimator):
         pca = enforce_matlab_sign_convention(vt.T.astype(np.float32))
         return PCATransformer(pca[:, : self.dims])
 
-    def cost(self, n, d, k, sparsity, num_machines, cpu_w, mem_w, net_w) -> float:
-        """Reference cost model (DistributedPCA.scala:59-73)."""
+    #: mean + center + device TSQR + small host SVD: four serial rounds.
+    DISPATCH_ROUNDS = 4
+
+    def cost(self, n, d, k, sparsity, num_machines, cpu_w, mem_w, net_w,
+             lat_w=0.0) -> float:
+        """Reference cost model (DistributedPCA.scala:59-73) plus the
+        TPU dispatch-latency term; ``lat_w=0`` reproduces the
+        reference."""
         log2m = np.log2(max(num_machines, 1))
         flops = n * d * d / num_machines + d * d * d * log2m
         bytes_scanned = n * d
         network = d * d * log2m
-        return max(cpu_w * flops, mem_w * bytes_scanned) + net_w * network
+        return (max(cpu_w * flops, mem_w * bytes_scanned) + net_w * network
+                + lat_w * self.DISPATCH_ROUNDS)
 
 
 @functools.partial(jax.jit, static_argnames=("q",))
@@ -217,17 +230,21 @@ class ColumnPCAEstimator(OptimizableEstimator):
     calibrated cost models; until then it runs distributed."""
 
     def __init__(self, dims: int, cpu_weight: float = None,
-                 mem_weight: float = None, network_weight: float = None):
+                 mem_weight: float = None, network_weight: float = None,
+                 lat_weight: float = None):
         from .least_squares import (
-            DEFAULT_CPU_WEIGHT, DEFAULT_MEM_WEIGHT, DEFAULT_NETWORK_WEIGHT)
+            DEFAULT_CPU_WEIGHT, DEFAULT_LAT_WEIGHT, DEFAULT_MEM_WEIGHT,
+            DEFAULT_NETWORK_WEIGHT)
         cpu_weight = DEFAULT_CPU_WEIGHT if cpu_weight is None else cpu_weight
         mem_weight = DEFAULT_MEM_WEIGHT if mem_weight is None else mem_weight
         network_weight = (DEFAULT_NETWORK_WEIGHT if network_weight is None
                           else network_weight)
+        lat_weight = DEFAULT_LAT_WEIGHT if lat_weight is None else lat_weight
         self.dims = dims
         self.cpu_weight = cpu_weight
         self.mem_weight = mem_weight
         self.network_weight = network_weight
+        self.lat_weight = lat_weight
 
     @property
     def options(self):
@@ -249,9 +266,11 @@ class ColumnPCAEstimator(OptimizableEstimator):
         dist = DistributedPCAEstimator(self.dims)
         costs = [
             (local.cost(total_cols, d, self.dims, 1.0, num_machines,
-                        self.cpu_weight, self.mem_weight, self.network_weight), 0),
+                        self.cpu_weight, self.mem_weight,
+                        self.network_weight, lat_w=self.lat_weight), 0),
             (dist.cost(total_cols, d, self.dims, 1.0, num_machines,
-                       self.cpu_weight, self.mem_weight, self.network_weight), 1),
+                       self.cpu_weight, self.mem_weight,
+                       self.network_weight, lat_w=self.lat_weight), 1),
         ]
         _, best = min(costs)
         return NodeChoice(self.options[best])

@@ -13,7 +13,7 @@ and inference need nothing beyond the standard library.
 
 Shipped weights: ``data/pos_perceptron.json.gz``, trained by
 ``tools/train_pos.py`` on the in-tree hand-tagged corpus
-(``tests/resources/pos_train_corpus.txt``, 219 sentences authored for
+(``tests/resources/pos_train_corpus.txt``, 328 sentences authored for
 this purpose) and evaluated on the held-out gold sample
 (``tests/resources/pos_tagged_sample.txt``) — the train/eval split is
 by-file with deliberately divergent vocabulary, so the shipped accuracy
@@ -208,12 +208,18 @@ class AveragedPerceptronPosModel:
         return cls(weights=blob["weights"], tags=blob["tags"])
 
 
+_PRETRAINED_CACHE: List[Optional[AveragedPerceptronPosModel]] = []
+
+
 def load_pretrained() -> Optional[AveragedPerceptronPosModel]:
-    """The shipped trained model, or None when the artifact is absent
-    (callers fall back to the rule-based model)."""
-    if os.path.exists(_DATA_PATH):
-        return AveragedPerceptronPosModel.load()
-    return None
+    """The shipped trained model (process-wide singleton, so identical
+    default pipelines CSE-merge on model identity), or None when the
+    artifact is absent (callers fall back to the rule-based model)."""
+    if not _PRETRAINED_CACHE:
+        _PRETRAINED_CACHE.append(
+            AveragedPerceptronPosModel.load()
+            if os.path.exists(_DATA_PATH) else None)
+    return _PRETRAINED_CACHE[0]
 
 
 def read_tagged_file(path: str) -> List[List[Tuple[str, str]]]:

@@ -52,28 +52,57 @@ from tools._bench import fence, timeit  # noqa: E402
 
 def measure_flop_rate():
     """Sustained solver-precision (HIGHEST) MXU rate on a Gram at the
-    solver's own shape class — the rate the cpu term of every solver
-    cost model is charged at."""
-    n, d = (8_192, 1_024) if SMALL else (32_768, 4_096)
-    A = random.normal(random.PRNGKey(0), (n, d), jnp.float32)
-    fence(A)
-    dt = timeit(jax.jit(linalg.gram), A)
-    return 2.0 * n * d * d / dt
+    solver's own shape class. FLOOR-CANCELLED: the axon tunnel adds
+    ~20 ms of dispatch latency per timed call, which at these shapes is
+    comparable to the compute itself — so the rate is taken from the
+    DIFFERENCE between two row counts, where the per-call latency
+    cancels (r5: the single-shape estimate read 18.5 TFLOPS for a
+    ~40 TFLOPS gram)."""
+    n_small, n_large, d = ((4_096, 16_384, 1_024) if SMALL
+                           else (16_384, 49_152, 4_096))
+    g = jax.jit(linalg.gram)
+    dts = {}
+    for n in (n_small, n_large):
+        A = random.normal(random.PRNGKey(0), (n, d), jnp.float32)
+        fence(A)
+        dts[n] = timeit(g, A)
+    return 2.0 * (n_large - n_small) * d * d / (dts[n_large] - dts[n_small])
 
 
 def measure_stream_rate():
     """Sustained HBM read rate (f32 elements/s) on a bandwidth-bound
-    reduction over a solver-scale operand."""
-    elems = (32 << 20) if SMALL else (128 << 20)  # 512 MB full-size
-    A = random.normal(random.PRNGKey(1), (elems,), jnp.float32)
-    fence(A)
+    reduction — floor-cancelled like the flop rate (the single-size
+    estimate read 12.7 GB/s for a ~2 TB/s stream: pure dispatch
+    floor)."""
+    e_small = (8 << 20) if SMALL else (32 << 20)
+    e_large = (32 << 20) if SMALL else (160 << 20)
 
     @jax.jit
     def scan_sum(x):
         return jnp.sum(x)
 
-    dt = timeit(scan_sum, A)
-    return elems / dt
+    dts = {}
+    for elems in (e_small, e_large):
+        A = random.normal(random.PRNGKey(1), (elems,), jnp.float32)
+        fence(A)
+        dts[elems] = timeit(scan_sum, A, iters=4)
+    return (e_large - e_small) / (dts[e_large] - dts[e_small])
+
+
+def measure_dispatch_latency():
+    """Seconds per serial device round: the time of a trivial jitted op
+    (all latency, no compute). This is the ``lat_w`` the TPU cost
+    extension charges per dispatch round — the term that lets the model
+    rank latency-dominated small-d solves (the scan-based BCD's 3
+    rounds beat the exact solver's ~10 at every d tested)."""
+    x = random.normal(random.PRNGKey(2), (128,), jnp.float32)
+    fence(x)
+
+    @jax.jit
+    def bump(v):
+        return v + 1.0
+
+    return timeit(bump, x, iters=8)
 
 
 #: Published per-chip ICI bandwidth by generation (bytes/s, one
@@ -125,9 +154,10 @@ def time_solvers(n, d, k=10):
     return out
 
 
-def predicted_ranking(n, d, k, cpu_w, mem_w, net_w):
+def predicted_ranking(n, d, k, cpu_w, mem_w, net_w, lat_w):
     costs = {
-        name: solver.cost(n, d, k, 1.0, 1, cpu_w, mem_w, net_w)
+        name: solver.cost(n, d, k, 1.0, 1, cpu_w, mem_w, net_w,
+                          lat_w=lat_w)
         for name, solver in solver_options()
     }
     return sorted(costs, key=costs.get), costs
@@ -137,25 +167,30 @@ def main():
     print(f"device: {jax.devices()[0].device_kind}", flush=True)
     flop_rate = measure_flop_rate()
     stream_rate = measure_stream_rate()
+    lat_w = measure_dispatch_latency()
     cpu_w = 1.0 / flop_rate
     mem_w = 1.0 / stream_rate
     net_w = derive_net_weight()
-    print(f"MXU rate (HIGHEST gram): {flop_rate / 1e12:.2f} TFLOPS "
-          f"-> cpu_w = {cpu_w:.3e} s/flop", flush=True)
-    print(f"HBM stream rate: {stream_rate * 4 / 1e9:.1f} GB/s "
-          f"-> mem_w = {mem_w:.3e} s/elem", flush=True)
+    print(f"MXU rate (HIGHEST gram, floor-cancelled): "
+          f"{flop_rate / 1e12:.2f} TFLOPS -> cpu_w = {cpu_w:.3e} s/flop",
+          flush=True)
+    print(f"HBM stream rate (floor-cancelled): "
+          f"{stream_rate * 4 / 1e9:.1f} GB/s -> mem_w = {mem_w:.3e} s/elem",
+          flush=True)
+    print(f"dispatch latency: lat_w = {lat_w:.3e} s/round", flush=True)
     print(f"ICI (spec-derived): net_w = {net_w:.3e} s/elem", flush=True)
 
     shapes = [(65_536, 256), (65_536, 1_024), (32_768, 4_096)]
     if SMALL:
         shapes = [(8_192, 256), (8_192, 1_024)]
-    agree = True
+    agree = 0
     for n, d in shapes:
         measured = time_solvers(n, d)
         m_rank = sorted(measured, key=measured.get)
-        p_rank, p_costs = predicted_ranking(n, d, 10, cpu_w, mem_w, net_w)
+        p_rank, p_costs = predicted_ranking(n, d, 10, cpu_w, mem_w,
+                                            net_w, lat_w)
         ok = m_rank[0] == p_rank[0]
-        agree = agree and ok
+        agree += ok
         print(f"  -> measured fastest: {m_rank[0]}, model picks: "
               f"{p_rank[0]}  {'OK' if ok else 'MISMATCH'}", flush=True)
         print(f"     predicted costs: "
@@ -167,7 +202,9 @@ def main():
     print(f"DEFAULT_CPU_WEIGHT = {cpu_w:.3e}", flush=True)
     print(f"DEFAULT_MEM_WEIGHT = {mem_w:.3e}", flush=True)
     print(f"DEFAULT_NETWORK_WEIGHT = {net_w:.3e}", flush=True)
-    print(f"model-vs-measurement agreement: {agree}", flush=True)
+    print(f"DEFAULT_LAT_WEIGHT = {lat_w:.3e}", flush=True)
+    print(f"model-vs-measurement agreement: {agree}/{len(shapes)} shapes",
+          flush=True)
 
 
 if __name__ == "__main__":

