@@ -142,13 +142,19 @@ def _timed_median(work, *, setup=None, reps=3, target_window=2.0,
     app regressions (VERDICT r4 weak#2/next#3: mnist "-53%", tar loader
     "-47%" with no code cause); a >= 2 s window caps the dispatch-floor
     share at ~1% and the median rejects one-off executable-load stalls.
+    The window multiplier comes from the MIN of two estimate calls
+    (ADVICE r5 low#5: a one-off executable-load stall in a single
+    unguarded estimate inflates est, collapsing m to 1 and undersizing
+    every rep's window — the exact jitter this helper exists to reject).
     Returns (median_dt, evidence) where evidence carries the window
     multiplier, rep count, and rep spread for the metric line."""
-    if setup is not None:
-        setup()
-    t0 = time.perf_counter()
-    work()
-    est = time.perf_counter() - t0
+    est = float("inf")
+    for _ in range(2):
+        if setup is not None:
+            setup()
+        t0 = time.perf_counter()
+        work()
+        est = min(est, time.perf_counter() - t0)
     m = max(1, min(max_mult, int(np.ceil(target_window / max(est, 1e-3)))))
     times = []
     for _ in range(reps):
@@ -1201,6 +1207,18 @@ def main():
         print(json.dumps(next(iter(_metrics.values()))), flush=True)
 
 
+def _pop_trace_out(argv):
+    """Extract ``--trace-out PATH`` from argv (None when absent)."""
+    if "--trace-out" not in argv:
+        return None
+    i = argv.index("--trace-out")
+    if i + 1 >= len(argv):
+        raise SystemExit("--trace-out requires a path")
+    path = argv[i + 1]
+    del argv[i:i + 2]
+    return path
+
+
 if __name__ == "__main__":
     import sys
 
@@ -1219,14 +1237,33 @@ if __name__ == "__main__":
         "--stupid-backoff": stupid_backoff_bench,
         "--voc": voc_bench,
     }
-    picked = [f for f in sys.argv[1:] if f in sections]
-    unknown = [f for f in sys.argv[1:] if f.startswith("--")
+    argv = list(sys.argv[1:])
+    trace_out = _pop_trace_out(argv)
+    picked = [f for f in argv if f in sections]
+    unknown = [f for f in argv if f.startswith("--")
                and f not in sections]
     if unknown:
         raise SystemExit(f"unknown bench flags {unknown}; "
-                         f"known: {sorted(sections)}")
-    if picked:
-        for f in picked:
-            sections[f]()
+                         f"known: {sorted(sections)} plus --trace-out PATH")
+
+    def _run_all():
+        if picked:
+            for f in picked:
+                sections[f]()
+        else:
+            main()
+
+    if trace_out is None:
+        _run_all()
     else:
-        main()
+        # bench numbers should travel with their execution evidence
+        # (PERFORMANCE.md): the trace JSON records per-node wall times,
+        # optimizer rule log, auto-cache report, and solver decisions
+        from keystone_tpu.observability import PipelineTrace
+
+        with PipelineTrace("bench") as _tr:
+            _run_all()
+        with open(trace_out, "w") as _f:
+            _f.write(_tr.to_json())
+        print(_tr.summary(top=30), file=sys.stderr)
+        print(f"# trace written to {trace_out}", file=sys.stderr)

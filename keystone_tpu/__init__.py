@@ -8,6 +8,12 @@ Spark cluster, with distributed linear algebra (normal equations, block
 coordinate descent, TSQR) as sharded JAX programs and image/NLP feature
 kernels as TPU-friendly ops.
 """
+from .observability import (
+    MetricsRegistry,
+    PipelineTrace,
+    current_trace,
+    xprof_trace,
+)
 from .parallel.dataset import ArrayDataset, Dataset, HostDataset, as_dataset
 from .parallel.mesh import get_mesh, make_mesh, mesh_scope, set_mesh
 from .workflow import (
@@ -27,6 +33,10 @@ from .workflow import (
 __version__ = "0.1.0"
 
 __all__ = [
+    "MetricsRegistry",
+    "PipelineTrace",
+    "current_trace",
+    "xprof_trace",
     "ArrayDataset",
     "Dataset",
     "HostDataset",

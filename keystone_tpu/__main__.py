@@ -4,6 +4,12 @@
     python -m keystone_tpu <app> [--flags]
 
 Run with no arguments to list the available applications.
+
+``--trace-out PATH`` runs the app under a
+:class:`~keystone_tpu.observability.PipelineTrace` and writes the full
+execution trace (per-node wall times and memory, optimizer rule log,
+auto-cache report, solver decisions) as JSON to PATH; a per-node summary
+table is printed to stderr.
 """
 from __future__ import annotations
 
@@ -77,6 +83,15 @@ def main(argv=None) -> int:
         from keystone_tpu.parallel.mesh import initialize_distributed
 
         initialize_distributed(**dist_args)
+    trace_out = None
+    if "--trace-out" in rest:
+        i = rest.index("--trace-out")
+        if i + 1 >= len(rest):
+            print("--trace-out requires a path", file=sys.stderr)
+            return 2
+        trace_out = rest[i + 1]
+        del rest[i:i + 2]
+
     module = APPS.get(app)
     if module is None:
         print(f"unknown app '{app}'; run with no arguments to list apps",
@@ -84,7 +99,18 @@ def main(argv=None) -> int:
         return 2
     import importlib
 
-    importlib.import_module(module).main(rest)
+    mod = importlib.import_module(module)
+    if trace_out is None:
+        mod.main(rest)
+        return 0
+    from keystone_tpu.observability import PipelineTrace
+
+    with PipelineTrace(app) as tr:
+        mod.main(rest)
+    with open(trace_out, "w") as f:
+        f.write(tr.to_json())
+    print(tr.summary(), file=sys.stderr)
+    print(f"trace written to {trace_out}", file=sys.stderr)
     return 0
 
 

@@ -25,10 +25,13 @@ and ``lat_weight=0`` the choice surface is the reference's exactly.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+import json
+import os
+from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ...observability.trace import current_trace
 from ...parallel.dataset import ArrayDataset, Dataset
 from ...workflow.optimizable import NodeChoice, OptimizableLabelEstimator
 from ..util import Densify
@@ -41,14 +44,117 @@ from .linear import BlockLeastSquaresEstimator, LinearMapEstimator
 #: 2026-07-31, model-vs-measurement agreement 3/3 shapes). cpu:
 #: floor-cancelled HIGHEST-precision gram rate; mem: floor-cancelled
 #: HBM reduction stream; net: ICI spec; lat: measured per-dispatch-
-#: round latency. The tunnel puts real run-to-run variance on the cpu/
-#: mem primitive rates (the ranking is robust to it — the choice
-#: surface at solver shapes is dominated by the lat and mem terms);
-#: re-run the tool on other deployments.
+#: round latency.
+#:
+#: These shipped values are AXON-TUNNEL-INCLUSIVE: they were measured
+#: through the dev tunnel, whose ~18-20 ms dispatch floor dominates
+#: ``DEFAULT_LAT_WEIGHT`` in particular. On a deployment without the
+#: tunnel, per-dispatch latency is orders of magnitude smaller, so
+#: these defaults can over-prefer few-dispatch solvers (e.g. BlockLS)
+#: — they are the *fallback*, not ground truth. Run
+#: ``python tools/calibrate_cost_model.py`` on the target deployment;
+#: it writes a calibration artifact (JSON with timestamp + hostname)
+#: that this module loads in preference to the shipped values (see
+#: :func:`load_calibration`), and whose provenance the observability
+#: layer reports with every solver decision.
 DEFAULT_CPU_WEIGHT = 5.090e-15
 DEFAULT_MEM_WEIGHT = 3.543e-11
 DEFAULT_NETWORK_WEIGHT = 4.0e-11
 DEFAULT_LAT_WEIGHT = 1.442e-2
+
+#: Where ``tools/calibrate_cost_model.py`` writes its artifact and where
+#: :func:`load_calibration` looks by default; override with the
+#: ``KEYSTONE_COST_CALIBRATION`` environment variable.
+CALIBRATION_ENV = "KEYSTONE_COST_CALIBRATION"
+DEFAULT_CALIBRATION_PATH = os.path.join(
+    os.path.expanduser("~"), ".keystone_tpu", "cost_model_calibration.json")
+
+_WEIGHT_KEYS = ("cpu_weight", "mem_weight", "network_weight", "lat_weight")
+
+#: resolved-path -> (weights, provenance); the artifact is tiny but read
+#: once per estimator construction otherwise
+_CALIBRATION_CACHE: Dict[str, Tuple[Dict[str, float], Dict]] = {}
+
+
+def _shipped_weights() -> Dict[str, float]:
+    return {
+        "cpu_weight": DEFAULT_CPU_WEIGHT,
+        "mem_weight": DEFAULT_MEM_WEIGHT,
+        "network_weight": DEFAULT_NETWORK_WEIGHT,
+        "lat_weight": DEFAULT_LAT_WEIGHT,
+    }
+
+
+def load_calibration(
+        path: Optional[str] = None,
+) -> Tuple[Dict[str, float], Dict]:
+    """Resolve the cost-model weights and their provenance.
+
+    Returns ``(weights, provenance)`` where weights come from the
+    calibration artifact written by ``tools/calibrate_cost_model.py``
+    when one is present and valid (all four weights finite, compute
+    weights positive), and otherwise fall back to the shipped
+    tunnel-inclusive ``DEFAULT_*`` values. ``provenance`` carries
+    ``source`` (``"artifact"`` / ``"shipped_defaults"``) plus the
+    artifact's timestamp/hostname/device so trace consumers can judge
+    whether the weights match the deployment that produced a decision.
+    """
+    candidate = (path or os.environ.get(CALIBRATION_ENV)
+                 or DEFAULT_CALIBRATION_PATH)
+    cached = _CALIBRATION_CACHE.get(candidate)
+    if cached is not None:
+        return cached
+    weights = _shipped_weights()
+    provenance: Dict = {
+        "source": "shipped_defaults",
+        "note": ("r5 bench-chip calibration, axon-tunnel-inclusive "
+                 "(lat_weight carries the ~20 ms tunnel dispatch floor); "
+                 "run tools/calibrate_cost_model.py on this deployment"),
+    }
+    try:
+        with open(candidate) as f:
+            blob = json.load(f)
+        parsed = {k: float(blob[k]) for k in _WEIGHT_KEYS}
+        ok = all(np.isfinite(v) for v in parsed.values()) and all(
+            parsed[k] > 0 for k in ("cpu_weight", "mem_weight",
+                                    "network_weight")
+        ) and parsed["lat_weight"] >= 0
+        # the tool refuses to write low-agreement artifacts, but guard
+        # against hand-made / older ones: weights whose recorded
+        # model-vs-measurement agreement was <= half are not trustworthy
+        agreement = str(blob.get("agreement", ""))
+        if ok and "/" in agreement:
+            try:
+                hits, total = (int(p) for p in agreement.split("/", 1))
+                ok = 2 * hits > total
+            except ValueError:
+                pass
+        if ok:
+            weights = parsed
+            provenance = {
+                "source": "artifact",
+                "path": candidate,
+                "timestamp": blob.get("timestamp"),
+                "hostname": blob.get("hostname"),
+                "device": blob.get("device"),
+            }
+        else:
+            provenance["note"] = (
+                f"calibration artifact {candidate} has out-of-range "
+                "weights; using shipped defaults")
+    except FileNotFoundError:
+        pass
+    except Exception as exc:  # malformed artifact: fall back loudly
+        provenance["note"] = (
+            f"calibration artifact {candidate} unreadable ({exc}); "
+            "using shipped defaults")
+    _CALIBRATION_CACHE[candidate] = (weights, provenance)
+    return weights, provenance
+
+
+def clear_calibration_cache() -> None:
+    """Drop memoized calibration lookups (tests, recalibration)."""
+    _CALIBRATION_CACHE.clear()
 
 #: The reference's EC2 calibration (LeastSquaresEstimator.scala:17,
 #: 26-31) — documented fallback, not the default: it encodes a 2015
@@ -96,19 +202,37 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         self,
         lam: float = 0.0,
         num_machines: Optional[int] = None,
-        cpu_weight: float = DEFAULT_CPU_WEIGHT,
-        mem_weight: float = DEFAULT_MEM_WEIGHT,
-        network_weight: float = DEFAULT_NETWORK_WEIGHT,
+        cpu_weight: Optional[float] = None,
+        mem_weight: Optional[float] = None,
+        network_weight: Optional[float] = None,
         num_iterations: int = 20,
-        lat_weight: float = DEFAULT_LAT_WEIGHT,
+        lat_weight: Optional[float] = None,
     ):
+        # weights default to the per-host calibration artifact when one
+        # exists, else the shipped tunnel-inclusive defaults; explicit
+        # arguments always win (and mark provenance as "explicit")
+        calibrated, provenance = load_calibration()
+        explicit = {
+            "cpu_weight": cpu_weight,
+            "mem_weight": mem_weight,
+            "network_weight": network_weight,
+            "lat_weight": lat_weight,
+        }
+        if any(v is not None for v in explicit.values()):
+            provenance = {"source": "explicit", "overrides": sorted(
+                k for k, v in explicit.items() if v is not None)}
         self.lam = lam
         self.num_machines = num_machines
-        self.cpu_weight = cpu_weight
-        self.mem_weight = mem_weight
-        self.network_weight = network_weight
+        self.cpu_weight = (cpu_weight if cpu_weight is not None
+                           else calibrated["cpu_weight"])
+        self.mem_weight = (mem_weight if mem_weight is not None
+                           else calibrated["mem_weight"])
+        self.network_weight = (network_weight if network_weight is not None
+                               else calibrated["network_weight"])
         self.num_iterations = num_iterations
-        self.lat_weight = lat_weight
+        self.lat_weight = (lat_weight if lat_weight is not None
+                           else calibrated["lat_weight"])
+        self._weight_provenance = provenance  # underscore: not in eq_key
 
     @property
     def options(self) -> Sequence[Tuple[object, NodeChoice]]:
@@ -151,11 +275,36 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         k = _item_dim(sample_labels)
         sparsity = estimate_sparsity(sample)
         machines = self.num_machines or num_machines
+        options = self.options
         costs = [
             (solver.cost(n, d, k, sparsity, machines, self.cpu_weight,
                          self.mem_weight, self.network_weight,
                          lat_w=self.lat_weight), i)
-            for i, (solver, _) in enumerate(self.options)
+            for i, (solver, _) in enumerate(options)
         ]
         _, best = min(costs)
-        return self.options[best][1]
+        choice = options[best][1]
+        trace = current_trace()
+        if trace is not None:
+            # the full decision surface: workload shape, every candidate's
+            # cost estimate, the pick, and where the weights came from —
+            # the record that makes a silent solver mis-ranking visible
+            trace.record_solver_decision({
+                "estimator": type(self).__name__,
+                "n": n, "d": d, "k": k,
+                "sparsity": sparsity,
+                "num_machines": machines,
+                "costs": {
+                    type(solver).__name__: cost
+                    for (cost, i), (solver, _) in zip(costs, options)
+                },
+                "chosen": type(choice.node).__name__,
+                "weights": {
+                    "cpu_weight": self.cpu_weight,
+                    "mem_weight": self.mem_weight,
+                    "network_weight": self.network_weight,
+                    "lat_weight": self.lat_weight,
+                },
+                "provenance": dict(self._weight_provenance),
+            })
+        return choice

@@ -23,6 +23,18 @@ this purpose) and evaluated on the held-out gold sample
 (``tests/resources/ner_tagged_sample.txt``) — entity vocabulary in the
 two files deliberately diverges, so the shipped F1 measures
 generalization. ``tests/test_nlp_quality.py`` pins the floor.
+
+Known limitation (ADVICE r5 low#4): ``best_sequence`` merges ALL
+adjacent same-label tokens into one span, so two distinct adjacent
+entities of the same type ("... Alice Bob ..." as two people, or two
+back-to-back organization names) coalesce into a single span — unlike
+the reference's Epic SemiCRF, whose segmentation model can place a
+boundary between them. Token-level consumers are unaffected
+(``label_sequence`` / ``Segmentation.labels`` are exact); only
+span-level consumers see merged entities. Recovering boundaries would
+require BIO-style labels in training and decoding; the current
+token-level behavior is pinned by a regression test in
+``tests/test_nlp_quality.py``.
 """
 from __future__ import annotations
 

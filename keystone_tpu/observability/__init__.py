@@ -1,0 +1,44 @@
+"""Observability layer: pipeline-wide tracing and process metrics.
+
+The reference framework leans on its AutoCacheRule profiler plus the
+Spark UI to answer "which node is slow, what did the optimizer decide,
+and was it right?" (PAPER.md, whole-pipeline optimizer). This package is
+the TPU port's equivalent, threaded through the workflow stack:
+
+* :class:`MetricsRegistry` — process-wide counters / gauges / timing
+  histograms (executor memo hits, prefix-state loads, nodes executed).
+* :class:`PipelineTrace` — a structured per-run trace recording, for
+  every executed graph node: operator name, wall time (honest — device
+  results are blocked on before the clock is read), output
+  device-memory footprint, cache/prefix hit vs compute, and shard
+  count; plus the optimizer's decision logs (which rules fired and
+  their graph-size delta, the auto-cache rule's sampled profiles and
+  selected cache set, and the node-level cost-model's per-solver cost
+  estimates with calibration provenance).
+* :func:`xprof_trace` — an XLA profiler (XProf/TensorBoard) capture
+  whose per-node ``jax.profiler.TraceAnnotation`` scopes carry
+  pipeline-level operator names.
+
+Tracing is zero-overhead by default: every instrumentation site first
+checks :func:`current_trace` and does nothing when no trace context is
+active.
+"""
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry, StepTimer
+from .trace import (
+    NodeRecord,
+    PipelineTrace,
+    current_trace,
+    xprof_trace,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "StepTimer",
+    "NodeRecord",
+    "PipelineTrace",
+    "current_trace",
+    "xprof_trace",
+]

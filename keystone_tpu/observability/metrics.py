@@ -1,0 +1,175 @@
+"""Process-wide metrics: counters, gauges, and timing histograms.
+
+The cheap, always-on half of the observability layer (the detailed
+per-run structure lives in :mod:`.trace`). A metric update is a dict
+lookup plus a float add — safe to leave in hot paths like the DAG
+executor. Like :class:`~keystone_tpu.workflow.env.PipelineEnv`, the
+registry is a process singleton and relies on the single-threaded
+driver model for safety.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, List, Optional
+
+
+class Counter:
+    """Monotonically increasing count."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.value += amount
+
+
+class Gauge:
+    """Last-written value."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        self.value = float(value)
+
+
+class Histogram:
+    """Streaming aggregates (count/total/min/max) plus a bounded tail of
+    raw observations for percentile-ish inspection without unbounded
+    memory growth in long-lived processes."""
+
+    __slots__ = ("name", "count", "total", "min", "max", "_tail")
+
+    TAIL = 256
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self._tail: List[float] = []
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.total += value
+        if value < self.min:
+            self.min = value
+        if value > self.max:
+            self.max = value
+        self._tail.append(value)
+        if len(self._tail) > self.TAIL:
+            del self._tail[: len(self._tail) - self.TAIL]
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def snapshot(self) -> Dict[str, float]:
+        if not self.count:
+            return {"count": 0, "total": 0.0, "mean": 0.0,
+                    "min": 0.0, "max": 0.0}
+        return {"count": self.count, "total": self.total, "mean": self.mean,
+                "min": self.min, "max": self.max}
+
+
+class MetricsRegistry:
+    """Process-wide named metrics (``MetricsRegistry.get_or_create()``)."""
+
+    _instance: Optional["MetricsRegistry"] = None
+
+    def __init__(self) -> None:
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    @classmethod
+    def get_or_create(cls) -> "MetricsRegistry":
+        if cls._instance is None:
+            cls._instance = MetricsRegistry()
+        return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        """Drop the global registry (tests)."""
+        cls._instance = None
+
+    # -- access -----------------------------------------------------------
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            c = self._counters[name] = Counter(name)
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            g = self._gauges[name] = Gauge(name)
+        return g
+
+    def histogram(self, name: str) -> Histogram:
+        h = self._histograms.get(name)
+        if h is None:
+            h = self._histograms[name] = Histogram(name)
+        return h
+
+    @contextlib.contextmanager
+    def timer(self, name: str) -> Iterator[None]:
+        """Time the enclosed block into histogram ``name`` (seconds).
+        Callers timing async device work must block inside the block."""
+        t0 = time.perf_counter()
+        yield
+        self.histogram(name).observe(time.perf_counter() - t0)
+
+    # -- export -----------------------------------------------------------
+    def snapshot(self) -> Dict[str, Dict]:
+        return {
+            "counters": {k: c.value for k, c in sorted(self._counters.items())},
+            "gauges": {k: g.value for k, g in sorted(self._gauges.items())},
+            "histograms": {
+                k: h.snapshot() for k, h in sorted(self._histograms.items())
+            },
+        }
+
+
+class StepTimer:
+    """Wall-clock step timing (formerly ``utils.profiling.StepTimer``;
+    kept API-compatible). ``timed(name, fn, ...)`` blocks on the device
+    result before reading the clock — the honest way to time jitted
+    programs. ``step(name)`` times the enclosed block as-is (callers
+    must block_until_ready inside if the block dispatches async device
+    work)."""
+
+    def __init__(self) -> None:
+        self.times: Dict[str, list] = {}
+
+    @contextlib.contextmanager
+    def step(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        yield
+        self.times.setdefault(name, []).append(time.perf_counter() - t0)
+
+    def timed(self, name: str, fn, *args, **kwargs):
+        import jax
+
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        out = jax.block_until_ready(out)
+        self.times.setdefault(name, []).append(time.perf_counter() - t0)
+        return out
+
+    def summary(self) -> str:
+        lines = []
+        for name, ts in self.times.items():
+            lines.append(
+                f"{name}: n={len(ts)} mean={sum(ts)/len(ts)*1e3:.2f}ms "
+                f"min={min(ts)*1e3:.2f}ms max={max(ts)*1e3:.2f}ms")
+        return "\n".join(lines)

@@ -1,0 +1,302 @@
+"""Structured per-run pipeline tracing.
+
+A :class:`PipelineTrace` is entered as a context manager around pipeline
+execution; while active (:func:`current_trace` returns it), the workflow
+stack feeds it:
+
+* per-node execution records (``record_node`` — appended by the
+  executor's instrumented expression thunks, with wall time measured
+  after ``jax.block_until_ready`` on device results, the output's
+  device-memory footprint, whether the value came from a cache/prefix
+  hit or was computed, and the data shard count);
+* optimizer rule logs (``record_rule`` — which rewrite rules fired and
+  the graph-size delta per rule);
+* the auto-cache rule's report (``record_auto_cache`` — the sampled
+  profiles it extrapolated, the cache set it selected, and the memory
+  budget it worked under);
+* node-level cost-model decisions (``record_node_choice`` /
+  ``record_solver_decision`` — the workload shape n/d/k/sparsity, the
+  per-solver cost estimates behind each choice, and the calibration
+  provenance of the cost-model weights).
+
+Node wall times are *self* times: each instrumented thunk's elapsed time
+minus the time spent inside nested instrumented thunks (dependencies are
+lazy and memoized, so a parent's first ``get()`` transitively computes
+its uncomputed ancestors). Self times therefore sum to the real
+aggregate compute time with no double counting, which is what makes
+``summary()``'s per-node percentages meaningful.
+
+Tracing is zero-overhead by default: when no trace is active every hook
+returns immediately, and the executor does not wrap expression thunks at
+all.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+_ACTIVE: Optional["PipelineTrace"] = None
+
+
+def current_trace() -> Optional["PipelineTrace"]:
+    """The active trace, or None when tracing is disabled (the common
+    case — instrumentation sites bail out on None)."""
+    return _ACTIVE
+
+
+_SUPPRESS_DEPTH = 0
+
+
+@contextlib.contextmanager
+def tracing_disabled() -> Iterator[None]:
+    """Suspend the active trace AND the executor's always-on metrics
+    counters for the enclosed block. Used by optimizer sampling
+    (node-level optimization, auto-cache profiling): sampled sub-graph
+    executions share node ids with the main graph and would pollute the
+    per-node record stream and inflate ``executor.*`` counters; their
+    aggregate cost is already recorded in the optimizer decision
+    entries."""
+    global _ACTIVE, _SUPPRESS_DEPTH
+    prev = _ACTIVE
+    _ACTIVE = None
+    _SUPPRESS_DEPTH += 1
+    try:
+        yield
+    finally:
+        _ACTIVE = prev
+        _SUPPRESS_DEPTH -= 1
+
+
+def metrics_suppressed() -> bool:
+    """True inside a :func:`tracing_disabled` block (throwaway sampled
+    executions must not count as real executor activity)."""
+    return _SUPPRESS_DEPTH > 0
+
+
+@dataclass
+class NodeRecord:
+    """One executed graph node."""
+
+    node_id: int
+    operator: str
+    wall_s: float = 0.0        # self time (nested node compute excluded)
+    total_s: float = 0.0       # inclusive wall time of this node's thunk
+    output_bytes: float = 0.0  # device-memory footprint of the output
+    cached: bool = False       # value came from the prefix/state memo
+    shards: int = 1            # data shards of the output dataset
+    kind: str = ""             # expression kind (dataset/datum/transformer)
+
+
+class _Frame:
+    __slots__ = ("child_s",)
+
+    def __init__(self) -> None:
+        self.child_s = 0.0
+
+
+class PipelineTrace:
+    """Collects one run's execution telemetry; see module docstring.
+
+    Usage::
+
+        with PipelineTrace("mnist") as tr:
+            pipeline.apply(data).numpy()
+        print(tr.summary())
+        open("trace.json", "w").write(tr.to_json())
+    """
+
+    def __init__(self, name: str = "pipeline"):
+        self.name = name
+        self.nodes: List[NodeRecord] = []
+        self.optimizer_rules: List[Dict[str, Any]] = []
+        self.auto_cache: List[Dict[str, Any]] = []
+        self.node_choices: List[Dict[str, Any]] = []
+        self.solver_decisions: List[Dict[str, Any]] = []
+        self.meta: Dict[str, Any] = {}
+        self.wall_s: float = 0.0
+        self._t0: Optional[float] = None
+        self._stack: List[_Frame] = []
+        self._prev: Optional["PipelineTrace"] = None
+
+    # -- context ----------------------------------------------------------
+    def __enter__(self) -> "PipelineTrace":
+        global _ACTIVE
+        self._prev = _ACTIVE
+        _ACTIVE = self
+        self._t0 = time.perf_counter()
+        try:
+            import jax
+
+            dev = jax.devices()[0]
+            self.meta.setdefault("backend", dev.platform)
+            self.meta.setdefault("device_kind", dev.device_kind)
+            self.meta.setdefault("num_devices", len(jax.devices()))
+        except Exception:
+            pass
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _ACTIVE
+        if self._t0 is not None:
+            self.wall_s += time.perf_counter() - self._t0
+            self._t0 = None
+        _ACTIVE = self._prev
+        self._prev = None
+
+    # -- recording hooks (called by the workflow stack) -------------------
+    @contextlib.contextmanager
+    def node_timer(self, record: NodeRecord) -> Iterator[NodeRecord]:
+        """Time one node's thunk, attributing nested instrumented node
+        time to the children (self-time accounting)."""
+        frame = _Frame()
+        self._stack.append(frame)
+        t0 = time.perf_counter()
+        try:
+            yield record
+        finally:
+            total = time.perf_counter() - t0
+            self._stack.pop()
+            record.total_s = total
+            record.wall_s = max(total - frame.child_s, 0.0)
+            if self._stack:
+                self._stack[-1].child_s += total
+            self.nodes.append(record)
+
+    def record_node(self, record: NodeRecord) -> None:
+        """Record a node that involved no timed compute (eager constants,
+        prefix/state cache hits)."""
+        self.nodes.append(record)
+
+    def record_rule(self, optimizer: str, batch: str, rule: str,
+                    nodes_before: int, nodes_after: int,
+                    wall_s: float) -> None:
+        self.optimizer_rules.append({
+            "optimizer": optimizer, "batch": batch, "rule": rule,
+            "nodes_before": nodes_before, "nodes_after": nodes_after,
+            "wall_s": wall_s,
+        })
+
+    def record_auto_cache(self, report: Dict[str, Any]) -> None:
+        self.auto_cache.append(report)
+
+    def record_node_choice(self, entry: Dict[str, Any]) -> None:
+        self.node_choices.append(entry)
+
+    def record_solver_decision(self, entry: Dict[str, Any]) -> None:
+        self.solver_decisions.append(entry)
+
+    # -- views ------------------------------------------------------------
+    def node_ids(self) -> set:
+        return {r.node_id for r in self.nodes}
+
+    def cache_hits(self) -> List[NodeRecord]:
+        return [r for r in self.nodes if r.cached]
+
+    def total_node_wall_s(self) -> float:
+        return sum(r.wall_s for r in self.nodes)
+
+    # -- export -----------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "meta": dict(self.meta),
+            "wall_s": self.wall_s,
+            "nodes": [asdict(r) for r in self.nodes],
+            "optimizer_rules": list(self.optimizer_rules),
+            "auto_cache": list(self.auto_cache),
+            "node_choices": list(self.node_choices),
+            "solver_decisions": list(self.solver_decisions),
+        }
+
+    def to_json(self, indent: Optional[int] = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent, sort_keys=False)
+
+    @classmethod
+    def from_json(cls, blob: str) -> "PipelineTrace":
+        data = json.loads(blob)
+        tr = cls(data.get("name", "pipeline"))
+        tr.meta = dict(data.get("meta", {}))
+        tr.wall_s = float(data.get("wall_s", 0.0))
+        tr.nodes = [NodeRecord(**r) for r in data.get("nodes", [])]
+        tr.optimizer_rules = list(data.get("optimizer_rules", []))
+        tr.auto_cache = list(data.get("auto_cache", []))
+        tr.node_choices = list(data.get("node_choices", []))
+        tr.solver_decisions = list(data.get("solver_decisions", []))
+        return tr
+
+    def summary(self, top: int = 0) -> str:
+        """Human-readable per-node table sorted by self wall time, with
+        each node's share of the total, followed by optimizer decisions."""
+        lines = [f"PipelineTrace {self.name!r}: "
+                 f"{len(self.nodes)} node executions, "
+                 f"wall {self.wall_s:.3f}s"]
+        total = self.total_node_wall_s()
+        lines.append(f"traced node compute: {total:.3f}s "
+                     f"({100.0 * total / self.wall_s:.1f}% of wall)"
+                     if self.wall_s else
+                     f"traced node compute: {total:.3f}s")
+        rows = sorted(self.nodes, key=lambda r: -r.wall_s)
+        if top:
+            rows = rows[:top]
+        lines.append(f"{'node':>6} {'operator':<28} {'self ms':>10} "
+                     f"{'% total':>8} {'out MiB':>9} {'shards':>6} "
+                     f"{'cached':>6}")
+        for r in rows:
+            pct = 100.0 * r.wall_s / total if total else 0.0
+            lines.append(
+                f"{r.node_id:>6} {r.operator[:28]:<28} "
+                f"{r.wall_s * 1e3:>10.2f} {pct:>7.1f}% "
+                f"{r.output_bytes / (1 << 20):>9.2f} {r.shards:>6} "
+                f"{'yes' if r.cached else '':>6}")
+        if self.optimizer_rules:
+            lines.append("optimizer rules fired:")
+            for e in self.optimizer_rules:
+                lines.append(
+                    f"  {e['rule']} [{e['batch']}] nodes "
+                    f"{e['nodes_before']} -> {e['nodes_after']} "
+                    f"({e['wall_s'] * 1e3:.1f} ms)")
+        for rep in self.auto_cache:
+            sel = rep.get("selected", [])
+            lines.append(
+                f"auto-cache[{rep.get('strategy')}]: cached {len(sel)} "
+                f"node(s) {sel} under budget "
+                f"{rep.get('budget_bytes', 0) / (1 << 20):.0f} MiB "
+                f"(profiled {len(rep.get('profiles', {}))} nodes)")
+        for d in self.solver_decisions:
+            costs = ", ".join(
+                f"{k}={v:.3g}s" for k, v in d.get("costs", {}).items())
+            lines.append(
+                f"solver choice @ n={d.get('n')} d={d.get('d')} "
+                f"k={d.get('k')} sparsity={d.get('sparsity'):.3g}: "
+                f"{d.get('chosen')} ({costs}) "
+                f"[weights: {d.get('provenance', {}).get('source', '?')}]")
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def xprof_trace(log_dir: str, name: str = "pipeline"
+                ) -> Iterator[PipelineTrace]:
+    """Capture an XLA profiler trace (xplane, viewable in
+    TensorBoard/XProf) for everything in scope, with a
+    :class:`PipelineTrace` active so per-node
+    ``jax.profiler.TraceAnnotation`` scopes carry pipeline-level
+    operator names in the profile.
+
+    When a trace is already active it is reused (yielded as-is), so
+    nesting ``xprof_trace`` inside ``with PipelineTrace(...) as tr:``
+    keeps every record in ``tr`` instead of diverting it to a throwaway
+    inner trace."""
+    import jax
+
+    active = current_trace()
+    ctx = (contextlib.nullcontext(active) if active is not None
+           else PipelineTrace(name))
+    with ctx as tr:
+        jax.profiler.start_trace(log_dir)
+        try:
+            yield tr
+        finally:
+            jax.profiler.stop_trace()

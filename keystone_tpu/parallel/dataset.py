@@ -282,6 +282,35 @@ def _masked_vmap(fn, data, n: int, padded_n: int, mesh: Mesh):
     return _apply_mask(out, n, mesh) if n < padded_n else out
 
 
+def device_nbytes(value: Any) -> float:
+    """Best-effort memory footprint in bytes of a pipeline value, cheap
+    enough for the observability hot path: array metadata only — never
+    gathers device data to host. ArrayDatasets sum their leaves' nbytes
+    (device-resident); HostDatasets extrapolate from a 16-item sample
+    (host-resident); other values sum nbytes over their pytree leaves,
+    charging a nominal 64 bytes per opaque leaf. Shared by the
+    auto-cache profiler's memory accounting and per-node trace records."""
+    if isinstance(value, ArrayDataset):
+        return float(sum(
+            getattr(leaf, "nbytes", 64)
+            for leaf in jax.tree_util.tree_leaves(value.data)))
+    if isinstance(value, HostDataset):
+        items = value.items
+        if not items:
+            return 0.0
+        sample = items[:16]
+        per = sum(
+            float(getattr(it, "nbytes", 64)) for it in sample) / len(sample)
+        return per * len(items)
+    if isinstance(value, Dataset):
+        # unknown future subclass: nominal per-item charge — never
+        # collect() here, that's the gather this hot path must not do
+        return 64.0 * len(value)
+    return float(sum(
+        getattr(leaf, "nbytes", 64)
+        for leaf in jax.tree_util.tree_leaves(value)))
+
+
 def to_numpy(x: Any, dtype=None) -> np.ndarray:
     """Materialize datasets / lazy pipeline results / arrays as one numpy
     array (the shared coercion for evaluators and host-side fits)."""

@@ -1,53 +1,34 @@
-"""Profiling hooks (reference SURVEY.md section 5: the reference relies on
-its AutoCacheRule profiler + Spark UI; the TPU analogues are the XLA
-profiler (xplane traces viewable in TensorBoard/XProf) and simple wall
-timing of jitted steps)."""
+"""Profiling hooks — subsumed by :mod:`keystone_tpu.observability`.
+
+This module is kept as a compatibility shim: :class:`StepTimer` now
+lives in ``observability.metrics`` (same API), and ``trace(log_dir)``
+keeps its original pure XLA-profiler semantics. For xplanes whose
+ranges carry pipeline-level node names, use
+``observability.xprof_trace`` — note it activates a
+:class:`~keystone_tpu.observability.PipelineTrace`, whose per-node
+device sync changes overlap behavior relative to an untraced run (an
+observer effect this pure capture does not have). Prefer importing from
+``keystone_tpu.observability`` directly.
+"""
 from __future__ import annotations
 
 import contextlib
-import time
-from typing import Dict, Iterator, Optional
+from typing import Iterator
 
-import jax
+from ..observability.metrics import StepTimer  # noqa: F401 (re-export)
+from ..observability.trace import xprof_trace  # noqa: F401 (re-export)
 
 
 @contextlib.contextmanager
 def trace(log_dir: str) -> Iterator[None]:
-    """Capture an XLA profiler trace (xplane) for everything in scope."""
+    """Capture an XLA profiler trace (xplane) for everything in scope —
+    profiler start/stop only, no PipelineTrace activation, so the
+    captured timeline reflects untraced execution exactly (existing
+    callers keep their measurement semantics)."""
+    import jax
+
     jax.profiler.start_trace(log_dir)
     try:
         yield
     finally:
         jax.profiler.stop_trace()
-
-
-class StepTimer:
-    """Wall-clock step timing. ``timed(name, fn, ...)`` blocks on the
-    device result before reading the clock — the honest way to time
-    jitted programs. ``step(name)`` times the enclosed block as-is
-    (callers must block_until_ready inside if the block dispatches
-    async device work)."""
-
-    def __init__(self) -> None:
-        self.times: Dict[str, list] = {}
-
-    @contextlib.contextmanager
-    def step(self, name: str) -> Iterator[None]:
-        t0 = time.perf_counter()
-        yield
-        self.times.setdefault(name, []).append(time.perf_counter() - t0)
-
-    def timed(self, name: str, fn, *args, **kwargs):
-        t0 = time.perf_counter()
-        out = fn(*args, **kwargs)
-        out = jax.block_until_ready(out)
-        self.times.setdefault(name, []).append(time.perf_counter() - t0)
-        return out
-
-    def summary(self) -> str:
-        lines = []
-        for name, ts in self.times.items():
-            lines.append(
-                f"{name}: n={len(ts)} mean={sum(ts)/len(ts)*1e3:.2f}ms "
-                f"min={min(ts)*1e3:.2f}ms max={max(ts)*1e3:.2f}ms")
-        return "\n".join(lines)

@@ -4,16 +4,41 @@ Mirrors ``workflow/graph/GraphExecutor.scala``: optimizes lazily on first
 execution, refuses to execute ids reachable from unconnected sources, and
 saves results of saveable nodes (estimator fits, caches) into the global
 prefix state table (``GraphExecutor.scala:53-80``).
+
+Observability: when a :class:`~keystone_tpu.observability.PipelineTrace`
+is active, ``_execute`` wraps each node's lazy expression thunk so that
+its first ``get()`` is timed (blocking on device results before reading
+the clock), its output's device-memory footprint and shard count are
+recorded, and the compute runs under ``jax.named_scope`` /
+``jax.profiler.TraceAnnotation`` so XProf traces carry pipeline-level
+operator names. Already-computed expressions (prefix/state cache hits)
+are recorded as such. With no trace active nothing is wrapped — the
+executor path is byte-for-byte the untraced one except for a few
+always-on :class:`MetricsRegistry` counter increments per node
+(``executor.nodes_executed`` / ``memo_hits`` / ``prefix_hits``).
 """
 from __future__ import annotations
 
 from typing import Dict, FrozenSet, Optional
 
+from ..observability.metrics import MetricsRegistry
+from ..observability.trace import NodeRecord, current_trace, metrics_suppressed
 from .env import PipelineEnv
-from .expression import Expression
+from .expression import (
+    DatasetExpression,
+    DatumExpression,
+    Expression,
+    TransformerExpression,
+)
 from .graph import Graph
 from .graph_ids import GraphId, NodeId, SinkId, SourceId
-from .operators import EstimatorOperator, Operator
+from .operators import (
+    DatasetOperator,
+    DatumOperator,
+    EstimatorOperator,
+    ExpressionOperator,
+    Operator,
+)
 from .prefix import compute_prefix
 
 
@@ -21,6 +46,84 @@ def is_saveable(op: Operator) -> bool:
     """Which operators' results enter the global prefix memo (reference
     ``ExtractSaveablePrefixes.scala:8-19``: Cacher or EstimatorOperator)."""
     return isinstance(op, EstimatorOperator) or getattr(op, "saveable", False)
+
+
+def _expression_kind(expr: Expression) -> str:
+    if isinstance(expr, DatasetExpression):
+        return "dataset"
+    if isinstance(expr, DatumExpression):
+        return "datum"
+    if isinstance(expr, TransformerExpression):
+        return "transformer"
+    return "expression"
+
+
+def _block_on_device(value) -> None:
+    """Block until device work backing ``value`` completes, so recorded
+    wall times are honest for async-dispatched jax computations. Fitted
+    transformers carry their device arrays (solver weights etc.) as
+    attributes, so their async fit work is synced too — otherwise the
+    solve's cost would be misattributed to the first downstream node
+    that forces the weights."""
+    import jax
+
+    from ..parallel.dataset import ArrayDataset
+
+    try:
+        if isinstance(value, ArrayDataset):
+            jax.block_until_ready(value.data)
+        elif hasattr(value, "block_until_ready") or isinstance(
+                value, (list, tuple, dict)):
+            jax.block_until_ready(value)
+        else:
+            attrs = getattr(value, "__dict__", None)
+            if attrs:
+                jax.block_until_ready([
+                    leaf for leaf in jax.tree_util.tree_leaves(attrs)
+                    if hasattr(leaf, "block_until_ready")
+                ])
+    except Exception:
+        pass  # host values: nothing to block on
+
+
+def _measure_output(record: NodeRecord, value) -> None:
+    from ..parallel.dataset import ArrayDataset, device_nbytes
+    from ..parallel.mesh import num_data_shards
+
+    record.output_bytes = device_nbytes(value)
+    if isinstance(value, ArrayDataset):
+        record.shards = num_data_shards(value.mesh)
+
+
+def _traced_thunk(orig, node_id: int, label: str, kind: str):
+    """Wrap an expression thunk with trace recording. The active trace is
+    looked up at *call* time: saved expressions outlive the trace under
+    which they were created (they live in ``PipelineEnv.state``), and a
+    stale captured trace must not be written to after it exits."""
+
+    def run():
+        trace = current_trace()
+        if trace is None:
+            return orig()
+        import jax
+
+        record = NodeRecord(node_id=node_id, operator=label, kind=kind)
+        with trace.node_timer(record):
+            scope = f"{label}#{node_id}"
+            try:
+                ann = jax.profiler.TraceAnnotation(scope)
+            except Exception:  # profiler backend unavailable
+                import contextlib
+
+                ann = contextlib.nullcontext()
+            with jax.named_scope(scope), ann:
+                value = orig()
+            _block_on_device(value)
+            _measure_output(record, value)
+        return value
+
+    run._keystone_traced = True
+    return run
 
 
 class GraphExecutor:
@@ -61,19 +164,37 @@ class GraphExecutor:
         return self._unexecutables
 
     def execute(self, gid: GraphId) -> Expression:
+        return self._execute(gid)
+
+    def _execute(self, gid: GraphId) -> Expression:
         graph = self.graph
         if isinstance(gid, SinkId):
-            return self.execute(graph.get_sink_dependency(gid))
+            return self._execute(graph.get_sink_dependency(gid))
         if gid in self.unexecutables:
             raise ValueError(
                 f"cannot execute {gid!r}: it depends on an unconnected source"
             )
+        # sampled optimizer executions (tracing_disabled) are throwaway:
+        # they must not count as real executor activity
+        count = not metrics_suppressed()
+        metrics = MetricsRegistry.get_or_create() if count else None
         if gid in self._cache:
+            if count:
+                metrics.counter("executor.memo_hits").inc()
             return self._cache[gid]
         assert isinstance(gid, NodeId), gid
         op = graph.get_operator(gid)
-        deps = [self.execute(d) for d in graph.get_dependencies(gid)]
+        deps = [self._execute(d) for d in graph.get_dependencies(gid)]
         expr = op.execute(deps)
+        if count:
+            metrics.counter("executor.nodes_executed").inc()
+            if isinstance(op, ExpressionOperator):
+                # saved-state substitution (SavedStateLoadRule / prefix
+                # memo) — counted traced or not
+                metrics.counter("executor.prefix_hits").inc()
+        trace = current_trace()
+        if trace is not None:
+            self._instrument(trace, gid, op, expr)
         self._cache[gid] = expr
         if is_saveable(op):
             prefix = compute_prefix(graph, gid)
@@ -83,3 +204,25 @@ class GraphExecutor:
                 # across pipelines (GraphExecutor.scala:66-70).
                 PipelineEnv.get_or_create().state[prefix] = expr
         return expr
+
+    @staticmethod
+    def _instrument(trace, gid: NodeId, op: Operator, expr: Expression) -> None:
+        """Attach trace recording to ``expr``. Computed expressions are
+        recorded immediately: constants as such, anything else (saved
+        state substituted by ``SavedStateLoadRule``, results shared via
+        the prefix memo) as a cache hit."""
+        label = op.label()
+        if expr.computed:
+            record = NodeRecord(
+                node_id=gid.id, operator=label,
+                cached=not isinstance(op, (DatasetOperator, DatumOperator)),
+                kind=_expression_kind(expr))
+            _measure_output(record, expr.get())
+            trace.record_node(record)
+            return
+        if getattr(expr._thunk, "_keystone_traced", False):
+            # already wrapped (a saved lazy handle reused across
+            # pipelines); the wrapper resolves the active trace itself
+            return
+        expr._thunk = _traced_thunk(
+            expr._thunk, gid.id, label, _expression_kind(expr))

@@ -137,19 +137,11 @@ def generalize_profiles(new_scale: int,
 
 
 def _result_mem(value) -> float:
-    if isinstance(value, ArrayDataset):
-        import jax
+    # shared with per-node trace records (parallel.dataset.device_nbytes):
+    # one memory-accounting definition for planner and observer
+    from ...parallel.dataset import device_nbytes
 
-        return float(sum(
-            np.asarray(leaf).nbytes
-            for leaf in jax.tree_util.tree_leaves(value.data)))
-    if isinstance(value, Dataset):
-        items = value.collect()
-        total = 0.0
-        for it in items[:16]:
-            total += getattr(it, "nbytes", 64)
-        return total * (len(items) / max(min(len(items), 16), 1))
-    return float(getattr(value, "nbytes", 64))
+    return device_nbytes(value)
 
 
 def profile_graph(
@@ -181,13 +173,18 @@ def profile_graph(
             if isinstance(op, DatasetOperator):
                 sampled = sampled.set_operator(
                     n, DatasetOperator(_sample_dataset(op.dataset, items)))
+        from ...observability.trace import tracing_disabled
+
         for _ in range(num_trials):
             executor = GraphExecutor(sampled, optimize=False)
             for node in sampled.linearize():
                 if not isinstance(node, NodeId) or node in unexec:
                     continue
                 t0 = time.monotonic()
-                value = executor.execute(node).get()
+                with tracing_disabled():
+                    # sampled profiling runs share node ids with the real
+                    # graph; keep them out of the per-node record stream
+                    value = executor.execute(node).get()
                 if isinstance(value, ArrayDataset):
                     import jax
 
@@ -282,6 +279,8 @@ class AutoCacheRule(Rule):
 
     # -- strategies -------------------------------------------------------
     def _aggressive(self, graph: Graph) -> Graph:
+        from ...observability.trace import current_trace
+
         children = _children_with_multiplicity(graph)
         weights = {n: node_weight(graph.get_operator(n)) for n in graph.nodes}
         downstream_of_source = graph.source_descendants()
@@ -290,6 +289,14 @@ class AutoCacheRule(Rule):
             if sum(weights[c] for c in children[n]
                    if c not in downstream_of_source) > 1
         )
+        trace = current_trace()
+        if trace is not None:
+            trace.record_auto_cache({
+                "strategy": self.AGGRESSIVE,
+                "selected": sorted(n.id for n in to_cache),
+                "selected_operators": {
+                    n.id: graph.get_operator(n).label() for n in to_cache},
+            })
         return make_cached_graph(graph, to_cache)
 
     def _greedy(self, graph: Graph) -> Graph:
@@ -328,6 +335,32 @@ class AutoCacheRule(Rule):
             runs = get_runs(graph, children, frozenset(cached), weights)
 
         to_cache = frozenset(cached - init_cache_set(graph))
+        from ...observability.trace import current_trace
+
+        trace = current_trace()
+        if trace is not None:
+            # the full decision record: what was measured (extrapolated
+            # per-node profiles), what was chosen, and under what budget
+            # — so "was the cache choice right?" is answerable offline
+            trace.record_auto_cache({
+                "strategy": self.GREEDY,
+                "budget_bytes": float(budget),
+                "mem_used_bytes": float(used()),
+                "profiles": {
+                    n.id: {"ns": p.ns, "mem": p.mem}
+                    for n, p in sorted(profiles.items(), key=lambda kv: kv[0].id)
+                },
+                "profile_scales": list(self.scales),
+                "initially_cached": sorted(
+                    n.id for n in init_cache_set(graph)),
+                "selected": sorted(n.id for n in to_cache),
+                "selected_operators": {
+                    n.id: graph.get_operator(n).label() for n in to_cache},
+                "estimated_uncached_s": estimate_cached_run_time(
+                    graph, children, init_cache_set(graph), profiles) / 1e9,
+                "estimated_cached_s": estimate_cached_run_time(
+                    graph, children, frozenset(cached), profiles) / 1e9,
+            })
         return make_cached_graph(graph, to_cache)
 
     def apply(self, graph: Graph) -> Graph:

@@ -5,6 +5,15 @@ Mirrors ``workflow/graph/DefaultOptimizer.scala:5-10`` plus the v1
 pruning, CSE to fixpoint, cost-model node-level optimization, CSE again.
 (The reference's ExtractSaveablePrefixes step is subsumed by the
 executor's ``is_saveable`` check — see ``executor.py``.)
+
+Observability: under an active
+:class:`~keystone_tpu.observability.PipelineTrace`, every rule
+application here is logged with its graph-size delta (engine hook in
+``rule.Optimizer.execute``), the node-level pass logs each splice
+decision with the cost model's per-solver estimates
+(``node_rule`` / ``LeastSquaresEstimator.optimize``), and the
+auto-cache batch logs its sampled profiles, selected cache set, and
+memory budget (``auto_cache.AutoCacheRule``).
 """
 from __future__ import annotations
 

@@ -81,8 +81,14 @@ class NodeOptimizationRule(Rule):
                 sampled = sampled.set_operator(
                     node, DatasetOperator(
                         _sample_dataset(op.dataset, self.sample_size)))
+        from ...observability.trace import tracing_disabled
+
         executor = GraphExecutor(sampled, optimize=False)
-        return [executor.execute(d).get() for d in deps], n
+        with tracing_disabled():
+            # sampled executions share node ids with the real graph and
+            # must not appear as per-node trace records; their cost is
+            # logged via the node-choice entry instead
+            return [executor.execute(d).get() for d in deps], n
 
     # -- splicing ---------------------------------------------------------
     @staticmethod
@@ -129,8 +135,33 @@ class NodeOptimizationRule(Rule):
         graph = graph.set_operator(node, choice.node)
         return graph.set_dependencies(node, tuple(new_deps))
 
+    # -- trace hook -------------------------------------------------------
+    @staticmethod
+    def _record_choice(node: NodeId, op, choice: NodeChoice, n: int,
+                       machines: int, wall_s: float) -> None:
+        """Log the splice decision to the active trace (the detailed
+        per-solver cost table is recorded by the optimizable node itself,
+        e.g. ``LeastSquaresEstimator.optimize`` — this entry ties it to a
+        graph node and the sampling cost)."""
+        from ...observability.trace import current_trace
+
+        trace = current_trace()
+        if trace is None:
+            return
+        trace.record_node_choice({
+            "node_id": node.id,
+            "optimizable": type(op).__name__,
+            "chosen": type(choice.node).__name__,
+            "prefix": [type(t).__name__ for t in choice.prefix],
+            "full_n": n,
+            "num_machines": machines,
+            "sample_and_optimize_s": wall_s,
+        })
+
     # -- rule entry -------------------------------------------------------
     def apply(self, graph: Graph) -> Graph:
+        import time
+
         # ids reachable from unconnected (runtime) sources can't be sampled
         downstream = graph.source_descendants()
 
@@ -141,6 +172,7 @@ class NodeOptimizationRule(Rule):
             op = graph.get_operator(node)
             if node in downstream:
                 continue
+            t0 = time.perf_counter()
             if isinstance(op, OptimizableLabelEstimator):
                 (sample, sample_labels), n = self._execute_sampled(
                     graph, graph.get_dependencies(node)[:2])
@@ -156,4 +188,8 @@ class NodeOptimizationRule(Rule):
                     graph, graph.get_dependencies(node)[:1])
                 choice = op.optimize(sample, n, machines)
                 graph = self._splice_transformer(graph, node, choice)
+            else:
+                continue
+            self._record_choice(node, op, choice, n, machines,
+                                time.perf_counter() - t0)
         return graph

@@ -3,14 +3,18 @@
 Mirrors ``workflow/graph/Rule.scala`` and ``RuleExecutor.scala``: an
 Optimizer is a sequence of batches of rewrite rules, each batch run either
 once or iterated to fixpoint (bounded), with plan-diff logging in DOT form
-at debug level.
+at debug level. When a :class:`~keystone_tpu.observability.PipelineTrace`
+is active, every rule application that rewrote the plan is recorded with
+its batch, wall time, and graph-size delta — the optimizer decision log.
 """
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass
 from typing import Sequence, Union
 
+from ...observability.trace import current_trace
 from ..graph import Graph
 
 logger = logging.getLogger(__name__)
@@ -55,6 +59,8 @@ class Optimizer:
         raise NotImplementedError
 
     def execute(self, graph: Graph) -> Graph:
+        trace = current_trace()
+        t_start = time.perf_counter()
         current = graph
         for batch in self.batches:
             if isinstance(batch.strategy, Once):
@@ -64,14 +70,25 @@ class Optimizer:
             for i in range(iters):
                 before = current
                 for rule in batch.rules:
+                    t0 = time.perf_counter() if trace is not None else 0.0
                     after = rule.apply(current)
-                    if after is not current and logger.isEnabledFor(logging.DEBUG):
-                        logger.debug(
-                            "rule %s (batch %s) rewrote plan:\n%s",
-                            rule.name,
-                            batch.name,
-                            after.to_dot(rule.name),
-                        )
+                    if after is not current:
+                        if trace is not None:
+                            trace.record_rule(
+                                optimizer=type(self).__name__,
+                                batch=batch.name,
+                                rule=rule.name,
+                                nodes_before=len(current.nodes),
+                                nodes_after=len(after.nodes),
+                                wall_s=time.perf_counter() - t0,
+                            )
+                        if logger.isEnabledFor(logging.DEBUG):
+                            logger.debug(
+                                "rule %s (batch %s) rewrote plan:\n%s",
+                                rule.name,
+                                batch.name,
+                                after.to_dot(rule.name),
+                            )
                     current = after
                 if current == before:
                     break
@@ -82,4 +99,12 @@ class Optimizer:
                         batch.name,
                         iters,
                     )
+        if trace is not None:
+            trace.meta.setdefault("optimizer_runs", []).append({
+                "optimizer": type(self).__name__,
+                "batches": [b.name for b in self.batches],
+                "nodes_in": len(graph.nodes),
+                "nodes_out": len(current.nodes),
+                "wall_s": time.perf_counter() - t_start,
+            })
         return current

@@ -8,6 +8,13 @@ process over 8 virtual devices.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # tests never touch the real TPU
+# Hermeticity: LeastSquaresEstimator loads the per-host cost-model
+# calibration artifact (~/.keystone_tpu/...) when present; a machine
+# that has run tools/calibrate_cost_model.py must not change
+# shipped-default cost-model test outcomes. Point the lookup at a
+# nonexistent path unless a test overrides it explicitly.
+os.environ["KEYSTONE_COST_CALIBRATION"] = (
+    "/nonexistent/keystone-test-calibration.json")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -31,11 +38,19 @@ def pytest_configure(config):
 def fresh_env():
     """Reset global pipeline state between tests (the reference stops and
     recreates its SparkContext per test)."""
+    from keystone_tpu.nodes.learning.least_squares import (
+        clear_calibration_cache,
+    )
+    from keystone_tpu.observability.metrics import MetricsRegistry
     from keystone_tpu.workflow.env import PipelineEnv
 
     PipelineEnv.reset()
+    MetricsRegistry.reset()
+    clear_calibration_cache()
     yield
     PipelineEnv.reset()
+    MetricsRegistry.reset()
+    clear_calibration_cache()
 
 
 @pytest.fixture

@@ -173,3 +173,29 @@ def test_ner_default_is_trained_model():
     )
 
     assert isinstance(NER().model, AveragedPerceptronNerModel)
+
+
+def test_ner_adjacent_same_type_entities_merge_into_one_span():
+    """Regression pin for the documented span-merge limitation (ADVICE
+    r5 low#4, perceptron_ner module docstring): token-level labels are
+    exact, but ``best_sequence`` coalesces adjacent same-label tokens,
+    so two distinct adjacent PERSON entities come back as ONE span.
+    Hand-crafted weights make the decode deterministic; if span
+    boundaries between adjacent entities ever become recoverable (BIO
+    decoding), this test should be updated alongside the docstring."""
+    from keystone_tpu.nodes.nlp.perceptron_ner import (
+        AveragedPerceptronNerModel,
+    )
+
+    model = AveragedPerceptronNerModel(
+        weights={"w=alice": {"PERSON": 5.0}, "w=bob": {"PERSON": 5.0},
+                 "w=visited": {"O": 5.0}, "w=paris": {"LOCATION": 5.0}},
+        labels=["LOCATION", "O", "PERSON"])
+    words = ["Alice", "Bob", "visited", "Paris"]
+    # token level: exact
+    assert model.label_sequence(words) == [
+        "PERSON", "PERSON", "O", "LOCATION"]
+    seg = model.best_sequence(words)
+    assert seg.labels == ["PERSON", "PERSON", "O", "LOCATION"]
+    # span level: Alice and Bob — two people — merge into one span
+    assert seg.spans == [("PERSON", 0, 2), ("LOCATION", 3, 4)]

@@ -27,7 +27,20 @@ Data is generated ON DEVICE (the axon tunnel uploads at single-digit
 MB/s) and every timed region ends with a scalar pull (bench.py _fence
 rationale).
 
-Usage: python tools/calibrate_cost_model.py [--small]
+Floor-cancelled differences are GUARDED (ADVICE r5 low#3): tunnel
+jitter can make dt_large - dt_small near-zero or negative, which would
+silently print nonsensical (even negative) weights; each pair is
+re-measured once and the run aborts with a clear message if the
+difference stays non-positive, and every derived rate is bounds-checked
+before the ship block is printed.
+
+Besides the copy-pasteable ship block, the tool writes a calibration
+ARTIFACT (JSON with the four weights plus timestamp / hostname /
+device): ``keystone_tpu.nodes.learning.least_squares`` loads it in
+preference to the shipped defaults, and pipeline traces report its
+provenance with every solver decision.
+
+Usage: python tools/calibrate_cost_model.py [--small] [--out PATH]
 """
 import sys
 
@@ -50,6 +63,39 @@ from tools._bench import fence, timeit  # noqa: E402
 
 # -- primitive rates -------------------------------------------------------
 
+def _floor_cancelled(label, measure):
+    """rate = numer / (dt_large - dt_small) with a jitter guard:
+    ``measure()`` returns (dt_small, dt_large, numer); a non-positive
+    difference (tunnel jitter swamping the size delta) is re-measured
+    once, then aborts — a negative weight must never reach the ship
+    block or the artifact."""
+    for attempt in (0, 1):
+        dt_small, dt_large, numer = measure()
+        if dt_large > dt_small:
+            return numer / (dt_large - dt_small)
+        print(f"WARNING: {label}: dt_large ({dt_large * 1e3:.1f} ms) <= "
+              f"dt_small ({dt_small * 1e3:.1f} ms) — tunnel jitter "
+              "swamped the floor-cancelled difference; "
+              + ("retrying once" if attempt == 0 else "aborting"),
+              flush=True)
+    raise SystemExit(
+        f"calibration aborted: {label} unmeasurable on this host (the "
+        "large-shape timing is not slower than the small-shape timing "
+        "after a retry). Re-run when the tunnel/host is quieter; do NOT "
+        "hand-edit weights from a run that printed this message.")
+
+
+def _sanity_bound(name, value, lo, hi, unit):
+    """Abort before printing/shipping a physically implausible rate."""
+    if not (lo <= value <= hi) or not np.isfinite(value):
+        raise SystemExit(
+            f"calibration aborted: {name} = {value:.3e} {unit} is outside "
+            f"the plausible range [{lo:.0e}, {hi:.0e}] — the measurement "
+            "is untrustworthy (tunnel jitter, thermal throttling, or a "
+            "mis-detected device). Re-run; do not ship these weights.")
+    return value
+
+
 def measure_flop_rate():
     """Sustained solver-precision (HIGHEST) MXU rate on a Gram at the
     solver's own shape class. FLOOR-CANCELLED: the axon tunnel adds
@@ -61,12 +107,20 @@ def measure_flop_rate():
     n_small, n_large, d = ((4_096, 16_384, 1_024) if SMALL
                            else (16_384, 49_152, 4_096))
     g = jax.jit(linalg.gram)
-    dts = {}
-    for n in (n_small, n_large):
-        A = random.normal(random.PRNGKey(0), (n, d), jnp.float32)
-        fence(A)
-        dts[n] = timeit(g, A)
-    return 2.0 * (n_large - n_small) * d * d / (dts[n_large] - dts[n_small])
+
+    def measure():
+        dts = {}
+        for n in (n_small, n_large):
+            A = random.normal(random.PRNGKey(0), (n, d), jnp.float32)
+            fence(A)
+            dts[n] = timeit(g, A)
+        return (dts[n_small], dts[n_large],
+                2.0 * (n_large - n_small) * d * d)
+
+    # plausible sustained MXU rates: ~GFLOPS (CPU smoke) to <2 PFLOPS
+    return _sanity_bound("MXU flop rate",
+                         _floor_cancelled("MXU flop rate", measure),
+                         1e8, 2e15, "FLOPS")
 
 
 def measure_stream_rate():
@@ -81,12 +135,18 @@ def measure_stream_rate():
     def scan_sum(x):
         return jnp.sum(x)
 
-    dts = {}
-    for elems in (e_small, e_large):
-        A = random.normal(random.PRNGKey(1), (elems,), jnp.float32)
-        fence(A)
-        dts[elems] = timeit(scan_sum, A, iters=4)
-    return (e_large - e_small) / (dts[e_large] - dts[e_small])
+    def measure():
+        dts = {}
+        for elems in (e_small, e_large):
+            A = random.normal(random.PRNGKey(1), (elems,), jnp.float32)
+            fence(A)
+            dts[elems] = timeit(scan_sum, A, iters=4)
+        return dts[e_small], dts[e_large], float(e_large - e_small)
+
+    # ~4 MB/s (broken) .. 4 TB/s-class HBM in f32 elements/s
+    return _sanity_bound("HBM stream rate",
+                         _floor_cancelled("HBM stream rate", measure),
+                         1e6, 1e13, "elements/s")
 
 
 def measure_dispatch_latency():
@@ -163,11 +223,53 @@ def predicted_ranking(n, d, k, cpu_w, mem_w, net_w, lat_w):
     return sorted(costs, key=costs.get), costs
 
 
+def write_artifact(path, weights, agreement, shapes_checked):
+    """Persist the calibration as the JSON artifact that
+    ``least_squares.load_calibration`` picks up, stamped with enough
+    provenance (timestamp, hostname, device) for the observability layer
+    to report where a solver decision's weights came from."""
+    import datetime
+    import json
+    import os
+    import socket
+
+    blob = dict(weights)
+    blob.update({
+        "device": jax.devices()[0].device_kind,
+        "hostname": socket.gethostname(),
+        "timestamp": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(),
+        "agreement": f"{agreement}/{shapes_checked}",
+        "small": SMALL,
+        "tool": "tools/calibrate_cost_model.py",
+    })
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(blob, f, indent=2)
+    os.replace(tmp, path)
+    return path
+
+
 def main():
+    from keystone_tpu.nodes.learning.least_squares import (
+        DEFAULT_CALIBRATION_PATH,
+    )
+
+    out_path = DEFAULT_CALIBRATION_PATH
+    if "--out" in sys.argv:
+        i = sys.argv.index("--out")
+        if i + 1 >= len(sys.argv):
+            raise SystemExit("--out requires a path")
+        out_path = sys.argv[i + 1]
+
     print(f"device: {jax.devices()[0].device_kind}", flush=True)
     flop_rate = measure_flop_rate()
     stream_rate = measure_stream_rate()
-    lat_w = measure_dispatch_latency()
+    lat_w = _sanity_bound("dispatch latency", measure_dispatch_latency(),
+                          1e-7, 1.0, "s/round")
     cpu_w = 1.0 / flop_rate
     mem_w = 1.0 / stream_rate
     net_w = derive_net_weight()
@@ -205,6 +307,24 @@ def main():
     print(f"DEFAULT_LAT_WEIGHT = {lat_w:.3e}", flush=True)
     print(f"model-vs-measurement agreement: {agree}/{len(shapes)} shapes",
           flush=True)
+    if 2 * agree <= len(shapes):
+        # the agreement check used to gate a human copy-pasting the ship
+        # block; now that the artifact is auto-loaded it must gate the
+        # write — weights that mis-rank the measured solvers on most
+        # validation shapes would silently mis-rank every future solve
+        print(f"NOT writing calibration artifact: model-vs-measurement "
+              f"agreement {agree}/{len(shapes)} is too low to trust "
+              "(rates may be individually plausible but jitter-skewed). "
+              "Re-run on a quieter host; shipped defaults stay active.",
+              flush=True)
+        return
+    weights = {"cpu_weight": cpu_w, "mem_weight": mem_w,
+               "network_weight": net_w, "lat_weight": lat_w}
+    path = write_artifact(out_path, weights, agree, len(shapes))
+    print(f"calibration artifact written to {path} — "
+          "LeastSquaresEstimator loads it automatically (override with "
+          "$KEYSTONE_COST_CALIBRATION); pipeline traces report its "
+          "provenance with every solver decision", flush=True)
 
 
 if __name__ == "__main__":
