@@ -4,6 +4,9 @@
 #
 #   bin/run-pipeline.sh <app> [--flags]
 #   bin/run-pipeline.sh                 # list apps
+#   bin/run-pipeline.sh --check         # repo static gate (tools/lint.py):
+#                                       # per-app pipeline checks + AST rules
+#   bin/run-pipeline.sh check <app>     # static-check one app's DAG
 #
 # The reference capped OMP_NUM_THREADS to protect OpenBLAS inside Spark
 # executors (run-pipeline.sh:12-31). Here TPU compute goes through XLA,
@@ -28,4 +31,12 @@ fi
 export PYTHONPATH="$KEYSTONE_HOME${PYTHONPATH:+:$PYTHONPATH}"
 PY=python3
 command -v python3 >/dev/null 2>&1 || PY=python
+
+# --check: the pre-PR static gate — no data, no device, exit != 0 on
+# any diagnostic (see tools/lint.py)
+if [[ "${1:-}" == "--check" ]]; then
+  shift
+  exec "$PY" "$KEYSTONE_HOME/tools/lint.py" "$@"
+fi
+
 exec "$PY" -m keystone_tpu "$@"

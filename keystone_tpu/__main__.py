@@ -2,8 +2,16 @@
 ``bin/run-pipeline.sh <class> --flags``, SURVEY.md section 2.13):
 
     python -m keystone_tpu <app> [--flags]
+    python -m keystone_tpu check <app> [--json PATH]
+    python -m keystone_tpu check --all
 
 Run with no arguments to list the available applications.
+
+``check`` statically analyzes an app's pipeline DAG — shape/dtype
+propagation plus the graph lints (see ``keystone_tpu/analysis``) —
+without loading data or allocating a device buffer, and exits non-zero
+if any diagnostic fires. ``--json PATH`` additionally writes the full
+report (per-node specs + diagnostics).
 
 ``--trace-out PATH`` runs the app under a
 :class:`~keystone_tpu.observability.PipelineTrace` and writes the full
@@ -31,14 +39,76 @@ APPS = {
 }
 
 
+def check_main(rest) -> int:
+    """``python -m keystone_tpu check <app>|--all [--json PATH]``."""
+    import os
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    json_out = None
+    if "--json" in rest:
+        i = rest.index("--json")
+        if i + 1 >= len(rest):
+            print("--json requires a path", file=sys.stderr)
+            return 2
+        json_out = rest[i + 1]
+        del rest[i:i + 2]
+
+    from keystone_tpu.pipelines import CHECK_APPS, resolve_check_app
+
+    if not rest or rest[0] in ("-h", "--help"):
+        print("usage: python -m keystone_tpu check <app>|--all "
+              "[--json PATH]\n\napps:")
+        for name in sorted(CHECK_APPS):
+            print(f"  {name}")
+        return 0
+    if rest[0] == "--all":
+        builders = [CHECK_APPS[k] for k in sorted(CHECK_APPS)]
+    else:
+        try:
+            builders = [resolve_check_app(rest[0])]
+        except KeyError:
+            print(f"unknown app '{rest[0]}'; run `check` with no "
+                  "arguments to list apps", file=sys.stderr)
+            return 2
+
+    failed = 0
+    reports = []
+    for build in builders:
+        target = build()
+        report = target.pipeline.check(target.input_spec, name=target.name)
+        reports.append(report)
+        print(report.summary(), file=sys.stderr)
+        if not report.ok:
+            failed += 1
+        status = "OK" if report.ok else (
+            f"FAIL ({len(report.diagnostics)} diagnostic(s))")
+        print(f"{target.name}: {status}")
+    if json_out is not None:
+        import json as _json
+
+        blob = (reports[0].to_dict() if len(reports) == 1
+                else [r.to_dict() for r in reports])
+        with open(json_out, "w") as f:
+            f.write(_json.dumps(blob, indent=2))
+        print(f"report written to {json_out}", file=sys.stderr)
+    return 1 if failed else 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help", "help"):
-        print("usage: python -m keystone_tpu <app> [--flags]\n\napps:")
+        print("usage: python -m keystone_tpu <app> [--flags]\n"
+              "       python -m keystone_tpu check <app>|--all\n\napps:")
         for name in sorted(APPS):
             print(f"  {name}")
         return 0
     app, rest = argv[0], argv[1:]
+    if app == "check":
+        return check_main(rest)
     import os
 
     # Environments that import jax at interpreter start (device-plugin

@@ -1,0 +1,53 @@
+"""Static pipeline analysis: abstract interpretation + graph lints.
+
+KeystoneML's core promise is that the whole-DAG structure of a pipeline
+is known before execution; this package makes that promise *checkable*
+on the TPU port. ``analyze`` propagates shape/dtype/sharding specs
+(``jax.ShapeDtypeStruct``-style, via each operator's ``abstract_eval``)
+through a workflow Graph without touching a device; ``check_pipeline``
+(exposed as ``Pipeline.check``) layers rule-based lints on top and
+returns an :class:`AnalysisReport`.
+
+Entry points:
+
+* ``pipeline.check(sample_spec)``               — library API
+* ``python -m keystone_tpu check <app>``        — CLI over the bundled
+  app registry (``keystone_tpu.pipelines.CHECK_APPS``)
+* ``tools/lint.py``                             — repo-wide static gate
+"""
+from .diagnostics import (
+    AnalysisReport,
+    Diagnostic,
+    apply_body_host_coercions,
+    check_graph,
+    check_pipeline,
+)
+from .interpreter import Analysis, analyze
+from .spec import (
+    DatasetSpec,
+    DatumSpec,
+    SparseSpec,
+    SpecDataset,
+    TransformerSpec,
+    Unknown,
+    as_input_spec,
+    spec_dataset,
+)
+
+__all__ = [
+    "Analysis",
+    "AnalysisReport",
+    "DatasetSpec",
+    "DatumSpec",
+    "Diagnostic",
+    "SparseSpec",
+    "SpecDataset",
+    "TransformerSpec",
+    "Unknown",
+    "analyze",
+    "apply_body_host_coercions",
+    "as_input_spec",
+    "check_graph",
+    "check_pipeline",
+    "spec_dataset",
+]

@@ -1,0 +1,368 @@
+"""Rule-based graph lints + the check report.
+
+The diagnostics engine over the abstract interpreter: propagation
+errors (shape/dtype mismatches, host-sync hazards caught during
+``jax.eval_shape``) come from ``interpreter.analyze``; this module adds
+the structural lints —
+
+* ``unbound-source``     a sink-reachable value depends on a source no
+                         input spec was bound to
+* ``dead-branch``        nodes no sink depends on (silently skipped at
+                         execution; almost always a mis-wired graph)
+* ``dtype-narrowing``    a node's output drops float width relative to
+                         its inputs (f32 -> bf16/f16) without being an
+                         explicit cast — silent precision loss across a
+                         node boundary
+* ``host-sync``          (static form) a device-node ``apply`` body
+                         calls ``np.asarray``/``np.array`` on its item
+                         argument — the AST-level gate behind ADVICE's
+                         "no host coercions in hot paths" rule
+* ``fusion-prefix-hazard`` a saveable node's logical prefix changes
+                         under map/gather fusion, so saved fitted state
+                         could never be re-matched by
+                         ``SavedStateLoadRule`` (CHANGES.md PR 1 note)
+
+— and packages everything as an :class:`AnalysisReport` in the
+observability layer's report style (text summary + ``to_json``).
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import json
+import textwrap
+from dataclasses import asdict
+from typing import Any, Callable, Dict, List, Mapping, Optional
+
+import jax
+import numpy as np
+
+from ..workflow.graph import Graph
+from ..workflow.graph_ids import GraphId, NodeId, SourceId
+from .interpreter import (
+    Analysis,
+    Diagnostic,
+    SEVERITY_ERROR,
+    SEVERITY_WARNING,
+    analyze,
+)
+from .spec import (
+    AbstractValue,
+    DatasetSpec,
+    DatumSpec,
+    Unknown,
+    as_input_spec,
+    format_element,
+)
+
+
+# -- structural lints -------------------------------------------------------
+
+def _sink_reachable(graph: Graph) -> set:
+    needed: set = set()
+    for k in graph.sinks:
+        dep = graph.get_sink_dependency(k)
+        needed.add(dep)
+        needed |= graph.get_ancestors(dep)
+    return needed
+
+
+def unbound_source_lint(
+    graph: Graph, source_specs: Mapping[SourceId, AbstractValue]
+) -> List[Diagnostic]:
+    out = []
+    needed = _sink_reachable(graph)
+    for s in sorted(graph.sources, key=lambda g: g.id):
+        if s in source_specs:
+            continue
+        if s in needed:
+            out.append(Diagnostic(
+                code="unbound-source", severity=SEVERITY_ERROR,
+                node_id=s.id, operator="Source",
+                message=("a sink-reachable value depends on source "
+                         f"{s.id} but no input spec was bound to it")))
+    return out
+
+
+def dead_branch_lint(graph: Graph) -> List[Diagnostic]:
+    needed = _sink_reachable(graph)
+    out = []
+    for n in sorted(graph.nodes, key=lambda g: g.id):
+        if n not in needed:
+            out.append(Diagnostic(
+                code="dead-branch", severity=SEVERITY_WARNING,
+                node_id=n.id, operator=graph.get_operator(n).label(),
+                message="no sink depends on this node; it will never "
+                        "execute (mis-wired branch?)"))
+    return out
+
+
+def _float_widths(spec: AbstractValue) -> List[int]:
+    element = getattr(spec, "element", None)
+    if element is None:
+        return []
+    widths = []
+    for leaf in jax.tree_util.tree_leaves(
+            element, is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct)):
+        if isinstance(leaf, jax.ShapeDtypeStruct):
+            # covers bf16 too: ml_dtypes.bfloat16 is a 2-byte floating
+            # np dtype, so itemsize*8 reports 16
+            dt = np.dtype(leaf.dtype)
+            if jax.numpy.issubdtype(dt, jax.numpy.floating):
+                widths.append(dt.itemsize * 8)
+    return widths
+
+
+def dtype_narrowing_lint(analysis: Analysis) -> List[Diagnostic]:
+    graph = analysis.graph
+    out = []
+    for n in sorted(graph.nodes, key=lambda g: g.id):
+        op = graph.get_operator(n)
+        if getattr(op, "narrowing_ok", False):
+            continue  # explicit casts narrow on purpose
+        out_w = _float_widths(analysis.value(n))
+        if not out_w:
+            continue
+        in_w: List[int] = []
+        for d in graph.get_dependencies(n):
+            in_w.extend(_float_widths(analysis.value(d)))
+        if in_w and min(out_w) < min(in_w):
+            out.append(Diagnostic(
+                code="dtype-narrowing", severity=SEVERITY_WARNING,
+                node_id=n.id, operator=op.label(),
+                message=(f"output narrows floats to {min(out_w)}-bit from "
+                         f"{min(in_w)}-bit inputs; silent precision loss "
+                         "across a node boundary (mark the operator "
+                         "`narrowing_ok = True` if intentional)")))
+    return out
+
+
+# -- host-sync AST lint -----------------------------------------------------
+
+_HOST_COERCIONS = {"asarray", "array", "ascontiguousarray"}
+_NUMPY_ALIASES = {"np", "numpy", "onp"}
+
+
+def host_coercions_in_funcdef(fdef) -> List[tuple]:
+    """``(lineno, description)`` for each ``np.*`` host coercion applied
+    to one of ``fdef``'s own parameters. The single source of truth for
+    the host-coercion pattern — used on live classes here and on raw
+    source trees by ``tools/lint.py``. Only coercions whose argument IS
+    a parameter are flagged: ``np.*`` on static config (seeds, index
+    tables) is legitimate."""
+    params = {a.arg for a in fdef.args.args[1:]}  # skip self
+    hits = []
+    for node in ast.walk(fdef):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id in _NUMPY_ALIASES
+                and f.attr in _HOST_COERCIONS):
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Name) and arg.id in params:
+            hits.append((node.lineno, f"{f.value.id}.{f.attr}({arg.id})"))
+    return hits
+
+
+def apply_body_host_coercions(cls) -> List[str]:
+    """Names of ``np.*`` host coercions applied to the item argument in
+    ``cls.apply`` — the static (AST) form of the host-sync lint."""
+    from ..workflow.transformer import HostTransformer, Transformer
+
+    if not (isinstance(cls, type) and issubclass(cls, Transformer)):
+        return []
+    if issubclass(cls, HostTransformer):
+        return []  # host stages are allowed host semantics
+    fn = cls.__dict__.get("apply")
+    if fn is None:
+        return []
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError):
+        return []
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return []
+    return [what for _, what in host_coercions_in_funcdef(fdef)]
+
+
+def host_sync_lint(graph: Graph) -> List[Diagnostic]:
+    out = []
+    seen_types = set()
+    for n in sorted(graph.nodes, key=lambda g: g.id):
+        op = graph.get_operator(n)
+        stages = getattr(op, "stages", None) or getattr(
+            op, "branches", None) or [op]
+        for stage in stages:
+            if type(stage) in seen_types:
+                continue
+            seen_types.add(type(stage))
+            hits = apply_body_host_coercions(type(stage))
+            if hits:
+                out.append(Diagnostic(
+                    code="host-sync", severity=SEVERITY_ERROR,
+                    node_id=n.id, operator=stage.label(),
+                    message=(f"apply() coerces its item to host via "
+                             f"{', '.join(hits)}: forces a device sync "
+                             "per item; use jnp or a HostTransformer")))
+    return out
+
+
+# -- fusion/prefix hazard ---------------------------------------------------
+
+def _fusion_fixpoint(graph: Graph) -> Graph:
+    from ..workflow.optimizer.fusion import GatherFusionRule, MapFusionRule
+
+    rules = [MapFusionRule(), GatherFusionRule()]
+    for _ in range(1000):
+        nxt = graph
+        for r in rules:
+            nxt = r.apply(nxt)
+        if nxt is graph:
+            return graph
+        graph = nxt
+    return graph
+
+
+def fusion_prefix_lint(
+    graph: Graph, fuse: Optional[Callable[[Graph], Graph]] = None
+) -> List[Diagnostic]:
+    """Saveable nodes must keep their canonical logical prefix under
+    map/gather fusion, or fitted state saved by an optimized run can
+    never be re-matched by ``SavedStateLoadRule`` on a later raw graph
+    (the cross-pipeline cache-miss recorded in CHANGES.md). Detected
+    statically by comparing each saveable node's prefix before and after
+    the fusion rules run."""
+    from ..workflow.executor import is_saveable
+    from ..workflow.prefix import compute_prefix
+
+    pre_memo: Dict[GraphId, Any] = {}
+    pre = {
+        n: compute_prefix(graph, n, pre_memo)
+        for n in graph.nodes
+        if is_saveable(graph.get_operator(n))
+    }
+    pre = {n: p for n, p in pre.items() if p is not None}
+    if not pre:
+        return []
+    fused = (fuse or _fusion_fixpoint)(graph)
+    if fused is graph:
+        return []
+    out = []
+    post_memo: Dict[GraphId, Any] = {}
+    for n, p in sorted(pre.items(), key=lambda kv: kv[0].id):
+        if n not in fused.nodes:
+            continue  # the saveable node itself was rewritten away
+        p2 = compute_prefix(fused, n, post_memo)
+        if p2 != p:
+            out.append(Diagnostic(
+                code="fusion-prefix-hazard", severity=SEVERITY_ERROR,
+                node_id=n.id, operator=graph.get_operator(n).label(),
+                message=("logical prefix changes under map/gather fusion; "
+                         "saved fitted state for this node would never be "
+                         "re-matched by SavedStateLoadRule (canonicalize "
+                         "the fused operator's prefix — see "
+                         "workflow/prefix.py)")))
+    return out
+
+
+# -- report -----------------------------------------------------------------
+
+class AnalysisReport:
+    """One static check's outcome: the abstract values per node plus all
+    diagnostics, exportable in the observability layer's report style."""
+
+    def __init__(self, name: str, analysis: Analysis,
+                 diagnostics: List[Diagnostic]):
+        self.name = name
+        self.analysis = analysis
+        self.diagnostics = diagnostics
+
+    @property
+    def ok(self) -> bool:
+        return not self.diagnostics
+
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == SEVERITY_ERROR]
+
+    def resolved_nodes(self) -> int:
+        return sum(
+            1 for n in self.analysis.graph.nodes
+            if not isinstance(self.analysis.value(n), Unknown))
+
+    def to_dict(self) -> Dict[str, Any]:
+        graph = self.analysis.graph
+        nodes = []
+        for n in sorted(graph.nodes, key=lambda g: g.id):
+            spec = self.analysis.value(n)
+            nodes.append({
+                "node_id": n.id,
+                "operator": graph.get_operator(n).label(),
+                "spec": repr(spec),
+            })
+        return {
+            "name": self.name,
+            "nodes": nodes,
+            "diagnostics": [asdict(d) for d in self.diagnostics],
+        }
+
+    def to_json(self, indent: Optional[int] = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent)
+
+    def summary(self) -> str:
+        graph = self.analysis.graph
+        total = len(graph.nodes)
+        lines = [f"Static check {self.name!r}: {total} nodes, "
+                 f"{self.resolved_nodes()} with resolved specs, "
+                 f"{len(self.diagnostics)} diagnostic(s)"]
+        lines.append(f"{'node':>6} {'operator':<34} spec")
+        for n in sorted(graph.nodes, key=lambda g: g.id):
+            spec = self.analysis.value(n)
+            op = graph.get_operator(n).label()
+            if isinstance(spec, (DatasetSpec, DatumSpec)):
+                shown = (f"{format_element(spec.element)}"
+                         + (f" x n={spec.n}"
+                            if isinstance(spec, DatasetSpec) else ""))
+            else:
+                shown = repr(spec)
+            lines.append(f"{n.id:>6} {op[:34]:<34} {shown}")
+        if self.diagnostics:
+            lines.append("diagnostics:")
+            for d in self.diagnostics:
+                lines.append(f"  {d}")
+        else:
+            lines.append("no diagnostics: pipeline is statically clean")
+        return "\n".join(lines)
+
+
+def check_graph(
+    graph: Graph,
+    source_specs: Optional[Mapping[SourceId, AbstractValue]] = None,
+    name: str = "graph",
+) -> AnalysisReport:
+    """Run the abstract interpreter plus every lint over ``graph``."""
+    source_specs = dict(source_specs or {})
+    analysis = analyze(graph, source_specs)
+    diagnostics = list(analysis.diagnostics)
+    diagnostics += unbound_source_lint(graph, source_specs)
+    diagnostics += dead_branch_lint(graph)
+    diagnostics += dtype_narrowing_lint(analysis)
+    diagnostics += host_sync_lint(graph)
+    diagnostics += fusion_prefix_lint(graph)
+    return AnalysisReport(name, analysis, diagnostics)
+
+
+def check_pipeline(pipeline, sample: Any = None,
+                   name: str = "pipeline") -> AnalysisReport:
+    """``Pipeline.check``'s engine: bind ``sample`` (an input spec — see
+    ``spec.as_input_spec``) to the pipeline's dangling source and check
+    the full graph."""
+    p = pipeline.to_pipeline()
+    specs = {}
+    if sample is not None:
+        specs[p._source] = as_input_spec(sample)
+    return check_graph(p._graph, specs, name=name)

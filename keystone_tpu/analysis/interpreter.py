@@ -1,0 +1,116 @@
+"""Abstract interpretation of a workflow Graph.
+
+Walks the DAG in topological order (``Graph.linearize``), calling each
+operator's ``abstract_eval`` on its dependencies' abstract values
+(``analysis.spec``). Everything is shape-level — ``jax.eval_shape``
+under the hood — so no device buffer is ever allocated and no data is
+read: the whole-DAG structure KeystoneML promises to know before
+execution (reference ``workflow/graph/Graph.scala``) is checked before a
+single TPU cycle is spent.
+
+Failures during a node's abstract evaluation become diagnostics:
+
+* jax shape/dtype errors        -> ``shape-mismatch``
+* tracer-to-host coercions      -> ``host-sync`` (an ``np.asarray`` on a
+  traced value inside a device node's ``apply`` — the silent
+  device-to-host round trip that serializes the pipeline)
+
+and the failing node's output becomes :class:`~.spec.Unknown`, so one
+real error does not cascade into dozens of follow-on reports.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional
+
+from ..workflow.graph import Graph
+from ..workflow.graph_ids import GraphId, NodeId, SinkId, SourceId
+from .spec import AbstractValue, Unknown
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+
+
+@dataclass
+class Diagnostic:
+    """One statically detected problem."""
+
+    code: str            # lint identifier, e.g. "shape-mismatch"
+    severity: str        # "error" | "warning"
+    node_id: Optional[int]
+    operator: str        # operator label (or "" for graph-level lints)
+    message: str
+
+    def __str__(self) -> str:
+        where = f" @ node {self.node_id}" if self.node_id is not None else ""
+        op = f" [{self.operator}]" if self.operator else ""
+        return f"{self.severity}: {self.code}{where}{op}: {self.message}"
+
+
+@dataclass
+class Analysis:
+    """Abstract values per graph id plus propagation diagnostics."""
+
+    graph: Graph
+    values: Dict[GraphId, AbstractValue] = field(default_factory=dict)
+    diagnostics: List[Diagnostic] = field(default_factory=list)
+
+    def value(self, gid: GraphId) -> AbstractValue:
+        return self.values.get(gid, Unknown("not analyzed"))
+
+
+def _classify_failure(exc: Exception) -> str:
+    """Map an abstract-evaluation exception to a lint code."""
+    name = type(exc).__name__
+    if name in ("TracerArrayConversionError", "ConcretizationTypeError",
+                "TracerBoolConversionError", "TracerIntegerConversionError"):
+        return "host-sync"
+    return "shape-mismatch"
+
+
+def _first_line(exc: Exception) -> str:
+    text = str(exc).strip()
+    return text.splitlines()[0] if text else type(exc).__name__
+
+
+def analyze(
+    graph: Graph,
+    source_specs: Optional[Mapping[SourceId, AbstractValue]] = None,
+) -> Analysis:
+    """Propagate abstract values through ``graph``.
+
+    ``source_specs`` binds dangling sources (a pipeline's runtime input)
+    to input specs; unbound sources propagate Unknown (and are reported
+    by the ``unbound-source`` lint in ``diagnostics.py`` if anything
+    reachable from a sink consumes them)."""
+    source_specs = dict(source_specs or {})
+    result = Analysis(graph)
+    values = result.values
+    for gid in graph.linearize():
+        if isinstance(gid, SourceId):
+            values[gid] = source_specs.get(
+                gid, Unknown("unbound source"))
+            continue
+        if isinstance(gid, SinkId):
+            values[gid] = values.get(
+                graph.get_sink_dependency(gid), Unknown("missing dep"))
+            continue
+        assert isinstance(gid, NodeId)
+        op = graph.get_operator(gid)
+        dep_specs = [values.get(d, Unknown("missing dep"))
+                     for d in graph.get_dependencies(gid)]
+        try:
+            values[gid] = op.abstract_eval(dep_specs)
+        except Exception as exc:  # classified into a diagnostic
+            code = _classify_failure(exc)
+            if code == "host-sync":
+                msg = ("per-item apply coerces a traced value to host "
+                       f"({_first_line(exc)}); wrap in a HostTransformer "
+                       "or keep the computation in jax")
+            else:
+                msg = _first_line(exc)
+            result.diagnostics.append(Diagnostic(
+                code=code, severity=SEVERITY_ERROR, node_id=gid.id,
+                operator=op.label(), message=msg))
+            values[gid] = Unknown(f"abstract eval failed: {code}")
+    return result

@@ -240,6 +240,11 @@ class RandomPatcher(Transformer):
             _already_sharded=True,
         )
 
+    def abstract_eval(self, dep_specs):
+        return _patcher_abstract_eval(
+            self, dep_specs, self.patch_size_x, self.patch_size_y,
+            self.num_patches)
+
 
 class CenterCornerPatcher(Transformer):
     """Center + four corner crops, optionally with horizontal flips —
@@ -279,6 +284,33 @@ class CenterCornerPatcher(Transformer):
             mesh=ds.mesh,
             _already_sharded=True,
         )
+
+    def abstract_eval(self, dep_specs):
+        return _patcher_abstract_eval(
+            self, dep_specs, self.patch_size_x, self.patch_size_y,
+            self.patches_per_image)
+
+
+def _patcher_abstract_eval(op, dep_specs, px, py, patches_per_image):
+    """Shared static semantics of the cropping augmenters: each (H, W, C)
+    image becomes ``patches_per_image`` items of (px, py, C), multiplying
+    the dataset's item count."""
+    from ...analysis.spec import DatasetSpec, Unknown
+
+    (d,) = dep_specs
+    if not isinstance(d, DatasetSpec):
+        return Unknown(f"{type(op).__name__} is dataset-only")
+    e = d.element
+    if not (isinstance(e, jax.ShapeDtypeStruct) and len(e.shape) == 3):
+        return Unknown("patcher input not an (H, W, C) image element")
+    H, W, C = e.shape
+    if H < px or W < py:
+        raise ValueError(
+            f"{type(op).__name__}: patch ({px}, {py}) larger than "
+            f"input image ({H}, {W})")
+    out = jax.ShapeDtypeStruct((px, py, C), e.dtype)
+    n = None if d.n is None else d.n * patches_per_image
+    return DatasetSpec(out, n=n, host=d.host, sparsity=1.0)
 
 
 def _flip_h(img):

@@ -106,12 +106,31 @@ def _gmm_from_columns(ds: Dataset, k: int,
     return est.fit(ArrayDataset.from_numpy(cols))
 
 
+def _fisher_abstract_fit(k: int):
+    """Fitted FV encoder spec: (D, nDesc) descriptor matrix -> (D, 2K)."""
+    import jax
+
+    from ...analysis.spec import Unknown
+
+    def apply_element(element):
+        if isinstance(element, jax.ShapeDtypeStruct) and len(
+                element.shape) == 2:
+            return jax.ShapeDtypeStruct(
+                (int(element.shape[0]), 2 * k), np.float32)
+        return Unknown("fisher-vector input not a (D, nDesc) matrix")
+
+    return apply_element
+
+
 class ScalaGMMFisherVectorEstimator(Estimator):
     """Per-item-jit FV estimator (reference ``FisherVector.scala:67-73``;
     the name mirrors the reference's scala implementation)."""
 
     def __init__(self, k: int):
         self.k = k
+
+    def abstract_fit(self, dep_specs):
+        return _fisher_abstract_fit(self.k)
 
     def _fit(self, ds: Dataset) -> FisherVector:
         return FisherVector(_gmm_from_columns(ds, self.k))
@@ -131,6 +150,9 @@ class GMMFisherVectorEstimator(OptimizableEstimator):
     def __init__(self, k: int):
         self.k = k
 
+    def abstract_fit(self, dep_specs):
+        return _fisher_abstract_fit(self.k)
+
     @property
     def default(self) -> Estimator:
         return ScalaGMMFisherVectorEstimator(self.k)
@@ -139,3 +161,7 @@ class GMMFisherVectorEstimator(OptimizableEstimator):
         if self.k >= 32:
             return NodeChoice(EncEvalGMMFisherVectorEstimator(self.k))
         return NodeChoice(ScalaGMMFisherVectorEstimator(self.k))
+
+    def optimize_static(self, spec, n: int, num_machines: int):
+        # the choice depends only on k: always statically resolvable
+        return self.optimize(None, n, num_machines)

@@ -80,6 +80,11 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
     def weight(self) -> int:
         return 3 * self.num_iter + 1  # reference :44
 
+    def abstract_fit(self, dep_specs):
+        from ...analysis.spec import labels_width_fit
+
+        return labels_width_fit(dep_specs)
+
     def _fit(self, ds: Dataset, labels: Dataset) -> BlockLinearMapper:
         ds, labels = ensure_array(ds), ensure_array(labels)
         return self._fit_sharded(ds, labels)

@@ -335,6 +335,11 @@ class LocalLeastSquaresEstimator(LabelEstimator):
     def __init__(self, lam: float):
         self.lam = lam
 
+    def abstract_fit(self, dep_specs):
+        from ...analysis.spec import labels_width_fit
+
+        return labels_width_fit(dep_specs)
+
     def _fit(self, ds: Dataset, labels: Dataset) -> LinearMapper:
         A = np.asarray(ds.numpy(), np.float32)
         b = np.asarray(labels.numpy(), np.float32)

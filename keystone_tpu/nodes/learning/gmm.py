@@ -137,6 +137,11 @@ class GaussianMixtureModelEstimator(Estimator):
         self.initialization_method = initialization_method
         self.seed = seed
 
+    def abstract_fit(self, dep_specs):
+        from ...analysis.spec import map_last_dim
+
+        return map_last_dim(self.k)
+
     def _fit(self, ds: Dataset) -> GaussianMixtureModel:
         X = ds.numpy() if isinstance(ds, ArrayDataset) else np.stack(ds.collect())
         return self.fit_matrix(np.asarray(X, np.float32))

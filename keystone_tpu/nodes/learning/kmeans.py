@@ -60,6 +60,11 @@ class KMeansPlusPlusEstimator(Estimator):
         self.stop_tolerance = stop_tolerance
         self.seed = seed
 
+    def abstract_fit(self, dep_specs):
+        from ...analysis.spec import map_last_dim
+
+        return map_last_dim(self.num_means)
+
     def _fit(self, ds: Dataset) -> KMeansModel:
         X = ds.numpy() if isinstance(ds, ArrayDataset) else np.stack(ds.collect())
         return self.fit_matrix(np.asarray(X, np.float32))

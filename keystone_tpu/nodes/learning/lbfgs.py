@@ -46,6 +46,11 @@ class DenseLBFGSwithL2(LabelEstimator):
     def weight(self) -> int:
         return self.num_iterations + 1
 
+    def abstract_fit(self, dep_specs):
+        from ...analysis.spec import labels_width_fit
+
+        return labels_width_fit(dep_specs)
+
     def _fit(self, ds: Dataset, labels: Dataset) -> LinearMapper:
         ds, labels = ensure_array(ds), ensure_array(labels)
         n = ds.n
@@ -152,6 +157,11 @@ class SparseLBFGSwithL2(LabelEstimator):
     @property
     def weight(self) -> int:
         return self.num_iterations + 1
+
+    def abstract_fit(self, dep_specs):
+        from ...analysis.spec import labels_width_fit
+
+        return labels_width_fit(dep_specs)
 
     def _fit(self, ds: Dataset, labels: Dataset):
         from .classifiers import SparseLinearMapper

@@ -260,6 +260,11 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
     def weight(self) -> int:
         return self.default.weight
 
+    def abstract_fit(self, dep_specs):
+        from ...analysis.spec import labels_width_fit
+
+        return labels_width_fit(dep_specs)
+
     def _fit(self, ds: Dataset, labels: Dataset):
         # fallback path when the node-level optimizer has not sampled:
         # densify host sparse data for the dense default
@@ -274,7 +279,31 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         d = _item_dim(sample)
         k = _item_dim(sample_labels)
         sparsity = estimate_sparsity(sample)
-        machines = self.num_machines or num_machines
+        return self._choose(n, d, k, sparsity,
+                            self.num_machines or num_machines, "sampled")
+
+    def optimize_static(self, spec, n: int, num_machines: int,
+                        labels_spec=None) -> Optional[NodeChoice]:
+        """Cost-model choice from statically inferred (n, d, k, sparsity)
+        — no sampled execution, no device time. ``sparsity`` here is the
+        analyzer's STRUCTURAL density (1.0 for dense-stored elements),
+        not the sampled value-level density ``estimate_sparsity``
+        measures; solvers for dense-stored data are ranked as dense.
+        Declines (returns None -> sampling fallback) when any cost input
+        is unresolved, e.g. sparse host elements of unknown density."""
+        from ...analysis.spec import element_feature_dim
+
+        d = element_feature_dim(spec)
+        k = element_feature_dim(labels_spec) if labels_spec is not None \
+            else None
+        sparsity = getattr(spec, "sparsity", None)
+        if d is None or k is None or sparsity is None:
+            return None
+        return self._choose(n, d, k, sparsity,
+                            self.num_machines or num_machines, "static")
+
+    def _choose(self, n: int, d: int, k: int, sparsity: float,
+                machines: int, shape_source: str) -> NodeChoice:
         options = self.options
         costs = [
             (solver.cost(n, d, k, sparsity, machines, self.cpu_weight,
@@ -287,8 +316,9 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         trace = current_trace()
         if trace is not None:
             # the full decision surface: workload shape, every candidate's
-            # cost estimate, the pick, and where the weights came from —
-            # the record that makes a silent solver mis-ranking visible
+            # cost estimate, the pick, where the weights came from, and
+            # whether the shape was sampled or statically inferred — the
+            # record that makes a silent solver mis-ranking visible
             trace.record_solver_decision({
                 "estimator": type(self).__name__,
                 "n": n, "d": d, "k": k,
@@ -306,5 +336,6 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
                     "lat_weight": self.lat_weight,
                 },
                 "provenance": dict(self._weight_provenance),
+                "shape_source": shape_source,
             })
         return choice

@@ -154,6 +154,11 @@ class LinearMapEstimator(LabelEstimator):
     def __init__(self, lam: Optional[float] = None):
         self.lam = lam
 
+    def abstract_fit(self, dep_specs):
+        from ...analysis.spec import labels_width_fit
+
+        return labels_width_fit(dep_specs)
+
     def _fit(self, ds: Dataset, labels: Dataset) -> LinearMapper:
         ds, labels = ensure_array(ds), ensure_array(labels)
         n = ds.n
@@ -424,6 +429,11 @@ class BlockLeastSquaresEstimator(LabelEstimator):
     @property
     def weight(self) -> int:
         return 3 * self.num_iter + 1
+
+    def abstract_fit(self, dep_specs):
+        from ...analysis.spec import labels_width_fit
+
+        return labels_width_fit(dep_specs)
 
     def _fit(self, ds: Dataset, labels: Dataset) -> BlockLinearMapper:
         ds, labels = ensure_array(ds), ensure_array(labels)

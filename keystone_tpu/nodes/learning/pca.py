@@ -87,7 +87,27 @@ def _svd_pca(data: jnp.ndarray, dims: int) -> np.ndarray:
     return pca[:, :dims]
 
 
-class PCAEstimator(Estimator):
+class _PcaAbstractFitMixin:
+    """abstract_fit shared by every PCA estimator: the fitted projection
+    replaces the leading (descriptor) axis with ``dims``."""
+
+    def abstract_fit(self, dep_specs):
+        import jax
+
+        from ...analysis.spec import Unknown
+
+        dims = self.dims
+
+        def apply_element(element):
+            if isinstance(element, jax.ShapeDtypeStruct) and element.shape:
+                return jax.ShapeDtypeStruct(
+                    (dims,) + tuple(element.shape[1:]), element.dtype)
+            return Unknown("pca input not an array element")
+
+        return apply_element
+
+
+class PCAEstimator(_PcaAbstractFitMixin, Estimator):
     """Local PCA: collect the (sampled) data, center, SVD
     (reference PCA.scala:163-210)."""
 
@@ -121,7 +141,7 @@ def _center_masked(X, means, mask):
     return (X - means) * mask[:, None].astype(X.dtype)
 
 
-class DistributedPCAEstimator(Estimator):
+class DistributedPCAEstimator(_PcaAbstractFitMixin, Estimator):
     """Distributed PCA via TSQR: center by broadcast means, tree-QR to the
     small R factor, local SVD of R (reference DistributedPCA.scala:34-57)."""
 
@@ -172,7 +192,7 @@ def _randomized_svd_vt(X, omega, *, q: int):
         return vt
 
 
-class ApproximatePCAEstimator(Estimator):
+class ApproximatePCAEstimator(_PcaAbstractFitMixin, Estimator):
     """Randomized-sketch PCA, Halko-Martinsson-Tropp algs 4.4/5.1
     (reference ApproximatePCA.scala:38-86): Gaussian sketch, q power
     iterations with intermediate QRs, then SVD of the projected matrix."""
@@ -197,7 +217,7 @@ class ApproximatePCAEstimator(Estimator):
         return pca[:, : self.dims]
 
 
-class LocalColumnPCAEstimator(Estimator):
+class LocalColumnPCAEstimator(_PcaAbstractFitMixin, Estimator):
     """Fits PCA treating each column of per-item matrices as a sample
     (reference PCA.scala:51-76); emits BatchPCATransformer."""
 
@@ -210,7 +230,7 @@ class LocalColumnPCAEstimator(Estimator):
         return BatchPCATransformer(pca)
 
 
-class DistributedColumnPCAEstimator(Estimator):
+class DistributedColumnPCAEstimator(_PcaAbstractFitMixin, Estimator):
     """Distributed variant of the column PCA (reference PCA.scala:78-102)."""
 
     def __init__(self, dims: int):
@@ -224,7 +244,7 @@ class DistributedColumnPCAEstimator(Estimator):
         return BatchPCATransformer(fitted.pca_mat)
 
 
-class ColumnPCAEstimator(OptimizableEstimator):
+class ColumnPCAEstimator(_PcaAbstractFitMixin, OptimizableEstimator):
     """Cost-model-optimizable column PCA (reference PCA.scala:118-156):
     the node-level optimizer picks local vs distributed by the reference's
     calibrated cost models; until then it runs distributed."""
@@ -261,6 +281,20 @@ class ColumnPCAEstimator(OptimizableEstimator):
         items = sample.collect()
         cols_per_item = int(np.asarray(items[0]).shape[-1]) if items else 1
         d = int(np.asarray(items[0]).shape[0]) if items else 1
+        return self._choose(d, cols_per_item, n, num_machines)
+
+    def optimize_static(self, spec, n: int, num_machines: int):
+        """Static form: the (d, cols) item geometry comes from the
+        analyzer's element spec instead of a sampled matrix."""
+        element = getattr(spec, "element", None)
+        if not (isinstance(element, jax.ShapeDtypeStruct)
+                and len(element.shape) == 2):
+            return None
+        d, cols_per_item = (int(element.shape[0]), int(element.shape[1]))
+        return self._choose(d, cols_per_item, n, num_machines)
+
+    def _choose(self, d: int, cols_per_item: int, n: int,
+                num_machines: int) -> NodeChoice:
         total_cols = n * cols_per_item
         local = PCAEstimator(self.dims)
         dist = DistributedPCAEstimator(self.dims)

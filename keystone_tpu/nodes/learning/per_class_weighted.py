@@ -48,6 +48,11 @@ class PerClassWeightedLeastSquaresEstimator(LabelEstimator):
         self.mixture_weight = mixture_weight
         self.num_features = num_features
 
+    def abstract_fit(self, dep_specs):
+        from ...analysis.spec import labels_width_fit
+
+        return labels_width_fit(dep_specs)
+
     def _fit(self, ds: Dataset, labels: Dataset) -> BlockLinearMapper:
         ds, labels = ensure_array(ds), ensure_array(labels)
         return self._fit_sharded(ds, labels)

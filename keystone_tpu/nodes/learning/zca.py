@@ -46,6 +46,11 @@ class ZCAWhitenerEstimator(Estimator):
     def __init__(self, eps: float = 0.1):
         self.eps = eps
 
+    def abstract_fit(self, dep_specs):
+        from ...analysis.spec import identity_fit
+
+        return identity_fit(dep_specs)
+
     def fit_single(self, mat: np.ndarray) -> ZCAWhitener:
         W, means = _fit_zca(jnp.asarray(mat, jnp.float32), self.eps)
         return ZCAWhitener(np.asarray(W), np.asarray(means))

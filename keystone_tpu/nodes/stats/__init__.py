@@ -185,6 +185,11 @@ class StandardScaler(Estimator):
         self.normalize_std_dev = normalize_std_dev
         self.eps = eps
 
+    def abstract_fit(self, dep_specs):
+        from ...analysis.spec import identity_fit
+
+        return identity_fit(dep_specs)
+
     def _fit(self, ds: Dataset) -> StandardScalerModel:
         assert isinstance(ds, ArrayDataset), "StandardScaler needs array data"
         n = ds.n

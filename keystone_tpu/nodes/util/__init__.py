@@ -135,6 +135,16 @@ class Densify(Transformer):
         ]
         return ArrayDataset.from_items(dense)
 
+    def abstract_single(self, elements):
+        from ...analysis.spec import SparseSpec, Unknown
+
+        (e,) = elements
+        if isinstance(e, SparseSpec):
+            if e.size is None:
+                return Unknown("sparse element of unknown size")
+            return jax.ShapeDtypeStruct((e.size,), np.float32)
+        return super().abstract_single(elements)
+
 
 class Cast(Transformer):
     def __init__(self, dtype: str):
@@ -164,6 +174,15 @@ class LabelAugmenter(Transformer):
 
     def apply(self, x):
         return x
+
+    def abstract_eval(self, dep_specs):
+        from ...analysis.spec import DatasetSpec
+
+        out = super().abstract_eval(dep_specs)
+        if isinstance(out, DatasetSpec) and out.n is not None:
+            return DatasetSpec(out.element, n=out.n * self.mult,
+                               host=out.host, sparsity=out.sparsity)
+        return out
 
     def apply_dataset(self, ds: Dataset) -> Dataset:
         if isinstance(ds, ArrayDataset):

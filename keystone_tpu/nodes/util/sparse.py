@@ -136,6 +136,18 @@ class Sparsify(HostTransformer):
         idx = np.nonzero(x)[0]
         return SparseVector(idx, x[idx], x.shape[0])
 
+    def abstract_single(self, elements):
+        import jax
+
+        from ...analysis.spec import SparseSpec
+
+        (e,) = elements
+        if isinstance(e, SparseSpec):
+            return e
+        if isinstance(e, jax.ShapeDtypeStruct) and len(e.shape) == 1:
+            return SparseSpec(int(e.shape[0]))
+        return super().abstract_single(elements)
+
 
 class SparseFeatureVectorizer(HostTransformer):
     """(feature, value) pairs -> SparseVector over a fixed feature space

@@ -73,12 +73,23 @@ class Histogram:
     def mean(self) -> float:
         return self.total / self.count if self.count else 0.0
 
+    def percentile(self, q: float) -> float:
+        """Approximate percentile over the retained tail (the most
+        recent ``TAIL`` observations), 0 <= q <= 100."""
+        if not self._tail:
+            return 0.0
+        ordered = sorted(self._tail)
+        idx = min(len(ordered) - 1,
+                  max(0, int(round(q / 100.0 * (len(ordered) - 1)))))
+        return ordered[idx]
+
     def snapshot(self) -> Dict[str, float]:
         if not self.count:
             return {"count": 0, "total": 0.0, "mean": 0.0,
                     "min": 0.0, "max": 0.0}
         return {"count": self.count, "total": self.total, "mean": self.mean,
-                "min": self.min, "max": self.max}
+                "min": self.min, "max": self.max,
+                "p50": self.percentile(50), "p99": self.percentile(99)}
 
 
 class MetricsRegistry:

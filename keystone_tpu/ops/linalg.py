@@ -407,6 +407,11 @@ def _bcd_scan_body(blocks, Y, lam, *, num_passes: int):
         # breakdown recovery, same policy as the unrolled path: the
         # Gram is recomputed only inside the rarely-taken branch
         W = _finite_or_eigh_solve(W, lambda: gram(A) + eye, rhs, ok=ok)
+        if w_spec is not None:
+            # the triangular solve + recovery select would otherwise let
+            # GSPMD replicate the block weights across 'model'; the
+            # returned Ws must stay class-sharded
+            W = jax.lax.with_sharding_constraint(W, w_spec)
         pred = pred + A @ (W - W_old)
         return pred, W
 
@@ -462,6 +467,11 @@ def _bcd_core_body(blocks, Y, lam, *, num_passes: int):
                 rhs,
                 ok=factor_ok[i],
             )
+            if w_spec is not None:
+                # keep the returned block weights class-sharded (the
+                # solve + recovery select would otherwise replicate
+                # them across 'model')
+                Wi = jax.lax.with_sharding_constraint(Wi, w_spec)
             pred = pred + A @ (Wi - Ws[i])
             Ws[i] = Wi
     return Ws
@@ -473,8 +483,14 @@ def _bcd_jit_for(mesh):
     pass count hit the warm executable (a fresh jit(partial(...)) per fit
     recompiled), while the trace-time sharding constraints from
     ``_class_spec`` (which read the ambient mesh) can never leak across
-    meshes."""
-    return jax.jit(bcd_core, static_argnames=("num_passes",))
+    meshes. The per-mesh closure matters: jax's jaxpr trace cache is
+    keyed on the *function object*, so ``jax.jit(bcd_core, ...)`` built
+    for a second mesh would silently reuse the first mesh's trace — and
+    its baked-in class-sharding constraints."""
+    def _bcd_core_on_mesh(blocks, Y, lam, *, num_passes: int):
+        return bcd_core(blocks, Y, lam, num_passes=num_passes)
+
+    return jax.jit(_bcd_core_on_mesh, static_argnames=("num_passes",))
 
 
 def solve_one_pass_l2(
@@ -540,11 +556,26 @@ def tsqr_r(A: jax.Array) -> jax.Array:
     return _fix_r_sign(_tsqr_run(mesh)(A))
 
 
+def _shard_map():
+    """(shard_map, replication-check kwargs): jax >= 0.6 exports it
+    top-level with ``check_vma``; older jax only has the experimental
+    module with ``check_rep``. The check is disabled either way — the
+    all-gathered R stack is deliberately replicated."""
+    try:
+        from jax import shard_map as sm
+
+        return sm, {"check_vma": False}
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+
+        return sm, {"check_rep": False}
+
+
 @functools.lru_cache(maxsize=None)
 def _tsqr_run(mesh):
     """Jitted TSQR body, one compiled program per mesh (a nested jit
     here would recompile on every call)."""
-    from jax import shard_map
+    shard_map, check_kw = _shard_map()
 
     @jax.jit
     def run(A):
@@ -560,7 +591,7 @@ def _tsqr_run(mesh):
             mesh=mesh,
             in_specs=P("data", None),
             out_specs=P(),
-            check_vma=False,
+            **check_kw,
         )(A)
 
     return run

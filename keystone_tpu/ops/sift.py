@@ -160,24 +160,36 @@ def _sampling_operator(length: int, lo: int, step: int,
 #: Band-matmul precision. HIGH (3-pass bf16 ≈ f32) measured 577 img/s
 #: vs HIGHEST's 412 on the 480x640 rehearsal batch; quantized
 #: descriptors stay within the golden test's envelope either way (CPU
-#: tests ignore the flag and run exact f32).
+#: tests ignore the flag and run exact f32). The claim is PINNED by a
+#: device-mode parity gate (``tools/profile_imagenet.py`` runs a
+#: HIGH-vs-HIGHEST descriptor comparison every profile;
+#: ``tests/test_golden_fixtures.py::test_dense_sift_high_precision_parity``
+#: is the @slow test form), so bf16 quantization drift cannot ship
+#: unnoticed (ADVICE medium#2).
 _PRECISION = jax.lax.Precision.HIGH
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("height", "width", "step", "bin_size", "lo"),
+    static_argnames=("height", "width", "step", "bin_size", "lo",
+                     "precision"),
 )
-def _dsift_one_scale(img, height, width, step, bin_size, lo):
+def _dsift_one_scale(img, height, width, step, bin_size, lo,
+                     precision=None):
     """Dense SIFT at one scale. Returns (128, numDesc) NORMALIZED,
     quantized descriptors. All heavy lifting is band-matrix matmuls
     (MXU): smoothing via ``_smooth_band``, spatial binning + sampling
     via ``_sampling_operator``; normalization runs in the binned
-    layout so no (N, 128) round-trip transpose is materialized."""
+    layout so no (N, 128) round-trip transpose is materialized.
+
+    ``precision`` overrides the module default for the band matmuls —
+    static, so each precision gets its own compiled program (the parity
+    gate compares HIGH against HIGHEST on identical inputs)."""
+    precision = _PRECISION if precision is None else precision
     Gy = jnp.asarray(_smooth_band(height, bin_size))
     Gx = jnp.asarray(_smooth_band(width, bin_size))
     smoothed = jnp.einsum("ih,hw,jw->ij", Gy, img, Gx,
-                          precision=_PRECISION)
+                          precision=precision)
     omaps = _orientation_maps(smoothed)            # (8, H, W)
 
     Ty, ny = _sampling_operator(height, lo, step, bin_size)
@@ -186,7 +198,7 @@ def _dsift_one_scale(img, height, width, step, bin_size, lo):
         return jnp.zeros((DIMS, 0), smoothed.dtype)
     # (8, NBP*ny, NBP*nx): spatial bin (by, bx) of descriptor (iy, ix)
     bins = jnp.einsum("ph,ohw,qw->opq", jnp.asarray(Ty), omaps,
-                      jnp.asarray(Tx), precision=_PRECISION)
+                      jnp.asarray(Tx), precision=precision)
     return _normalize_quantize_binned(
         bins.reshape(NBO, NBP, ny, NBP, nx))
 
@@ -228,12 +240,14 @@ def dense_sift(
     bin_size: int = 6,
     num_scales: int = 5,
     scale_step: int = 0,
+    precision=None,
 ) -> jax.Array:
     """Multi-scale dense SIFT of a grayscale (H, W) image in [0, 1].
 
     Returns (128, numDesc) float32, scales concatenated in order —
     matching ``VLFeat.getSIFTs`` (reference
-    ``utils/external/VLFeat.scala:17-27``).
+    ``utils/external/VLFeat.scala:17-27``). ``precision`` overrides the
+    band-matmul default (parity gating; None = module default HIGH).
     """
     height, width = int(img_gray.shape[0]), int(img_gray.shape[1])
     outs: List[jax.Array] = []
@@ -241,7 +255,8 @@ def dense_sift(
         s, scale_value, lo = _scale_params(
             scale, step, bin_size, num_scales, scale_step)
         outs.append(_dsift_one_scale(
-            img_gray, height, width, s, scale_value, lo))
+            img_gray, height, width, s, scale_value, lo,
+            precision=precision))
     return jnp.concatenate(outs, axis=1)  # (128, N)
 
 

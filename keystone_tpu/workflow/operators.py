@@ -52,6 +52,15 @@ class Operator:
     def execute(self, deps: Sequence[Expression]) -> Expression:
         raise NotImplementedError
 
+    def abstract_eval(self, dep_specs: Sequence[Any]) -> Any:
+        """Static analogue of ``execute``: map the dependencies' abstract
+        values (``analysis.spec``) to this node's output spec, without
+        touching a device. The default declines — the analyzer treats
+        that as Unknown and propagates silently (never a diagnostic)."""
+        from ..analysis.spec import Unknown
+
+        return Unknown(f"{type(self).__name__} has no abstract_eval")
+
     def label(self) -> str:
         return type(self).__name__
 
@@ -101,6 +110,11 @@ class DatasetOperator(Operator):
         assert not deps
         return DatasetExpression(self.dataset, eager=True)
 
+    def abstract_eval(self, dep_specs: Sequence[Any]) -> Any:
+        from ..analysis.spec import dataset_spec
+
+        return dataset_spec(self.dataset)
+
     def label(self) -> str:
         return "Dataset"
 
@@ -117,6 +131,11 @@ class DatumOperator(Operator):
     def execute(self, deps: Sequence[Expression]) -> Expression:
         assert not deps
         return DatumExpression(self.datum, eager=True)
+
+    def abstract_eval(self, dep_specs: Sequence[Any]) -> Any:
+        from ..analysis.spec import datum_spec
+
+        return datum_spec(self.datum)
 
     def label(self) -> str:
         return "Datum"
@@ -141,6 +160,54 @@ class TransformerOperator(Operator):
             lambda: self.single_transform([d.get() for d in deps])
         )
 
+    # -- static analysis ---------------------------------------------------
+    def abstract_single(self, elements: Sequence[Any]) -> Any:
+        """Per-item shape propagation mirroring ``single_transform``,
+        via ``jax.eval_shape`` (abstract: no device buffers). Raises on
+        shape/dtype errors and on host-sync hazards (``np.asarray`` on a
+        tracer) — the interpreter classifies those into diagnostics.
+        Nodes whose per-item function is not jax-traceable (host
+        stages) override this to return Unknown or a bespoke spec."""
+        from ..analysis.spec import Unknown, element_has_unknown
+
+        if any(element_has_unknown(e) for e in elements):
+            return Unknown("input element not fully specified")
+        import jax
+
+        return jax.eval_shape(
+            lambda *xs: self.single_transform(list(xs)), *elements)
+
+    def abstract_eval(self, dep_specs: Sequence[Any]) -> Any:
+        """Type dispatch mirroring ``execute``: dataset in -> dataset
+        out (element-wise ``abstract_single``), else datum. Operators
+        whose batch path changes the ITEM COUNT (samplers, augmenters)
+        must override to adjust ``n``."""
+        from ..analysis.spec import (
+            DatasetSpec,
+            DatumSpec,
+            Unknown,
+            dense_sparsity,
+            is_unknown,
+        )
+
+        if any(is_unknown(d) for d in dep_specs):
+            return Unknown("unknown input")
+        if not all(isinstance(d, (DatasetSpec, DatumSpec))
+                   for d in dep_specs):
+            return Unknown("non-data input")
+        elements = [d.element for d in dep_specs]
+        out = self.abstract_single(elements)
+        datasets = [d for d in dep_specs if isinstance(d, DatasetSpec)]
+        if not datasets:
+            return DatumSpec(out)
+        ns = [d.n for d in datasets if d.n is not None]
+        return DatasetSpec(
+            out,
+            n=min(ns) if ns else None,  # zip semantics across inputs
+            host=all(d.host for d in datasets),
+            sparsity=dense_sparsity(out),
+        )
+
 
 class EstimatorOperator(Operator):
     """Fits on datasets, yielding a TransformerOperator
@@ -153,6 +220,22 @@ class EstimatorOperator(Operator):
         return TransformerExpression(
             lambda: self.fit_datasets([d.get() for d in deps])
         )
+
+    # -- static analysis ---------------------------------------------------
+    def abstract_fit(self, dep_specs: Sequence[Any]):
+        """Describe the fitted transformer: return a callable mapping an
+        input element spec to the fitted transformer's output element
+        spec, or None when this estimator does not declare one (the
+        delegating child's output then propagates as Unknown). Estimators
+        with statically known output shapes (linear models: d -> k,
+        scalers: identity, PCA: d -> dims) override this."""
+        return None
+
+    def abstract_eval(self, dep_specs: Sequence[Any]) -> Any:
+        from ..analysis.spec import TransformerSpec
+
+        return TransformerSpec(
+            self.abstract_fit(dep_specs), label=self.label())
 
 
 class DelegatingOperator(Operator):
@@ -172,6 +255,29 @@ class DelegatingOperator(Operator):
             lambda: t.get().single_transform([d.get() for d in data])
         )
 
+    def abstract_eval(self, dep_specs: Sequence[Any]) -> Any:
+        from ..analysis.spec import (
+            DatasetSpec,
+            DatumSpec,
+            TransformerSpec,
+            Unknown,
+            dense_sparsity,
+        )
+
+        if not dep_specs or not isinstance(dep_specs[0], TransformerSpec):
+            return Unknown("delegating without a transformer spec")
+        t, data = dep_specs[0], dep_specs[1:]
+        if t.apply_element is None:
+            return Unknown(f"opaque fitted transformer {t.label}")
+        if len(data) != 1 or not isinstance(
+                data[0], (DatasetSpec, DatumSpec)):
+            return Unknown("delegating input not resolvable")
+        out = t.apply_element(data[0].element)
+        if isinstance(data[0], DatumSpec):
+            return DatumSpec(out)
+        return DatasetSpec(out, n=data[0].n, host=data[0].host,
+                           sparsity=dense_sparsity(out))
+
     def label(self) -> str:
         return "Delegate"
 
@@ -188,6 +294,13 @@ class ExpressionOperator(Operator):
 
     def execute(self, deps: Sequence[Expression]) -> Expression:
         return self.expression
+
+    def abstract_eval(self, dep_specs: Sequence[Any]) -> Any:
+        from ..analysis.spec import Unknown, value_spec
+
+        if self.expression.computed:
+            return value_spec(self.expression.get())
+        return Unknown("saved expression not yet computed")
 
     def label(self) -> str:
         return "Saved"

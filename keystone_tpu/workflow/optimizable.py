@@ -50,6 +50,13 @@ class OptimizableTransformer(Transformer):
     def optimize(self, sample: Dataset, n: int, num_machines: int) -> NodeChoice:
         raise NotImplementedError
 
+    def optimize_static(self, spec, n: int, num_machines: int):
+        """Cost-model choice from the static analyzer's input spec
+        (``analysis.spec.DatasetSpec``) instead of a sampled execution.
+        Return a NodeChoice, or None to fall back to sampling (the
+        default: nodes whose cost inputs are not statically derivable)."""
+        return None
+
 
 class OptimizableEstimator(Estimator):
     """An estimator with implementation choices
@@ -64,6 +71,10 @@ class OptimizableEstimator(Estimator):
 
     def optimize(self, sample: Dataset, n: int, num_machines: int) -> NodeChoice:
         raise NotImplementedError
+
+    def optimize_static(self, spec, n: int, num_machines: int):
+        """See :meth:`OptimizableTransformer.optimize_static`."""
+        return None
 
 
 class OptimizableLabelEstimator(LabelEstimator):
@@ -80,3 +91,9 @@ class OptimizableLabelEstimator(LabelEstimator):
     def optimize(self, sample: Dataset, sample_labels: Dataset, n: int,
                  num_machines: int) -> NodeChoice:
         raise NotImplementedError
+
+    def optimize_static(self, spec, n: int, num_machines: int,
+                        labels_spec=None):
+        """See :meth:`OptimizableTransformer.optimize_static`; label
+        estimators additionally receive the labels' DatasetSpec."""
+        return None

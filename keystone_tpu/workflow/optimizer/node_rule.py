@@ -13,6 +13,24 @@ into the graph:
   dependency AND on the runtime input of every delegating child — the
   same two-endpoint splice the reference performs on its instruction
   list (``NodeOptimizationRule.scala:82-299``).
+
+Static-first: before sampling, the rule runs the abstract interpreter
+(``analysis.interpreter.analyze``) over the graph (once per graph
+state — splices invalidate the cached analysis). When the optimizable
+node's data (and labels) dependencies resolve to full DatasetSpecs —
+known n, element dims, storage density — the node's ``optimize_static``
+hook is consulted, and if it returns a choice the sampled execution is
+skipped entirely: no data is loaded, no device program runs, and the
+PipelineTrace records the decision with ``"provenance": "static"``.
+Unresolved shapes (host stages, sparse elements of unknown density)
+fall back to the reference's sampling path (``"sampled"``).
+
+The static path's sparsity input is STRUCTURAL (1.0 for dense storage),
+not the value-level density a sample would measure; workloads whose
+dense-stored data is mostly zeros (and where a Sparsify -> sparse
+solver could win) can force the reference behavior with
+``NodeOptimizationRule(static_shapes=False)`` or the environment knob
+``KEYSTONE_STATIC_NODE_OPT=0``.
 """
 from __future__ import annotations
 
@@ -55,9 +73,16 @@ def _sample_dataset(ds: Dataset, size: int) -> Dataset:
 
 class NodeOptimizationRule(Rule):
     def __init__(self, sample_size: int = DEFAULT_SAMPLE_SIZE,
-                 num_machines: Optional[int] = None):
+                 num_machines: Optional[int] = None,
+                 static_shapes: Optional[bool] = None):
+        import os
+
         self.sample_size = sample_size
         self.num_machines = num_machines
+        if static_shapes is None:
+            static_shapes = os.environ.get(
+                "KEYSTONE_STATIC_NODE_OPT", "1") not in ("0", "false", "no")
+        self.static_shapes = static_shapes
 
     # -- sampling ---------------------------------------------------------
     def _execute_sampled(self, graph: Graph, deps: Tuple[GraphId, ...]):
@@ -135,14 +160,40 @@ class NodeOptimizationRule(Rule):
         graph = graph.set_operator(node, choice.node)
         return graph.set_dependencies(node, tuple(new_deps))
 
+    # -- static path ------------------------------------------------------
+    @staticmethod
+    def _static_choice(analysis, graph: Graph, node: NodeId, op,
+                       machines: int) -> Optional[Tuple[NodeChoice, int]]:
+        """Resolve the node's choice from statically inferred shapes, or
+        None when the analyzer (or the node) declines."""
+        from ...analysis.spec import DatasetSpec
+
+        deps = graph.get_dependencies(node)
+        data_spec = analysis.value(deps[0]) if deps else None
+        if not isinstance(data_spec, DatasetSpec) or data_spec.n is None:
+            return None
+        n = data_spec.n
+        if isinstance(op, OptimizableLabelEstimator):
+            if len(deps) < 2:
+                return None
+            labels_spec = analysis.value(deps[1])
+            if not isinstance(labels_spec, DatasetSpec):
+                return None
+            choice = op.optimize_static(
+                data_spec, n, machines, labels_spec=labels_spec)
+        else:
+            choice = op.optimize_static(data_spec, n, machines)
+        return None if choice is None else (choice, n)
+
     # -- trace hook -------------------------------------------------------
     @staticmethod
     def _record_choice(node: NodeId, op, choice: NodeChoice, n: int,
-                       machines: int, wall_s: float) -> None:
+                       machines: int, wall_s: float,
+                       provenance: str) -> None:
         """Log the splice decision to the active trace (the detailed
         per-solver cost table is recorded by the optimizable node itself,
         e.g. ``LeastSquaresEstimator.optimize`` — this entry ties it to a
-        graph node and the sampling cost)."""
+        graph node, the shape provenance, and the sampling cost)."""
         from ...observability.trace import current_trace
 
         trace = current_trace()
@@ -156,6 +207,7 @@ class NodeOptimizationRule(Rule):
             "full_n": n,
             "num_machines": machines,
             "sample_and_optimize_s": wall_s,
+            "provenance": provenance,
         })
 
     # -- rule entry -------------------------------------------------------
@@ -166,30 +218,46 @@ class NodeOptimizationRule(Rule):
         downstream = graph.source_descendants()
 
         machines = self.num_machines or num_data_shards(get_mesh())
+        # one abstract interpretation serves every optimizable node on
+        # the same graph state; a splice mutates the graph and drops it
+        cached_analysis = None
         for node in graph.linearize():
             if not isinstance(node, NodeId) or node not in graph.nodes:
                 continue
             op = graph.get_operator(node)
             if node in downstream:
                 continue
+            if not isinstance(op, (OptimizableLabelEstimator,
+                                   OptimizableEstimator,
+                                   OptimizableTransformer)):
+                continue
             t0 = time.perf_counter()
-            if isinstance(op, OptimizableLabelEstimator):
-                (sample, sample_labels), n = self._execute_sampled(
-                    graph, graph.get_dependencies(node)[:2])
-                choice = op.optimize(sample, sample_labels, n, machines)
-                graph = self._splice_estimator(graph, node, choice)
-            elif isinstance(op, OptimizableEstimator):
-                (sample,), n = self._execute_sampled(
-                    graph, graph.get_dependencies(node)[:1])
-                choice = op.optimize(sample, n, machines)
-                graph = self._splice_estimator(graph, node, choice)
-            elif isinstance(op, OptimizableTransformer):
-                (sample,), n = self._execute_sampled(
-                    graph, graph.get_dependencies(node)[:1])
-                choice = op.optimize(sample, n, machines)
+            static = None
+            if self.static_shapes:
+                if cached_analysis is None:
+                    from ...analysis.interpreter import analyze
+
+                    cached_analysis = analyze(graph)
+                static = self._static_choice(
+                    cached_analysis, graph, node, op, machines)
+            if static is not None:
+                choice, n = static
+                provenance = "static"
+            else:
+                provenance = "sampled"
+                if isinstance(op, OptimizableLabelEstimator):
+                    (sample, sample_labels), n = self._execute_sampled(
+                        graph, graph.get_dependencies(node)[:2])
+                    choice = op.optimize(sample, sample_labels, n, machines)
+                else:
+                    (sample,), n = self._execute_sampled(
+                        graph, graph.get_dependencies(node)[:1])
+                    choice = op.optimize(sample, n, machines)
+            if isinstance(op, OptimizableTransformer):
                 graph = self._splice_transformer(graph, node, choice)
             else:
-                continue
+                graph = self._splice_estimator(graph, node, choice)
+            cached_analysis = None  # splice changed the graph
             self._record_choice(node, op, choice, n, machines,
-                                time.perf_counter() - t0)
+                                time.perf_counter() - t0, provenance)
         return graph

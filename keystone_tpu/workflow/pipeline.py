@@ -143,6 +143,18 @@ class Chainable:
             return self.bind(data)
         return self.bind_datum(data)
 
+    def check(self, sample: Any = None, name: str = "pipeline"):
+        """Statically check this stage/pipeline: propagate shape/dtype
+        specs from ``sample`` (a ``jax.ShapeDtypeStruct``,
+        ``(shape, dtype)`` tuple, array, Dataset, or ``analysis`` spec
+        describing ONE input item) through every node without touching
+        a device, and run the graph lints. Returns an
+        :class:`~keystone_tpu.analysis.AnalysisReport`; inspect
+        ``report.ok`` / ``report.diagnostics`` / ``report.summary()``."""
+        from ..analysis import check_pipeline
+
+        return check_pipeline(self, sample, name=name)
+
 
 class Pipeline(Chainable):
     """A DAG with one dangling source (input) and one sink (output)."""

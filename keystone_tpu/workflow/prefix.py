@@ -6,6 +6,17 @@ dependencies. Nodes whose ancestry reaches an unconnected Source have no
 prefix (their value depends on unbound input). Prefixes key the global
 ``PipelineEnv.state`` memo so that re-running a pipeline (or a different
 pipeline sharing a fitted prefix) reuses already-computed expressions.
+
+Prefixes are CANONICAL under map/gather fusion: a
+``FusedTransformer([a, b, c])`` node contributes exactly the prefix of
+the unfused ``a >> b >> c`` chain, and a ``FusedGatherTransformer``
+contributes the unfused gather-of-branches prefix. Fitted state is
+saved at executor time — on the OPTIMIZED (fused) graph — while
+``SavedStateLoadRule`` matches on the next run's RAW (unfused) graph;
+without canonicalization the two signatures never meet, so any pipeline
+whose pre-estimator chain fuses silently refits every run (the
+cache-miss recorded in CHANGES.md PR 1, surfaced statically by the
+``fusion-prefix-hazard`` lint in ``analysis/diagnostics.py``).
 """
 from __future__ import annotations
 
@@ -13,13 +24,36 @@ from typing import Dict, Optional, Tuple
 
 from .graph import Graph
 from .graph_ids import GraphId, NodeId, SourceId
+from .operators import Operator
+
+
+def operator_prefix(op: Operator, dep_prefixes: Tuple) -> Tuple:
+    """Canonical prefix contribution of one operator given its
+    dependencies' prefixes — fused operators expand to the prefix of the
+    equivalent unfused subgraph."""
+    from .optimizer.fusion import FusedGatherTransformer, FusedTransformer
+
+    if isinstance(op, FusedTransformer):
+        (cur,) = dep_prefixes
+        for stage in op.stages:
+            cur = operator_prefix(stage, (cur,))
+        return cur
+    if isinstance(op, FusedGatherTransformer):
+        from .pipeline import GatherTransformerOperator
+
+        (p,) = dep_prefixes
+        branch_ps = tuple(
+            operator_prefix(b, (p,)) for b in op.branches)
+        gather = GatherTransformerOperator(len(op.branches))
+        return ("prefix", gather._cached_eq_key(), branch_ps)
+    return ("prefix", op._cached_eq_key(), tuple(dep_prefixes))
 
 
 def compute_prefix(
     graph: Graph, gid: GraphId, _memo: Optional[Dict[GraphId, Optional[Tuple]]] = None
 ) -> Optional[Tuple]:
-    """Structural prefix of ``gid`` in ``graph``, or None if it depends on
-    an unconnected source."""
+    """Canonical structural prefix of ``gid`` in ``graph``, or None if it
+    depends on an unconnected source."""
     memo: Dict[GraphId, Optional[Tuple]] = _memo if _memo is not None else {}
     if gid in memo:
         return memo[gid]
@@ -35,6 +69,6 @@ def compute_prefix(
             memo[gid] = None
             return None
         dep_prefixes.append(p)
-    result = ("prefix", graph.get_operator(gid)._cached_eq_key(), tuple(dep_prefixes))
+    result = operator_prefix(graph.get_operator(gid), tuple(dep_prefixes))
     memo[gid] = result
     return result

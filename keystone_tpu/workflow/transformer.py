@@ -252,3 +252,21 @@ class HostTransformer(Transformer):
         if isinstance(ds, ArrayDataset):
             ds = HostDataset(ds.collect())
         return ds.map(self.apply)
+
+    def abstract_single(self, elements: Sequence[Any]) -> Any:
+        """Host stages run arbitrary Python — not shape-propagatable via
+        eval_shape. Subclasses with known output specs (Sparsify,
+        Densify-style codecs) override this."""
+        from ..analysis.spec import Unknown
+
+        return Unknown(f"host stage {self.label()}")
+
+    def abstract_eval(self, dep_specs: Sequence[Any]) -> Any:
+        from ..analysis.spec import DatasetSpec
+
+        out = super().abstract_eval(dep_specs)
+        if isinstance(out, DatasetSpec):
+            # the batch path collects to host before mapping
+            return DatasetSpec(out.element, n=out.n, host=True,
+                               sparsity=out.sparsity)
+        return out

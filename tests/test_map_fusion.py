@@ -410,3 +410,72 @@ def test_lru_memo_rejects_none_and_is_locked():
     assert memo.get("a") == 1
     memo.put("c", 3)  # evicts LRU ("b": "a" was touched)
     assert memo.get("b") is None and memo.get("a") == 1 and memo.get("c") == 3
+
+
+def test_fused_prefix_chain_hits_saved_state(mesh8):
+    """Regression for the CHANGES.md PR 1 cache-miss: prefixes are
+    canonical under map fusion, so a pipeline whose pre-estimator chain
+    fuses still re-matches its saved fitted state when the SAME pipeline
+    is rebuilt from scratch (SavedStateLoadRule hits, no refit)."""
+    from keystone_tpu.observability.metrics import MetricsRegistry
+    from keystone_tpu.parallel.dataset import ArrayDataset
+    from keystone_tpu.workflow.estimator import LambdaEstimator
+
+    fits = []
+
+    def fit_fn(ds):
+        fits.append(1)
+        m = float(np.mean(ds.numpy()))
+        return t(lambda x, m=m: x - m, "center")
+
+    est = LambdaEstimator(fit_fn, "E")
+    a, b = t(lambda x: x + 1.0, "a"), t(lambda x: x * 2.0, "b")
+    train = ArrayDataset.from_numpy(
+        np.arange(8.0).reshape(8, 1).astype(np.float32), tag="fused-prefix")
+
+    out1 = (a >> b).and_then(est, train)(train).get().numpy()
+    assert len(fits) == 1
+    # rebuild from scratch: raw graph is unfused, saved state was keyed
+    # on the executor's FUSED graph — canonical prefixes must match
+    out2 = (a >> b).and_then(est, train)(train).get().numpy()
+    assert len(fits) == 1, "fused pre-estimator chain missed saved state"
+    np.testing.assert_allclose(out1, out2)
+    hits = MetricsRegistry.get_or_create().counter(
+        "executor.prefix_hits").value
+    assert hits >= 1
+
+
+def test_fused_gather_prefix_hits_saved_state(mesh8):
+    """Gather-fusion variant (the MNIST/TIMIT shape): branches + gather
+    collapse into one FusedGatherTransformer, and the estimator
+    downstream still re-matches saved state across rebuilds."""
+    from keystone_tpu.nodes.util import VectorCombiner
+    from keystone_tpu.observability.metrics import MetricsRegistry
+    from keystone_tpu.parallel.dataset import ArrayDataset
+    from keystone_tpu.workflow.estimator import LambdaEstimator
+    from keystone_tpu.workflow.pipeline import Pipeline
+
+    fits = []
+
+    def fit_fn(ds):
+        fits.append(1)
+        return t(lambda x: x, "id")
+
+    # stages are hoisted: LambdaTransformer's identity is its function
+    # object, and a fresh lambda per build would change the prefix
+    # legitimately (different node content, not a fusion artifact)
+    g1, g2 = t(lambda x: x + 1.0, "g1"), t(lambda x: x * 2.0, "g2")
+    combiner, est = VectorCombiner(), LambdaEstimator(fit_fn, "E")
+
+    def build():
+        feat = Pipeline.gather([g1, g2]) >> combiner
+        return feat.and_then(est, train)
+
+    train = ArrayDataset.from_numpy(
+        np.arange(8.0).reshape(8, 1).astype(np.float32),
+        tag="fused-gather-prefix")
+    out1 = build()(train).get().numpy()
+    assert len(fits) == 1
+    out2 = build()(train).get().numpy()
+    assert len(fits) == 1, "fused gather chain missed saved state"
+    np.testing.assert_allclose(out1, out2)

@@ -1,0 +1,331 @@
+"""Static pipeline analyzer (keystone_tpu/analysis): abstract shape
+propagation over every bundled app pipeline, plus targeted tests that
+each lint fires on a deliberately broken graph and that the node-level
+optimizer consumes statically inferred shapes instead of sampling."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.analysis import (
+    DatasetSpec,
+    SpecDataset,
+    Unknown,
+    check_graph,
+    spec_dataset,
+)
+from keystone_tpu.analysis.diagnostics import (
+    apply_body_host_coercions,
+    fusion_prefix_lint,
+)
+from keystone_tpu.pipelines import CHECK_APPS, resolve_check_app
+from keystone_tpu.workflow.pipeline import Pipeline
+from keystone_tpu.workflow.transformer import (
+    HostTransformer,
+    LambdaTransformer,
+    Transformer,
+)
+
+
+def t(fn, name):
+    return LambdaTransformer(fn, name)
+
+
+# -- every bundled app is statically clean ----------------------------------
+
+@pytest.mark.parametrize("app", sorted(CHECK_APPS))
+def test_bundled_app_checks_clean(app, mesh8):
+    target = CHECK_APPS[app]()
+    report = target.pipeline.check(target.input_spec, name=target.name)
+    assert report.ok, "\n".join(str(d) for d in report.diagnostics)
+    # every app resolves every node's spec — host-featurized text apps
+    # included, because Unknown propagation is silent but check_graph
+    # still assigns a value to each node
+    assert len(report.analysis.values) > 0
+    # the JSON form round-trips through the observability report style
+    blob = report.to_dict()
+    assert blob["name"] == target.name
+    assert blob["diagnostics"] == []
+
+
+def test_check_resolves_all_nodes_for_array_apps(mesh8):
+    # dense-array apps resolve 100% of their nodes (no Unknown leaks)
+    for app in ("mnist.random_fft", "cifar.linear_pixels", "speech.timit"):
+        target = resolve_check_app(app)()
+        report = target.pipeline.check(target.input_spec, name=app)
+        assert report.resolved_nodes() == len(report.analysis.graph.nodes)
+
+
+def test_check_allocates_no_device_buffers(mesh8):
+    # live_arrays is process-global: other tests' buffers may be alive,
+    # so assert check() itself creates none (the CLI path is verified
+    # from a clean interpreter by tools/lint.py / `check --all`)
+    before = {id(a) for a in jax.live_arrays()}
+    target = resolve_check_app("mnist_random_fft")()
+    report = target.pipeline.check(target.input_spec)
+    assert report.ok
+    new = [a for a in jax.live_arrays() if id(a) not in before]
+    assert not new, [(a.shape, a.dtype) for a in new[:5]]
+
+
+def test_spec_dataset_refuses_execution():
+    ds = spec_dataset((8,), np.float32, n=16)
+    assert len(ds) == 16
+    with pytest.raises(RuntimeError, match="static-analysis placeholder"):
+        ds.collect()
+    with pytest.raises(RuntimeError):
+        ds.map(lambda x: x)
+
+
+# -- lints fire on broken graphs --------------------------------------------
+
+def test_shape_mismatch_lint_fires(mesh8):
+    # a 784-wide sign mask applied to a 32-dim input: the einsum-level
+    # error surfaces at graph-check time, not minutes into a device run
+    from keystone_tpu.nodes.stats import RandomSignNode
+
+    pipe = t(lambda x: x * 2.0, "ok") >> RandomSignNode(np.ones(784))
+    report = pipe.check(jax.ShapeDtypeStruct((32,), np.float32))
+    codes = {d.code for d in report.diagnostics}
+    assert "shape-mismatch" in codes
+    bad = [d for d in report.diagnostics if d.code == "shape-mismatch"]
+    assert bad[0].operator == "RandomSignNode"
+
+
+def test_shape_mismatch_does_not_cascade(mesh8):
+    # one real error, not one per downstream node
+    from keystone_tpu.nodes.stats import RandomSignNode
+
+    pipe = (RandomSignNode(np.ones(784)) >> t(lambda x: x + 1, "a")
+            >> t(lambda x: x * 2, "b"))
+    report = pipe.check(jax.ShapeDtypeStruct((32,), np.float32))
+    assert len([d for d in report.diagnostics
+                if d.code == "shape-mismatch"]) == 1
+
+
+def test_dtype_narrowing_lint_fires(mesh8):
+    pipe = (t(lambda x: x + 1.0, "f32")
+            >> t(lambda x: x.astype(jnp.bfloat16), "narrow")
+            >> t(lambda x: x * 2, "after"))
+    report = pipe.check(jax.ShapeDtypeStruct((8,), np.float32))
+    narrow = [d for d in report.diagnostics if d.code == "dtype-narrowing"]
+    assert len(narrow) == 1 and narrow[0].operator == "narrow"
+
+
+def test_dtype_narrowing_respects_narrowing_ok(mesh8):
+    class DeliberateCast(Transformer):
+        narrowing_ok = True
+
+        def apply(self, x):
+            return x.astype(jnp.bfloat16)
+
+    pipe = t(lambda x: x + 1.0, "f32") >> DeliberateCast()
+    report = pipe.check(jax.ShapeDtypeStruct((8,), np.float32))
+    assert not [d for d in report.diagnostics
+                if d.code == "dtype-narrowing"]
+
+
+def test_unbound_source_lint_fires(mesh8):
+    pipe = t(lambda x: x + 1.0, "a") >> t(lambda x: x * 2.0, "b")
+    report = pipe.check()  # no sample bound to the source
+    assert [d for d in report.diagnostics if d.code == "unbound-source"]
+
+
+def test_dead_branch_lint_fires(mesh8):
+    from keystone_tpu.workflow.graph import Graph
+    from keystone_tpu.workflow.operators import DatasetOperator
+
+    g = Graph()
+    g, live = g.add_node(DatasetOperator(spec_dataset((4,), n=8)), ())
+    g, sink = g.add_sink(live)
+    g, dead = g.add_node(t(lambda x: x + 1, "dead"), (live,))
+    report = check_graph(g)
+    dead_diags = [d for d in report.diagnostics if d.code == "dead-branch"]
+    assert len(dead_diags) == 1 and dead_diags[0].node_id == dead.id
+
+
+def test_host_sync_lint_fires_dynamically(mesh8):
+    # np.asarray on a traced value raises at eval_shape time and is
+    # classified as a host-sync hazard, not a generic shape error
+    pipe = t(lambda x: np.asarray(x) + 1.0, "hostish")
+    report = pipe.check(jax.ShapeDtypeStruct((8,), np.float32))
+    assert [d for d in report.diagnostics if d.code == "host-sync"]
+
+
+def test_host_sync_ast_lint():
+    class BadNode(Transformer):
+        def apply(self, x):
+            return np.asarray(x) * 2.0
+
+    class GoodNode(Transformer):
+        def apply(self, x):
+            idx = np.arange(4)  # np on static config is fine
+            return x[jnp.asarray(idx)]
+
+    class HostNode(HostTransformer):
+        def apply(self, x):
+            return np.asarray(x).tolist()  # host stages may host-coerce
+
+    assert apply_body_host_coercions(BadNode) == ["np.asarray(x)"]
+    assert apply_body_host_coercions(GoodNode) == []
+    assert apply_body_host_coercions(HostNode) == []
+
+
+def test_fusion_prefix_lint_fires_on_noncanonical_fusion(mesh8):
+    """The lint guards the canonical-prefix invariant: a fusion rewrite
+    whose fused operator does NOT expand back to the unfused chain's
+    prefix (here: a plain composite transformer) changes every
+    downstream saveable prefix, which the lint must report."""
+    from keystone_tpu.workflow.graph_ids import NodeId
+    from keystone_tpu.workflow.estimator import LambdaEstimator
+
+    class OpaqueComposite(Transformer):
+        def __init__(self, stages):
+            self.composite_stages = list(stages)
+
+        def eq_key(self):
+            return (OpaqueComposite,
+                    tuple(s._cached_eq_key() for s in self.composite_stages))
+
+        def apply(self, x):
+            for s in self.composite_stages:
+                x = s.apply(x)
+            return x
+
+    def bad_fuse(graph):
+        # collapse the first two-node chain into an OpaqueComposite
+        for b in sorted(graph.nodes, key=lambda n: n.id):
+            deps = graph.get_dependencies(b)
+            if len(deps) == 1 and isinstance(deps[0], NodeId):
+                a = deps[0]
+                op_a, op_b = graph.get_operator(a), graph.get_operator(b)
+                if not (isinstance(op_a, LambdaTransformer)
+                        and isinstance(op_b, LambdaTransformer)):
+                    continue
+                g = graph.set_operator(b, OpaqueComposite([op_a, op_b]))
+                g = g.set_dependencies(b, graph.get_dependencies(a))
+                return g.remove_node(a)
+        return graph
+
+    def bad_fuse_fixpoint(graph):
+        while True:
+            nxt = bad_fuse(graph)
+            if nxt is graph:
+                return graph
+            graph = nxt
+
+    est = LambdaEstimator(lambda ds: t(lambda x: x, "id"), "E")
+    pipe = (t(lambda x: x + 1, "a") >> t(lambda x: x * 2, "b")).and_then(
+        est, spec_dataset((4,), n=8))
+    diags = fusion_prefix_lint(pipe.graph, fuse=bad_fuse_fixpoint)
+    assert len(diags) == 1
+    assert diags[0].code == "fusion-prefix-hazard"
+
+    # the REAL fusion rules are canonical: no hazard
+    assert fusion_prefix_lint(pipe.graph) == []
+
+
+# -- static cost-model provenance -------------------------------------------
+
+def test_node_rule_selects_solver_statically(mesh8):
+    """Dense least-squares path: the solver is chosen from statically
+    inferred (n, d, k) with NO sampled profile, and the PipelineTrace
+    records static provenance (ISSUE 2 acceptance)."""
+    from keystone_tpu.nodes.learning import LeastSquaresEstimator
+    from keystone_tpu.observability.trace import PipelineTrace
+    from keystone_tpu.parallel.dataset import ArrayDataset
+    from keystone_tpu.workflow.transformer import transformer
+
+    rng = np.random.RandomState(0)
+    n, d, k = 32, 6, 3
+    X = rng.randn(n, d).astype(np.float32)
+    Y = (X @ rng.randn(d, k)).astype(np.float32)
+    train, labels = ArrayDataset.from_numpy(X), ArrayDataset.from_numpy(Y)
+    ident = transformer(lambda x: x * 1.0)
+    with PipelineTrace("static") as tr:
+        pipe = ident.and_then(
+            LeastSquaresEstimator(num_iterations=100), train, labels)
+        preds = pipe(train).get().numpy()
+    np.testing.assert_allclose(preds, Y, atol=5e-2)
+    assert tr.node_choices and tr.node_choices[0]["provenance"] == "static"
+    assert tr.node_choices[0]["full_n"] == n
+    assert tr.solver_decisions
+    decision = tr.solver_decisions[0]
+    assert decision["shape_source"] == "static"
+    assert (decision["n"], decision["d"], decision["k"]) == (n, d, k)
+
+
+def test_optimize_static_declines_on_unknown_sparsity():
+    from keystone_tpu.analysis import SparseSpec
+    from keystone_tpu.nodes.learning import LeastSquaresEstimator
+
+    est = LeastSquaresEstimator()
+    data = DatasetSpec(SparseSpec(1000), n=500, host=True, sparsity=None)
+    labels = DatasetSpec(
+        jax.ShapeDtypeStruct((3,), np.float32), n=500)
+    assert est.optimize_static(data, 500, 8, labels_spec=labels) is None
+
+
+def test_node_rule_falls_back_to_sampling_for_sparse(mesh8):
+    """Sparse host inputs have no static density: the rule must keep the
+    reference's sampled path (provenance 'sampled') and still pick the
+    sparse solver."""
+    from keystone_tpu.nodes.learning import LeastSquaresEstimator
+    from keystone_tpu.nodes.learning.lbfgs import SparseLBFGSwithL2
+    from keystone_tpu.nodes.util.sparse import SparseVector
+    from keystone_tpu.observability.trace import PipelineTrace
+    from keystone_tpu.parallel.dataset import ArrayDataset, HostDataset
+    from keystone_tpu.workflow.optimizer.node_rule import (
+        NodeOptimizationRule,
+    )
+    from keystone_tpu.workflow.optimizable import OptimizableLabelEstimator
+
+    rng = np.random.RandomState(0)
+    items = [SparseVector(np.arange(10), np.ones(10, np.float32), 10_000)
+             for _ in range(16)]
+    labels = ArrayDataset.from_numpy(rng.randn(16, 2).astype(np.float32))
+    est = LeastSquaresEstimator(
+        **{"cpu_weight": 3.8e-4, "mem_weight": 2.9e-1,
+           "network_weight": 1.32, "lat_weight": 0.0})
+    from keystone_tpu.workflow.label_estimator import LabelEstimator  # noqa
+
+    pipe = est.with_data(HostDataset(items), labels)
+    with PipelineTrace("sparse") as tr:
+        NodeOptimizationRule(num_machines=16).apply(pipe.graph)
+    assert tr.node_choices
+    assert tr.node_choices[0]["provenance"] == "sampled"
+
+
+def test_static_shapes_opt_out_keeps_sampled_path(mesh8):
+    """`static_shapes=False` (or KEYSTONE_STATIC_NODE_OPT=0) forces the
+    reference's sampled behavior even for fully resolvable dense
+    shapes — the escape hatch for dense-stored-but-mostly-zero data
+    whose measured sparsity should drive the solver choice."""
+    from keystone_tpu.nodes.learning import LeastSquaresEstimator
+    from keystone_tpu.observability.trace import PipelineTrace
+    from keystone_tpu.parallel.dataset import ArrayDataset
+    from keystone_tpu.workflow.optimizer.node_rule import (
+        NodeOptimizationRule,
+    )
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 4).astype(np.float32)
+    Y = rng.randn(16, 2).astype(np.float32)
+    pipe = LeastSquaresEstimator().with_data(
+        ArrayDataset.from_numpy(X), ArrayDataset.from_numpy(Y))
+    with PipelineTrace("optout") as tr:
+        NodeOptimizationRule(static_shapes=False).apply(pipe.graph)
+    assert tr.node_choices
+    assert tr.node_choices[0]["provenance"] == "sampled"
+
+
+def test_check_summary_and_json(mesh8):
+    target = resolve_check_app("speech.timit")()
+    report = target.pipeline.check(target.input_spec, name="timit")
+    text = report.summary()
+    assert "statically clean" in text
+    assert "CosineRandomFeatures" in text
+    import json
+
+    blob = json.loads(report.to_json())
+    assert blob["diagnostics"] == []

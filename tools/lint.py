@@ -1,0 +1,170 @@
+"""The repo's own static gate — run before every PR.
+
+Three layers, all hermetic (no data, no device buffers):
+
+1. **Pipeline checks**: ``python -m keystone_tpu check`` semantics over
+   every registered app (``keystone_tpu.pipelines.CHECK_APPS``) — the
+   abstract interpreter plus graph lints must report zero diagnostics.
+2. **Custom AST rules** over the ``keystone_tpu`` source tree:
+   - ``host-coercion-in-apply``: a device-side ``Transformer.apply``
+     body must not call ``np.asarray``/``np.array`` on its item
+     argument (forces a per-item device sync; ADVICE r2/r3 lineage).
+     HostTransformers are exempt.
+   - ``unstable-jit-cache-tag``: ``self._cached_jit(tag, ...)`` must
+     pass a string-literal tag — a computed tag makes the global jit
+     cache key unstable across sessions, so warm-executable reuse
+     silently stops working.
+3. **ruff** (when installed): style/correctness pass over the package.
+   Skipped with a notice when the container lacks ruff — layers 1–2
+   are the required gate.
+
+Usage: ``python tools/lint.py [--skip-apps]`` or
+``bin/run-pipeline.sh --check``. Exit code 0 = clean.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "keystone_tpu"
+
+
+# -- layer 2: AST rules ------------------------------------------------------
+
+def _class_is_host_transformer(cls: ast.ClassDef) -> bool:
+    for base in cls.bases:
+        name = base.attr if isinstance(base, ast.Attribute) else getattr(
+            base, "id", "")
+        if "Host" in str(name):
+            return True
+    return False
+
+
+def _iter_transformer_applies(tree: ast.Module):
+    """(class, apply FunctionDef) pairs for transformer-looking classes.
+
+    Purely syntactic (no imports): any class whose base name mentions
+    Transformer and that defines ``apply(self, item)``; classes whose
+    base mentions Host are exempt."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        basenames = " ".join(
+            str(b.attr if isinstance(b, ast.Attribute)
+                else getattr(b, "id", "")) for b in node.bases)
+        if "Transformer" not in basenames:
+            continue
+        if _class_is_host_transformer(node):
+            continue
+        for item in node.body:
+            if isinstance(item, ast.FunctionDef) and item.name == "apply":
+                yield node, item
+
+
+def _host_coercions_in(fdef: ast.FunctionDef):
+    # single source of truth for the coercion pattern lives in the
+    # analysis package; this gate only adds the file-walk around it
+    from keystone_tpu.analysis.diagnostics import host_coercions_in_funcdef
+
+    yield from host_coercions_in_funcdef(fdef)
+
+
+def _unstable_jit_tags(tree: ast.Module):
+    """``self._cached_jit(<non-literal>, ...)`` call sites."""
+    for call in ast.walk(tree):
+        if not (isinstance(call, ast.Call) and call.args):
+            continue
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "_cached_jit"):
+            continue
+        tag = call.args[0]
+        if not (isinstance(tag, ast.Constant) and isinstance(tag.value, str)):
+            yield call.lineno
+
+
+def run_ast_rules() -> int:
+    failures = 0
+    for path in sorted(PKG.rglob("*.py")):
+        rel = path.relative_to(REPO)
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError as exc:
+            print(f"{rel}: syntax error: {exc}")
+            failures += 1
+            continue
+        for cls, fdef in _iter_transformer_applies(tree):
+            for lineno, what in _host_coercions_in(fdef):
+                print(f"{rel}:{lineno}: host-coercion-in-apply: "
+                      f"{cls.name}.apply calls {what} on its item "
+                      "(per-item device sync; use jnp or HostTransformer)")
+                failures += 1
+        for lineno in _unstable_jit_tags(tree):
+            print(f"{rel}:{lineno}: unstable-jit-cache-tag: _cached_jit "
+                  "tag must be a string literal (computed tags break "
+                  "warm-executable reuse across sessions)")
+            failures += 1
+    return failures
+
+
+# -- layer 1: pipeline checks ------------------------------------------------
+
+def run_pipeline_checks() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from keystone_tpu.pipelines import CHECK_APPS
+
+    failures = 0
+    for name in sorted(CHECK_APPS):
+        target = CHECK_APPS[name]()
+        report = target.pipeline.check(target.input_spec, name=name)
+        status = "ok" if report.ok else "FAIL"
+        print(f"check {name}: {status} "
+              f"({report.resolved_nodes()}/"
+              f"{len(report.analysis.graph.nodes)} specs resolved)")
+        if not report.ok:
+            for d in report.diagnostics:
+                print(f"  {d}")
+            failures += 1
+    return failures
+
+
+# -- layer 3: ruff -----------------------------------------------------------
+
+def run_ruff() -> int:
+    ruff = shutil.which("ruff")
+    if ruff is None:
+        print("ruff: not installed; skipping style pass "
+              "(AST rules + pipeline checks are the required gate)")
+        return 0
+    proc = subprocess.run(
+        [ruff, "check", "--select", "E9,F63,F7,F82", str(PKG)],
+        capture_output=True, text=True)
+    if proc.stdout.strip():
+        print(proc.stdout)
+    return 0 if proc.returncode == 0 else 1
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    failures = run_ast_rules()
+    failures += run_ruff()
+    if "--skip-apps" not in argv:
+        failures += run_pipeline_checks()
+    if failures:
+        print(f"\nlint: {failures} failure(s)")
+        return 1
+    print("\nlint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -159,6 +159,27 @@ def main():
           flush=True)
     assert err < 1e-4, err
 
+    # Device-mode precision parity gate (ADVICE medium#2): the shipped
+    # Precision.HIGH band matmuls must keep quantized descriptors within
+    # the golden test's envelope of a HIGHEST (6-pass, ~f32) reference —
+    # the same bound test_dense_sift_descriptor_golden_gantrycrane pins
+    # against VLFeat (diff.max <= 2 quantization levels, mean <= 0.15).
+    # On CPU the flag is a no-op (exact equality); on TPU this is the
+    # automated check that bf16 drift cannot ship unnoticed.
+    def sift_at(precision):
+        return jax.jit(jax.vmap(
+            lambda g: S.dense_sift(g, STEP, BIN, NSCALES, SSTEP,
+                                   precision=precision)))(imgs[:2])
+
+    hi = np.asarray(sift_at(jax.lax.Precision.HIGH))
+    ref = np.asarray(sift_at(jax.lax.Precision.HIGHEST))
+    diff = np.abs(hi - ref)
+    print(f"precision parity HIGH vs HIGHEST: max {diff.max():.3f} "
+          f"mean {diff.mean():.4f} (envelope: max <= 2.0, mean <= 0.15)",
+          flush=True)
+    assert diff.max() <= 2.0, diff.max()
+    assert diff.mean() <= 0.15, diff.mean()
+
 
 if __name__ == "__main__":
     main()
