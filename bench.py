@@ -31,11 +31,23 @@ configs: ``imagenet_rehearsal_images_per_sec_per_chip`` (SIFT->PCA->FV +
 classes), each through the real app DAG on synthetic data with the
 test error recorded in the metric line.
 
+Streaming-ingest sections (``parallel/streaming.py``):
+``tar_loader_sift_streamed_images_per_sec`` measures the tar -> decode
+-> device -> SIFT path with the double-buffered prefetcher against the
+serial path, and ``cifar_streamed_e2e_images_per_sec_per_chip`` runs the
+out-of-core CIFAR fit (per-chunk featurize -> Gram/cross accumulate ->
+finalize) under an asserted HBM ingest budget.
+
 ``--solver``/``--featurize``/``--e2e``/``--imagenet``/``--mnist``/
-``--timit``/``--newsgroups``/``--accuracy`` run a single section
-(``newsgroups_docs_per_sec`` covers the BASELINE text config:
-bigrams + binary TF + CommonSparseFeatures 100k + NaiveBayes).
+``--timit``/``--newsgroups``/``--accuracy``/``--streamed-e2e`` run a
+single section (``newsgroups_docs_per_sec`` covers the BASELINE text
+config: bigrams + binary TF + CommonSparseFeatures 100k + NaiveBayes).
 ``KEYSTONE_BENCH_SMALL=1`` shrinks sizes for CPU smoke-testing.
+
+Budgeting: per-section durations measured on this host persist in
+``.bench_durations.json``; over-budget sections SHRINK (scaled n/reps,
+``"scaled"`` key on their metric lines) instead of being skipped, so
+every historical metric appears in every artifact.
 """
 from __future__ import annotations
 
@@ -68,6 +80,61 @@ def _enable_compilation_cache():
 
 SMALL = os.environ.get("KEYSTONE_BENCH_SMALL") == "1"
 
+#: Budget scale for the CURRENT section, set by main()'s scheduler.
+#: 1.0 = full size; < 1.0 = the section was admitted over budget and
+#: must SHRINK (fewer reps, scaled n) instead of being skipped, so
+#: every BENCH_r*.json metric appears in every round (VERDICT r5
+#: weak#1). Metric lines carry a "scaled" key whenever < 1.
+_SCALE = 1.0
+
+#: Floor for shrunk sections: below this the numbers stop meaning
+#: anything (pure dispatch floor), so scaling clamps here.
+_MIN_SCALE = 0.2
+
+
+def _scaled(n, mult=1, floor=None):
+    """``n`` shrunk by the current budget scale, rounded DOWN to a
+    multiple of ``mult`` (shard/batch divisibility), floored at
+    ``floor`` (default one ``mult``)."""
+    floor = mult if floor is None else floor
+    out = int(n * _SCALE) // mult * mult
+    return max(out, floor)
+
+
+#: Measured per-section durations from previous runs on this host
+#: (written after every section): the scheduler budgets from evidence,
+#: not hardcoded estimates — stale estimates are what skipped 4-5
+#: sections in r4/r5.
+_DURATIONS_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".bench_durations.json")
+
+
+def _load_durations() -> dict:
+    try:
+        with open(_DURATIONS_PATH) as f:
+            return json.load(f)
+    except Exception:
+        return {}
+
+
+def _record_duration(name: str, seconds: float) -> None:
+    """Persist a section duration estimate. The write POLICY lives at
+    the call sites in ``main()``: clean full-size non-SMALL runs record
+    their measured wall, and budget-shrunk runs only DECAY an existing
+    estimate toward their observed wall (never extrapolate a shrunk
+    wall upward — mostly fixed compile/setup overhead would inflate the
+    estimate and ratchet the section into permanent shrinking; SMALL
+    smoke runs and retried sections never write at all)."""
+    durations = _load_durations()
+    durations[name] = round(seconds, 1)
+    tmp = _DURATIONS_PATH + f".tmp{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(durations, f, indent=1, sort_keys=True)
+        os.replace(tmp, _DURATIONS_PATH)
+    except Exception:
+        pass
+
 #: Wall-clock budget for the full run. Round 2's driver kill (rc=124)
 #: came AFTER ~910s of completed sections (featurize/solver/imagenet/
 #: e2e/mnist all emitted), so the driver timeout is >~910s. 780 keeps
@@ -89,6 +156,11 @@ _section_buffer = None  # list while a section runs under _run_section
 def _emit(metric, value, unit, vs_baseline, **extra):
     line = {"metric": metric, "value": value, "unit": unit,
             "vs_baseline": vs_baseline}
+    if _SCALE < 1.0:
+        # budget-shrunk section: the value was measured at reduced
+        # size/reps — comparable only with other runs at scale 1.0
+        # once the budget recovers, never silently absent
+        line["scaled"] = round(_SCALE, 2)
     line.update(extra)
     if _section_buffer is not None:
         # held until the section completes: a failed attempt's partial
@@ -132,7 +204,7 @@ def _emit_summary():
     print(json.dumps(line), flush=True)
 
 
-def _timed_median(work, *, setup=None, reps=3, target_window=2.0,
+def _timed_median(work, *, setup=None, reps=None, target_window=2.0,
                   max_mult=16):
     """Median-of-``reps`` seconds-per-call, each rep measured over a
     window of >= ``target_window`` seconds (the call repeated ``m``
@@ -147,7 +219,14 @@ def _timed_median(work, *, setup=None, reps=3, target_window=2.0,
     unguarded estimate inflates est, collapsing m to 1 and undersizing
     every rep's window — the exact jitter this helper exists to reject).
     Returns (median_dt, evidence) where evidence carries the window
-    multiplier, rep count, and rep spread for the metric line."""
+    multiplier, rep count, and rep spread for the metric line.
+    Budget-shrunk sections (``_SCALE < 1``) default to 2 reps over a
+    proportionally smaller window — the floor-scaled trailing sections
+    must fit the margin the driver's kill window leaves."""
+    if reps is None:
+        reps = 3 if _SCALE >= 1.0 else 2
+    if _SCALE < 1.0:
+        target_window = max(0.5, target_window * _SCALE)
     est = float("inf")
     for _ in range(2):
         if setup is not None:
@@ -167,6 +246,28 @@ def _timed_median(work, *, setup=None, reps=3, target_window=2.0,
     med = float(np.median(times))
     return med, {"timing_reps": reps, "timing_window_mult": m,
                  "timing_spread": round((max(times) - min(times)) / med, 3)}
+
+
+def _ingest_stall_probe(n_chunks_per_run):
+    """Snapshot the streaming metrics and return ``share(dt)``: the
+    per-run ingest stall as a fraction of ``dt`` seconds. The metrics
+    accumulate across every invocation ``_timed_median`` makes
+    (estimation calls + window reps), so the stall delta is normalized
+    by the observed run count before dividing — the ONE home of that
+    subtlety, shared by the loader and streamed-e2e sections."""
+    from keystone_tpu.observability import MetricsRegistry
+
+    reg = MetricsRegistry.get_or_create()
+    stall_h = reg.histogram("streaming.ingest_stall_s")
+    chunks_c = reg.counter("streaming.chunks_total")
+    stall0, chunks0 = stall_h.total, chunks_c.value
+
+    def share(dt):
+        runs = max(1.0, (chunks_c.value - chunks0) / n_chunks_per_run)
+        return round(min(
+            ((stall_h.total - stall0) / runs) / max(dt, 1e-9), 1.0), 3)
+
+    return share
 
 
 def _fence(tree) -> None:
@@ -228,7 +329,7 @@ def build_bench(num_filters=1024, patch_size=6, alpha=0.25):
 def featurize_bench():
     n_dev = len(jax.devices())
     batch = 256 if SMALL else 1024
-    iters = 3 if SMALL else 64
+    iters = 3 if SMALL else _scaled(64, mult=4, floor=8)
     imgs = jax.device_put(
         (np.random.RandomState(1).rand(batch, 32, 32, 3) * 255)
         .astype(np.float32))
@@ -280,9 +381,9 @@ def e2e_bench():
     n_dev = len(jax.devices())
     num_filters = 128 if SMALL else 1024
     patch = 6
-    n_train = 2_048 if SMALL else 20_480
-    n_test = 512 if SMALL else 4_096
     batch = 512 if SMALL else 2_048
+    n_train = 2_048 if SMALL else _scaled(20_480, mult=batch, floor=2 * batch)
+    n_test = 512 if SMALL else _scaled(4_096, mult=batch, floor=batch)
 
     rng = np.random.RandomState(2)
     filters = rng.randn(num_filters, patch * patch * 3).astype(np.float32)
@@ -396,7 +497,7 @@ def solver_bench():
     _fence((blocks, Y))  # staging fence, untimed
     run = jax.jit(functools.partial(linalg.bcd_core, num_passes=1))
     _fence(run(blocks, Y, jnp.float32(0.1)))
-    iters = 2 if SMALL else 5
+    iters = 2 if SMALL else _scaled(5, floor=2)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = run(blocks, Y, jnp.float32(0.1))
@@ -520,7 +621,8 @@ def accuracy_bench():
         num_filters = 1024
     else:
         (tr_x, tr_y), (te_x, te_y) = make_surrogate_cifar(
-            1_024 if SMALL else 10_240, 256 if SMALL else 2_048)
+            1_024 if SMALL else _scaled(10_240, mult=512, floor=4_096),
+            256 if SMALL else _scaled(2_048, mult=256, floor=1_024))
         train = LabeledData(ArrayDataset.from_numpy(tr_x),
                             ArrayDataset.from_numpy(tr_y.astype(np.int32)))
         test = LabeledData(ArrayDataset.from_numpy(te_x),
@@ -579,8 +681,8 @@ def timit_bench():
     n_dev = len(jax.devices())
     # 16k x 32k features = 2.1 GB; the centered solver copy + warm-run
     # remnants must co-exist in HBM on the single bench chip
-    n_train = 2_048 if SMALL else 16_384
-    n_test = 512 if SMALL else 2_048
+    n_train = 2_048 if SMALL else _scaled(16_384, mult=1_024, floor=4_096)
+    n_test = 512 if SMALL else _scaled(2_048, mult=512, floor=1_024)
     num_cosines = 2 if SMALL else 8     # branches of 4096 features
     k, d = 147, 440
 
@@ -635,8 +737,8 @@ def mnist_bench():
     )
 
     n_dev = len(jax.devices())
-    n_train = 2_048 if SMALL else 16_384
-    n_test = 512 if SMALL else 2_048
+    n_train = 2_048 if SMALL else _scaled(16_384, mult=1_024, floor=4_096)
+    n_test = 512 if SMALL else _scaled(2_048, mult=512, floor=1_024)
 
     rng = np.random.RandomState(0)
     # tight prototypes under 0.35 noise so the task has genuine overlap
@@ -690,8 +792,8 @@ def newsgroups_bench():
     )
 
     n_classes = 20
-    n_train = 512 if SMALL else 4_096
-    n_test = 128 if SMALL else 1_024
+    n_train = 512 if SMALL else _scaled(4_096, mult=256, floor=1_024)
+    n_test = 128 if SMALL else _scaled(1_024, mult=128, floor=256)
     words_per_doc = 40
 
     rng = np.random.RandomState(0)
@@ -754,8 +856,8 @@ def amazon_bench():
         run,
     )
 
-    n_train = 512 if SMALL else 4_096
-    n_test = 128 if SMALL else 1_024
+    n_train = 512 if SMALL else _scaled(4_096, mult=256, floor=1_024)
+    n_test = 128 if SMALL else _scaled(1_024, mult=128, floor=256)
     words_per_doc = 40
     common = [f"word{i}" for i in range(2_000)]
     # two overlapping 60-word sentiment windows over a shared 90-word
@@ -805,7 +907,7 @@ def stupid_backoff_bench():
         run,
     )
 
-    n_lines = 400 if SMALL else 4_000
+    n_lines = 400 if SMALL else _scaled(4_000, mult=100, floor=1_000)
     words_per_line = 20
     rng = np.random.RandomState(0)
     # Zipf-ish unigram law over a 5k vocabulary: real backoff mass
@@ -841,7 +943,7 @@ def voc_bench():
         run,
     )
 
-    n_imgs = 24 if SMALL else 96
+    n_imgs = 24 if SMALL else _scaled(96, mult=8, floor=32)
     side = 96
     n_cls = 20
     rng = np.random.RandomState(0)
@@ -908,7 +1010,7 @@ def imagenet_rehearsal_bench():
     n_classes = 100 if SMALL else 1000
     fv_dim = 2 * desc_dim * vocab          # one branch
     d_solve = 2 * fv_dim                   # SIFT + LCS branches combined
-    n_solve = 512 if SMALL else 4096
+    n_solve = 512 if SMALL else _scaled(4096, mult=512, floor=1_024)
 
     sift = SIFTExtractor(step=4, bin_size=6, num_scales=5, scale_step=1)
     n_desc = sift.descriptor_count(h, w)
@@ -947,13 +1049,44 @@ def imagenet_rehearsal_bench():
     imgs_dev = jax.device_put(
         imgs, NamedSharding(make_mesh(jax.devices()), P("data")))
     _fence(featurize_batch(imgs_dev))                  # compile
-    reps = 4
+    reps = _scaled(4, floor=2)
     t0 = time.perf_counter()
     for _ in range(reps):
         out = featurize_batch(imgs_dev)
     _fence(out)
     feat_dt = (time.perf_counter() - t0) / reps
     per_chip = n_imgs / feat_dt / len(jax.devices())
+
+    # batch-64 featurize via the streaming prefetcher (VERDICT r5 item
+    # 3, batching half): doubled vmap batch amortizes per-dispatch
+    # overhead (~+10% measured in the r5 build notes), and the host
+    # feed rides the double buffer — uint8 grayscale chunks upload on
+    # the prefetch thread while the chip featurizes the previous chunk,
+    # so the bigger batch is actually fed. Degenerate on CPU smoke runs
+    # (SMALL), honest at the rehearsal shape on chip.
+    from keystone_tpu.parallel.streaming import StreamingDataset
+
+    chunk64 = 2 * n_imgs
+    n64 = 2 * chunk64
+    imgs64 = (rng.rand(n64, h, w) * 255).astype(np.uint8)
+
+    @jax.jit
+    def feat_u8(X):
+        return jax.vmap(featurize)(X.astype(jnp.float32) / 255.0)
+
+    def run64():
+        stream = StreamingDataset.from_numpy(
+            imgs64, chunk_size=chunk64, prefetch_depth=2,
+            tag="imagenet-rehearsal-64")
+        outs = [feat_u8(c.data) for c in stream.chunks()]
+        _fence(outs)
+
+    run64()  # warm
+    # median-of-reps like every other number here: the tunneled host's
+    # ~8% between-run band would otherwise swing batch64_vs_base on a
+    # single sample
+    dt64, ev64 = _timed_median(run64)
+    per_chip64 = n64 / dt64 / len(jax.devices())
 
     # 1000-class weighted solve at the combined FV dimension; warmed so
     # the metric is solver time, not XLA compile time. Inputs are staged
@@ -983,7 +1116,12 @@ def imagenet_rehearsal_bench():
           image_shape=[h, w], descriptors_per_image=int(n_desc),
           sift_pca_fv_ms_per_image=round(1e3 * feat_dt / n_imgs, 1),
           weighted_solve_s=round(solve_dt, 2),
-          solve_shape=[n_solve, d_solve, n_classes])
+          solve_shape=[n_solve, d_solve, n_classes],
+          batch64_images_per_sec_per_chip=round(per_chip64, 2),
+          batch64_chunk=chunk64,
+          batch64_vs_base=round(per_chip64 / max(per_chip, 1e-9), 3),
+          batch64_timing_spread=ev64["timing_spread"],
+          batch64_ingest="prefetch-depth-2-uint8")
 
 
 # ----------------------------------------------- loader-in-the-loop bench
@@ -1012,7 +1150,7 @@ def loader_bench():
     from keystone_tpu.loaders.image_loader_utils import iter_decoded_chunks
     from keystone_tpu.nodes.images.extractors import SIFTExtractor
 
-    n_imgs = 64 if SMALL else 512
+    n_imgs = 64 if SMALL else _scaled(512, mult=64, floor=128)
     side = 128
     chunk = 16 if SMALL else 64
     tar_path = os.path.join(
@@ -1088,6 +1226,149 @@ def loader_bench():
           image_side=side, n_images=n_imgs,
           overlap_efficiency=round(decode_dt / e2e_dt, 3), **ev)
 
+    # -- streamed path: decode AND device_put move to the prefetch
+    # thread (StreamingDataset, depth 2), so ingest of chunk i+1
+    # overlaps the device work on chunk i. The serial path above pays
+    # the host->device upload inline per chunk — on the tunneled bench
+    # chip that upload dominates, which is exactly the overlap a double
+    # buffer recovers. Stall share comes from the process metrics.
+    from keystone_tpu.loaders.image_loader_utils import stream_tar_images
+
+    depth = 2
+
+    def prepare(batch):
+        # no tail padding here: _stage pads every chunk to chunk_size
+        # and keeps the TRUE row count in chunk.n — pre-padding would
+        # count zero images as real rows in any downstream carry
+        return np.stack([img for _, img in batch]).astype(np.uint8)
+
+    def run_streamed():
+        stream = stream_tar_images([tar_path], chunk, prepare=prepare,
+                                   n=n_imgs, prefetch_depth=depth)
+        outs = [featurize_chunk(c.data) for c in stream.chunks()]
+        _fence(outs)
+        return len(outs)
+
+    run_streamed()  # warm (compiles are shared with the serial path)
+    share = _ingest_stall_probe(-(-n_imgs // chunk))
+    s_dt, s_ev = _timed_median(run_streamed)
+    s_per_sec = n_imgs / s_dt
+    _emit("tar_loader_sift_streamed_images_per_sec", round(s_per_sec, 1),
+          "images/sec", round(s_per_sec / 100.0, 4),
+          prefetch_depth=depth,
+          speedup_vs_serial=round(e2e_dt / s_dt, 3),
+          ingest_stall_share=share(s_dt),
+          image_side=side, n_images=n_imgs, **s_ev)
+
+
+# ----------------------------------------- streamed out-of-core e2e bench
+
+
+def streamed_e2e_bench():
+    """Streamed CIFAR end-to-end (the out-of-core path): host uint8
+    chunks -> double-buffered device ingest (StreamingDataset, depth 2)
+    -> per-chunk fused featurization -> BlockLS Gram/cross ACCUMULATE ->
+    finalize -> streamed predict. The featurized training matrix never
+    exists in HBM — device residency is the bounded prefetch buffer plus
+    one chunk of features plus the (F, F) carry, and the ingest buffer
+    is asserted against an explicit budget via
+    ``parallel.dataset.device_nbytes``. vs_baseline shares the resident
+    e2e's 10k img/s/chip strawman (expect a lower number: this path
+    pays real host->device ingest, which the resident bench stages
+    outside the timed region — the metric is the OVERLAPPED ingest
+    cost, not a regression)."""
+    from keystone_tpu.nodes.learning.linear import BlockLeastSquaresEstimator
+    from keystone_tpu.ops.image_ops import filter_bank_convolve, pool_image
+    from keystone_tpu.ops.pallas_kernels import (
+        fused_cifar_featurize,
+        use_pallas,
+    )
+    from keystone_tpu.parallel.streaming import StreamingDataset, fit_streaming
+
+    n_dev = len(jax.devices())
+    num_filters = 64 if SMALL else 256
+    patch = 6
+    chunk = 256 if SMALL else 1_024
+    n_train = 1_024 if SMALL else _scaled(8_192, mult=1_024, floor=2_048)
+    n_test = 256 if SMALL else _scaled(2_048, mult=1_024, floor=1_024)
+    depth = 2
+    F = num_filters * 2 * 2 * 2
+
+    rng = np.random.RandomState(7)
+    filters = rng.randn(num_filters, patch * patch * 3).astype(np.float32)
+
+    if use_pallas():
+        @jax.jit
+        def featurize(imgs_u8):
+            return fused_cifar_featurize(
+                imgs_u8.astype(jnp.float32), jnp.asarray(filters), 32,
+                patch, 3, 13, 14, 10.0, 0.25)
+    else:
+        @jax.jit
+        def featurize(imgs_u8):
+            def one(img):
+                conv = filter_bank_convolve(
+                    img, jnp.asarray(filters), patch, 3, True, None, 10.0)
+                pos = jnp.maximum(0.0, conv - 0.25)
+                neg = jnp.maximum(0.0, -conv - 0.25)
+                return pool_image(
+                    jnp.concatenate([pos, neg], -1), 13, 14, "identity",
+                    "sum").reshape(-1)
+
+            return jax.vmap(one)(imgs_u8.astype(jnp.float32))
+
+    # uint8 on the wire (4x smaller than f32); chunk labels are sliced
+    # from the resident (n, 10) matrix — tiny next to the images
+    imgs_host = (rng.rand(n_train, 32, 32, 3) * 255).astype(np.uint8)
+    y = rng.randint(0, 10, n_train)
+    L = (-np.ones((n_train, 10)) + 2.0 * np.eye(10)[y]).astype(np.float32)
+    imgs_test = (rng.rand(n_test, 32, 32, 3) * 255).astype(np.uint8)
+
+    # the ingest buffer may hold depth staged chunks + one working
+    # chunk; anything beyond that margin means the stream is NOT
+    # bounded and the out-of-core claim is false — fail loudly
+    chunk_raw = chunk * 32 * 32 * 3
+    budget = (depth + 1) * chunk_raw + (1 << 20)
+
+    est = BlockLeastSquaresEstimator(min(1024, F), 1, lam=0.1)
+
+    def feat_chunks(u8_stream):
+        return u8_stream.map_chunks(lambda ad: ad.map_batch(featurize))
+
+    result = {}
+
+    def fit_and_predict():
+        train = StreamingDataset.from_numpy(
+            imgs_host, chunk_size=chunk, prefetch_depth=depth,
+            tag="cifar-stream-train")
+        model = fit_streaming(est, feat_chunks(train), L,
+                              hbm_budget=budget)
+        result["peak_stream"] = train.peak_device_nbytes
+        test = StreamingDataset.from_numpy(
+            imgs_test, chunk_size=chunk, prefetch_depth=depth,
+            tag="cifar-stream-test")
+        preds = []
+        for out in model.apply_dataset(feat_chunks(test)).chunks():
+            preds.append(np.asarray(
+                jnp.argmax(out.data, axis=-1))[: out.n])
+        result["preds"] = np.concatenate(preds)
+
+    fit_and_predict()  # warm: one compile per chunk shape, then zero
+
+    share = _ingest_stall_probe(
+        -(-n_train // chunk) + -(-n_test // chunk))
+    dt, ev = _timed_median(fit_and_predict)
+
+    per_chip = (n_train + n_test) / dt / n_dev
+    _emit("cifar_streamed_e2e_images_per_sec_per_chip", round(per_chip, 1),
+          "images/sec/chip", round(per_chip / 10000.0, 4),
+          chunk_size=chunk, prefetch_depth=depth, n_train=n_train,
+          num_filters=num_filters,
+          hbm_budget_mib=round(budget / (1 << 20), 2),
+          peak_stream_mib=round(result["peak_stream"] / (1 << 20), 2),
+          gram_carry_mib=round((F * F + F * 10) * 4 / (1 << 20), 2),
+          ingest_stall_share=share(dt), **ev)
+
 
 def _section_cleanup():
     """Drop cross-section state so one section's HBM residue (datasets,
@@ -1109,7 +1390,9 @@ def _run_section(section, deadline=None):
     failed attempt can never leave stale duplicate metric lines. The
     retry is forgone when the budget deadline has passed: a slow
     failing section must not run twice and push the process into the
-    driver's kill window."""
+    driver's kill window. Returns the attempt count on success (1 =
+    clean first try — the only wall time worth persisting as a duration
+    estimate), 0 on failure."""
     global _section_buffer
     import sys
     import traceback
@@ -1120,7 +1403,7 @@ def _run_section(section, deadline=None):
             section()
             for line in _section_buffer:
                 _flush_line(line)
-            return True
+            return attempt + 1
         except Exception:
             # stdout, not stderr: the driver captures stdout, so the
             # evidence of a failed section survives in BENCH_r*.json
@@ -1136,7 +1419,7 @@ def _run_section(section, deadline=None):
                 time.sleep(5)
         finally:
             _section_buffer = None
-    return False
+    return 0
 
 
 def main():
@@ -1146,22 +1429,22 @@ def main():
     section the flagship summary line is re-emitted, so the LAST stdout
     line — what the driver parses as the headline — is always
     ``cifar_randompatch_images_per_sec_per_chip`` carrying every value
-    measured so far, no matter where the run is cut off. Sections whose
-    conservative cost estimate exceeds the remaining self-imposed budget
-    are skipped explicitly (lowest priority last => sacrificed first)."""
-    # (section, cost estimate in seconds: measured warm-cache costs on
-    # the bench chip + margin; cold compiles can exceed these — the
-    # deadline check before each section is what keeps the total
-    # bounded)
-    # Ordering (r4 weak#1): after the flagship trio, the sections that
-    # have NEVER emitted a number on the chip (voc/amazon/backoff were
-    # added in r3 and skipped in r4) run BEFORE the apps that already
-    # have r3+r4 coverage, so a budget shortfall sacrifices repeat
-    # measurements, not first measurements. Estimates are warm-cache
-    # costs + margin re-measured in r5 (the persistent .xla_cache is
-    # left on disk by the pre-round full run, so the driver's invocation
-    # starts warm; mnist's r4 120 s was a cold-compile artifact of its
-    # stale 60 s estimate admitting it into a dying budget).
+    measured so far, no matter where the run is cut off.
+
+    Budgeting (VERDICT r5 weak#1): estimates come from MEASURED
+    per-section durations persisted in ``.bench_durations.json`` by
+    previous runs on this host (hardcoded values are only the cold
+    fallback — stale estimates are what skipped 4-5 sections in r4/r5).
+    A section whose estimate exceeds the remaining budget is SHRUNK
+    (``_SCALE`` scales its n/reps; its metric lines carry a ``scaled``
+    key), never skipped: every metric that has ever appeared in a
+    BENCH_r*.json appears in every run."""
+    global _SCALE
+    # (section, fallback cost estimate in seconds — used only until a
+    # measured duration exists for this host)
+    # Ordering (r4 weak#1): after the flagship trio, least-recently-
+    # measured sections run before well-covered repeats, so a budget
+    # shortfall shrinks repeat measurements, not first measurements.
     sections = (
         (featurize_bench, 15),
         (solver_bench, 90),
@@ -1169,27 +1452,53 @@ def main():
         (voc_bench, 90),
         (amazon_bench, 25),
         (stupid_backoff_bench, 15),
-        (imagenet_rehearsal_bench, 110),
+        (imagenet_rehearsal_bench, 130),
         (e2e_bench, 60),
-        (loader_bench, 45),
+        (loader_bench, 60),
+        (streamed_e2e_bench, 60),
         (newsgroups_bench, 30),
         (timit_bench, 120),
         (mnist_bench, 75),
     )
+    # SMALL smoke runs neither consult nor record durations: their
+    # seconds-long sections would poison the full-run budget estimates
+    measured = {} if SMALL else _load_durations()
     deadline = _START + BUDGET_S
-    for section, est in sections:
+    for section, fallback in sections:
+        est = 1.15 * measured.get(section.__name__, fallback)
         remaining = deadline - time.monotonic()
-        if remaining < est:
-            # plain text, not JSON: a skip note must never be parseable
-            # as the run's headline metric line
-            print(f"# skipped {section.__name__}: {remaining:.0f}s "
-                  f"of budget left < {est}s estimate", flush=True)
-            continue
+        if remaining >= est:
+            _SCALE = 1.0
+        else:
+            # over budget: shrink, don't skip — a scaled number beats a
+            # missing one (flagged via the "scaled" metric key). With
+            # the deadline already passed (remaining <= 0) the section
+            # still runs at the floor scale: BUDGET_S keeps >2 min of
+            # margin under the driver's kill window precisely so a few
+            # floor-scaled trailing sections fit inside it.
+            _SCALE = max(_MIN_SCALE,
+                         min(1.0, 0.8 * max(remaining, 0.0) / est))
+            print(f"# shrinking {section.__name__} to scale "
+                  f"{_SCALE:.2f}: {remaining:.0f}s of budget left < "
+                  f"{est:.0f}s estimate", flush=True)
         t_sec = time.monotonic()
-        _run_section(section, deadline)
+        attempts = _run_section(section, deadline)
+        took = time.monotonic() - t_sec
+        if attempts == 1 and not SMALL:
+            if _SCALE == 1.0:
+                _record_duration(section.__name__, took)
+            elif section.__name__ in measured:
+                # scaled runs never extrapolate (the ratchet-UP trap),
+                # but an inflated estimate must also not stick forever
+                # — a one-off cold-compile wall would otherwise shrink
+                # this section on every future run. Decay it toward the
+                # observed scaled wall (never below it), so the
+                # estimate heals and the section re-earns full size.
+                _record_duration(section.__name__,
+                                 max(took, 0.85 * measured[section.__name__]))
+        _SCALE = 1.0
         _section_cleanup()
-        print(f"# {section.__name__} took {time.monotonic() - t_sec:.0f}s",
-              flush=True)
+        print(f"# {section.__name__} took {took:.0f}s", flush=True)
         _emit_summary()
     if _emitted == 0:
         # every section failed: fail loudly instead of exiting 0 with an
@@ -1236,6 +1545,7 @@ if __name__ == "__main__":
         "--amazon": amazon_bench,
         "--stupid-backoff": stupid_backoff_bench,
         "--voc": voc_bench,
+        "--streamed-e2e": streamed_e2e_bench,
     }
     argv = list(sys.argv[1:])
     trace_out = _pop_trace_out(argv)

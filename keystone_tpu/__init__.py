@@ -16,6 +16,7 @@ from .observability import (
 )
 from .parallel.dataset import ArrayDataset, Dataset, HostDataset, as_dataset
 from .parallel.mesh import get_mesh, make_mesh, mesh_scope, set_mesh
+from .parallel.streaming import StreamingDataset, fit_streaming, is_streamable
 from .workflow import (
     Cacher,
     Estimator,
@@ -40,7 +41,10 @@ __all__ = [
     "ArrayDataset",
     "Dataset",
     "HostDataset",
+    "StreamingDataset",
     "as_dataset",
+    "fit_streaming",
+    "is_streamable",
     "get_mesh",
     "make_mesh",
     "mesh_scope",

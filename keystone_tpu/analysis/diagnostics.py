@@ -21,6 +21,16 @@ the structural lints —
                          under map/gather fusion, so saved fitted state
                          could never be re-matched by
                          ``SavedStateLoadRule`` (CHANGES.md PR 1 note)
+* ``non-streamable-fit`` an estimator whose training input is a
+                         StreamingDataset but which does not implement
+                         the accumulate/finalize streaming protocol —
+                         the fit would fail at runtime (or require
+                         materializing the stream in HBM); also fires
+                         for streamed LABELS with resident data (the
+                         chunk loop is data-driven)
+* ``host-stage-on-stream`` a HostTransformer consumes a streaming
+                         dataset — chunks are device-resident, so the
+                         host stage raises at runtime
 
 — and packages everything as an :class:`AnalysisReport` in the
 observability layer's report style (text summary + ``to_json``).
@@ -212,6 +222,96 @@ def host_sync_lint(graph: Graph) -> List[Diagnostic]:
     return out
 
 
+# -- streaming lints --------------------------------------------------------
+
+def host_stage_on_stream_lint(analysis: Analysis) -> List[Diagnostic]:
+    """Host-side stages cannot consume a StreamingDataset (chunks are
+    device-resident; the batch path would sync every chunk back —
+    ``HostTransformer.apply_dataset`` raises at runtime). Flag it before
+    anything executes, naming the stage."""
+    from ..workflow.transformer import HostTransformer
+
+    graph = analysis.graph
+    out = []
+    for n in sorted(graph.nodes, key=lambda g: g.id):
+        op = graph.get_operator(n)
+        stages = getattr(op, "stages", None) or getattr(
+            op, "branches", None) or [op]
+        if not any(isinstance(s, HostTransformer) for s in stages):
+            continue
+        streamed = [
+            d for d in graph.get_dependencies(n)
+            if isinstance(analysis.value(d), DatasetSpec)
+            and analysis.value(d).streaming
+        ]
+        if streamed:
+            host_stage = next(
+                s for s in stages if isinstance(s, HostTransformer))
+            out.append(Diagnostic(
+                code="host-stage-on-stream", severity=SEVERITY_ERROR,
+                node_id=n.id, operator=host_stage.label(),
+                message=(
+                    f"host stage {host_stage.label()!r} consumes a "
+                    "streaming dataset; chunks are device-resident and "
+                    "a host stage would sync every one back (this "
+                    "raises at runtime). Run host stages before "
+                    "building the stream, or materialize() it")))
+    return out
+
+
+
+def non_streamable_fit_lint(analysis: Analysis) -> List[Diagnostic]:
+    """Estimator nodes fed a streaming dataset must implement the
+    accumulate/finalize protocol (``parallel.streaming.is_streamable``)
+    — otherwise ``fit`` raises at runtime, after the whole upstream
+    pipeline has already run. The error names the node so the fix
+    (streamable estimator, or an explicit ``materialize()``) is
+    unambiguous before anything executes."""
+    from ..parallel.streaming import is_streamable
+    from ..workflow.operators import EstimatorOperator
+
+    graph = analysis.graph
+    out = []
+    for n in sorted(graph.nodes, key=lambda g: g.id):
+        op = graph.get_operator(n)
+        if not isinstance(op, EstimatorOperator):
+            continue
+        deps = graph.get_dependencies(n)
+        streamed = [
+            isinstance(analysis.value(d), DatasetSpec)
+            and analysis.value(d).streaming
+            for d in deps
+        ]
+        if not any(streamed):
+            continue
+        if not is_streamable(op):
+            out.append(Diagnostic(
+                code="non-streamable-fit", severity=SEVERITY_ERROR,
+                node_id=n.id, operator=op.label(),
+                message=(
+                    f"estimator {op.label()!r} fits on a streaming "
+                    "dataset but implements no accumulate(carry, chunk"
+                    "[, labels])/finalize(carry) protocol; the fit "
+                    "would have to materialize the whole stream in "
+                    "HBM. Use a streamable estimator (LeastSquares "
+                    "family, StandardScaler) or materialize() the "
+                    "stream explicitly if it fits")))
+        elif not streamed[0]:
+            # streamable estimator, but only a NON-data dependency
+            # (labels) streams: the chunk loop is driven by the data
+            # stream, so this shape fails at runtime
+            out.append(Diagnostic(
+                code="non-streamable-fit", severity=SEVERITY_ERROR,
+                node_id=n.id, operator=op.label(),
+                message=(
+                    f"estimator {op.label()!r} has a streaming LABELS "
+                    "input but resident data; the streamed chunk loop "
+                    "is driven by the data input. Stream the data too "
+                    "(aligned chunk sizes), or materialize() the "
+                    "labels")))
+    return out
+
+
 # -- fusion/prefix hazard ---------------------------------------------------
 
 def _fusion_fixpoint(graph: Graph) -> Graph:
@@ -353,6 +453,8 @@ def check_graph(
     diagnostics += dtype_narrowing_lint(analysis)
     diagnostics += host_sync_lint(graph)
     diagnostics += fusion_prefix_lint(graph)
+    diagnostics += non_streamable_fit_lint(analysis)
+    diagnostics += host_stage_on_stream_lint(analysis)
     return AnalysisReport(name, analysis, diagnostics)
 
 

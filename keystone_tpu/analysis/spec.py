@@ -77,16 +77,23 @@ class DatasetSpec(AbstractValue):
     ``sparsity`` is the *storage* density the cost model consumes:
     1.0 for dense array elements (an ``ArrayDataset`` stores every
     entry), ``None`` when unknown (sparse host items, host objects).
+
+    ``streaming`` marks a chunked (``parallel.streaming``) collection:
+    items arrive as bounded device chunks, ``n`` may be unknown (None),
+    and only estimators implementing accumulate/finalize can fit on it
+    (the ``non-streamable-fit`` lint enforces this statically).
     """
 
     element: Any
     n: Optional[int] = None
     host: bool = False
     sparsity: Optional[float] = None
+    streaming: bool = False
 
     def __repr__(self) -> str:
+        flag = ", streaming" if self.streaming else ""
         return (f"DatasetSpec(n={self.n}, "
-                f"element={format_element(self.element)})")
+                f"element={format_element(self.element)}{flag})")
 
 
 @dataclass(frozen=True)
@@ -168,6 +175,18 @@ def dataset_spec(ds: Dataset) -> AbstractValue:
             lambda a: jax.ShapeDtypeStruct(tuple(a.shape[1:]), a.dtype),
             ds.data)
         return DatasetSpec(element, n=ds.n, host=False, sparsity=1.0)
+    from ..parallel.streaming import StreamingDataset
+
+    if isinstance(ds, StreamingDataset):
+        # exact per-chunk element shape when the source can describe it
+        # without being consumed; n is known-or-None by construction
+        element = ds.element()
+        if element is None:
+            element = Unknown("opaque stream source")
+        return DatasetSpec(
+            element, n=ds.n, host=False,
+            sparsity=None if element_has_unknown(element) else 1.0,
+            streaming=True)
     if isinstance(ds, HostDataset):
         items = ds.items
         if not items:

@@ -227,6 +227,42 @@ def _loader_threads() -> int:
     return min(32, os.cpu_count() or 4)
 
 
+def stream_tar_images(
+    archive_paths: Sequence[str],
+    chunk_size: int,
+    prepare: Optional[Callable[[List[tuple]], np.ndarray]] = None,
+    name_prefix: Optional[str] = None,
+    n: Optional[int] = None,
+    **stream_kw,
+):
+    """tar archives -> threaded decode pool -> double-buffered device
+    stream: the loader half of ``iter_decoded_chunks`` composed with
+    ``parallel.streaming.StreamingDataset``, so chunk *i+1*'s decode AND
+    upload run behind the prefetch buffer while chunk *i* computes.
+
+    ``prepare`` maps one decoded chunk (a list of ``(entry_name,
+    float32 image)``) to a stacked fixed-shape host array — the hook for
+    resize/crop/grayscale of ragged archive images; the default stacks
+    as-is (uniform-size archives). ``n`` is the total image count when
+    known (streams from unindexed tars leave it None; a completed pass
+    pins it).
+    """
+    from ..parallel.streaming import StreamingDataset
+
+    if prepare is None:
+        def prepare(batch):
+            return np.stack([img for _, img in batch])
+
+    def factory():
+        for batch in iter_decoded_chunks(
+                archive_paths, chunk_size, name_prefix):
+            yield prepare(batch)
+
+    tag = f"tar:{archive_paths[0]}" if archive_paths else "tar"
+    return StreamingDataset.from_chunks(
+        factory, chunk_size, n=n, tag=tag, **stream_kw)
+
+
 def load_tar_files(
     archive_paths: Sequence[str],
     labels_map: Callable[[str], object],

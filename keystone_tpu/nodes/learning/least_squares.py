@@ -274,6 +274,36 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
             labels = Densify().apply_dataset(labels)
         return self.default._fit(ds, labels)
 
+    # -- streaming fit (accumulate/finalize protocol) ----------------------
+    def accumulate(self, carry, chunk, labels):
+        """Streamed fits share the linear family's Gram/cross carry;
+        every Gram-capable candidate solver can finalize from it, so the
+        solver choice is deferred to :meth:`finalize` (where n, d, k are
+        all known exactly — no sampling, no extra pass)."""
+        from .linear import accumulate_gram_carry
+
+        return accumulate_gram_carry(carry, chunk, labels)
+
+    def finalize(self, carry):
+        """Cost-model choice over the GRAM-CAPABLE solvers at the exact
+        accumulated workload shape, via the SAME ``_choose`` surface the
+        optimizer uses (``streaming=True`` filters ``self.options`` to
+        solvers that can finalize from the one-pass carry — the LBFGS
+        candidates need per-pass data access a stream cannot provide).
+        The decision rides the active trace with ``shape_source:
+        "streamed"`` and ``streaming_restricted: true``."""
+        from ...parallel.mesh import get_mesh, num_data_shards
+
+        G, C, _, _, n = carry
+        d, k = int(G.shape[0]), int(C.shape[1])
+        # same machine count the static/sampled optimizer paths use —
+        # the cost surface must not shift between a streamed fit and a
+        # graph-optimized fit of the identical workload
+        machines = self.num_machines or num_data_shards(get_mesh())
+        choice = self._choose(n, d, k, 1.0, machines,
+                              "streamed", streaming=True)
+        return choice.node.finalize(carry)
+
     def optimize(self, sample: Dataset, sample_labels: Dataset, n: int,
                  num_machines: int) -> NodeChoice:
         d = _item_dim(sample)
@@ -300,11 +330,24 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         if d is None or k is None or sparsity is None:
             return None
         return self._choose(n, d, k, sparsity,
-                            self.num_machines or num_machines, "static")
+                            self.num_machines or num_machines, "static",
+                            streaming=getattr(spec, "streaming", False))
 
     def _choose(self, n: int, d: int, k: int, sparsity: float,
-                machines: int, shape_source: str) -> NodeChoice:
+                machines: int, shape_source: str,
+                streaming: bool = False) -> NodeChoice:
+        """``streaming=True`` restricts the surface to solvers that can
+        fit from the one-pass Gram/cross carry (exact, BlockLS): the
+        LBFGS candidates need repeated data passes a stream cannot
+        provide, and the Sparsify prefix is a host stage — choosing
+        either for a StreamingDataset would fail (or materialize) at
+        fit time."""
+        from ...parallel.streaming import is_streamable
+
         options = self.options
+        if streaming:
+            options = [(solver, choice) for solver, choice in options
+                       if is_streamable(choice.node)]
         costs = [
             (solver.cost(n, d, k, sparsity, machines, self.cpu_weight,
                          self.mem_weight, self.network_weight,
@@ -337,5 +380,6 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
                 },
                 "provenance": dict(self._weight_provenance),
                 "shape_source": shape_source,
+                **({"streaming_restricted": True} if streaming else {}),
             })
         return choice

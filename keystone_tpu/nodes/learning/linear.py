@@ -159,6 +159,28 @@ class LinearMapEstimator(LabelEstimator):
 
         return labels_width_fit(dep_specs)
 
+    # -- streaming fit (accumulate/finalize protocol) ----------------------
+    def accumulate(self, carry, chunk, labels):
+        """One chunk's contribution to the raw Gram/cross/sum carry (the
+        fused ``gram_cross`` kernel streams each row tile through VMEM
+        once). Padded chunk rows are zero, so sums stay exact."""
+        return accumulate_gram_carry(carry, chunk, labels)
+
+    def finalize(self, carry):
+        """Centered ridge normal equations from the accumulated raw
+        moments: Gc = G - n mu_x mu_x^T, Cc = C - n mu_x mu_y^T —
+        algebraically identical to the resident ``_fit``, with only the
+        carry (d x d + d x k) ever resident in HBM."""
+        G, C, sx, sy, n = carry
+        x_mean, y_mean, W = _finalize_normal_equations(
+            G, C, sx, sy, jnp.asarray(n, G.dtype),
+            jnp.asarray(float(self.lam or 0.0), G.dtype))
+        return LinearMapper(
+            W,
+            intercept=y_mean,
+            feature_scaler=StandardScalerModel(x_mean),
+        )
+
     def _fit(self, ds: Dataset, labels: Dataset) -> LinearMapper:
         ds, labels = ensure_array(ds), ensure_array(labels)
         n = ds.n
@@ -249,6 +271,103 @@ def _affine_params(W, mean, inv_std, b):
         jnp.ones((d,), dt) if inv_std is None else jnp.asarray(inv_std, dt),
         jnp.zeros((k,), dt) if b is None else jnp.asarray(b, dt),
     )
+
+
+# -- streaming carry (shared by the whole least-squares family) ------------
+#
+# The carry is the Spark analogue of per-partition Gram reduction
+# (SURVEY.md section 3.2): raw second moments (G = X^T X, C = X^T Y) plus
+# raw first moments (column sums) and the true row count. Centering is
+# recovered at finalize time (Gc = G - n mu mu^T), so accumulation is a
+# pure sum — chunk order cannot change the result beyond f32 rounding.
+
+
+@jax.jit
+def _gram_carry_update(G, C, sx, sy, X, Y):
+    from ...ops.pallas_kernels import gram_cross
+
+    X = X.astype(jnp.float32)
+    Y = Y.astype(jnp.float32)
+    g, c = gram_cross(X, Y)  # fused: one pass over the chunk's rows
+    return (G + g, C + c,
+            sx + jnp.sum(X, axis=0), sy + jnp.sum(Y, axis=0))
+
+
+def accumulate_gram_carry(carry, chunk, labels):
+    """Fold one (features, labels) chunk pair into the
+    ``(G, C, sx, sy, n)`` carry (``n`` stays a host int — it is the only
+    piece of the carry the driver loop reads). Chunks must be
+    ArrayDatasets with the zero-pad invariant (StreamingDataset output
+    or any masked resident dataset)."""
+    chunk, labels = ensure_array(chunk), ensure_array(labels)
+    X, Y = chunk.data, labels.data
+    if X.ndim != 2 or Y.ndim != 2:
+        raise ValueError(
+            f"streamed least-squares needs 2-D (n, d)/(n, k) chunks, got "
+            f"{X.shape} / {Y.shape}")
+    if X.shape[0] != Y.shape[0]:
+        raise ValueError(
+            f"chunk/labels padded rows differ: {X.shape[0]} vs "
+            f"{Y.shape[0]}")
+    if carry is None:
+        d, k = X.shape[1], Y.shape[1]
+        carry = (jnp.zeros((d, d), jnp.float32),
+                 jnp.zeros((d, k), jnp.float32),
+                 jnp.zeros((d,), jnp.float32),
+                 jnp.zeros((k,), jnp.float32), 0)
+    G, C, sx, sy, n = carry
+    G, C, sx, sy = _gram_carry_update(G, C, sx, sy, X, Y)
+    return (G, C, sx, sy, n + chunk.n)
+
+
+@jax.jit
+def _finalize_normal_equations(G, C, sx, sy, n, lam):
+    with linalg.solver_precision():
+        x_mean = sx / n
+        y_mean = sy / n
+        Gc = G - n * jnp.outer(x_mean, x_mean)
+        Cc = C - n * jnp.outer(x_mean, y_mean)
+        return x_mean, y_mean, linalg.ridge_cho_solve(Gc, Cc, lam)
+
+
+@functools.partial(jax.jit, static_argnames=("bounds", "num_iter"))
+def _gram_bcd(G, C, sx, sy, n, lam, bounds, num_iter):
+    """Block coordinate descent driven entirely from the accumulated
+    Gram/cross carry: the update
+
+        W_b <- (Gc[b,b] + lam I)^-1 (Cc[b] - Gc[b,:] W + Gc[b,b] W_b)
+
+    is algebraically the data-form update A_b^T (Yc - P + A_b W_b) of
+    ``ops.linalg.bcd_core`` (same sequential block order, same per-block
+    Cholesky reuse and breakdown recovery), so streamed and resident
+    BlockLS fits agree to f32 rounding — without the (n, d) data ever
+    being resident."""
+    with linalg.solver_precision():
+        dtype = G.dtype
+        k = C.shape[1]
+        x_mean = sx / n
+        y_mean = sy / n
+        Gc = G - n * jnp.outer(x_mean, x_mean)
+        Cc = C - n * jnp.outer(x_mean, y_mean)
+        factors, oks = [], []
+        for lo, hi in bounds:
+            Gb = Gc[lo:hi, lo:hi] + lam * jnp.eye(hi - lo, dtype=dtype)
+            L = jax.scipy.linalg.cho_factor(Gb, lower=True)
+            factors.append(L)
+            oks.append(linalg._chol_healthy(L[0], Gb))
+        W = jnp.zeros((G.shape[0], k), dtype)
+        for _ in range(num_iter):
+            for i, (lo, hi) in enumerate(bounds):
+                rhs = (Cc[lo:hi] - Gc[lo:hi, :] @ W
+                       + Gc[lo:hi, lo:hi] @ W[lo:hi])
+                Wi = jax.scipy.linalg.cho_solve(factors[i], rhs)
+                Wi = linalg._finite_or_eigh_solve(
+                    Wi,
+                    lambda lo=lo, hi=hi: Gc[lo:hi, lo:hi]
+                    + lam * jnp.eye(hi - lo, dtype=dtype),
+                    rhs, ok=oks[i])
+                W = W.at[lo:hi].set(Wi)
+        return tuple(W[lo:hi] for lo, hi in bounds), x_mean, y_mean
 
 
 @jax.jit
@@ -434,6 +553,24 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         from ...analysis.spec import labels_width_fit
 
         return labels_width_fit(dep_specs)
+
+    # -- streaming fit (accumulate/finalize protocol) ----------------------
+    def accumulate(self, carry, chunk, labels):
+        """Same carry as the exact solver: raw Gram + cross + sums. Note
+        the carry is (d, d) — streaming bounds HBM in ``n`` (the usual
+        out-of-core axis: n >> d), not in ``d``."""
+        return accumulate_gram_carry(carry, chunk, labels)
+
+    def finalize(self, carry):
+        G, C, sx, sy, n = carry
+        d = G.shape[0]
+        bs = self.block_size
+        bounds = tuple((i, min(d, i + bs)) for i in range(0, d, bs))
+        Ws, x_mean, y_mean = _gram_bcd(
+            G, C, sx, sy, jnp.asarray(n, G.dtype),
+            jnp.asarray(float(self.lam), G.dtype), bounds, self.num_iter)
+        return BlockLinearMapper(
+            list(Ws), bs, intercept=y_mean, feature_means=x_mean)
 
     def _fit(self, ds: Dataset, labels: Dataset) -> BlockLinearMapper:
         ds, labels = ensure_array(ds), ensure_array(labels)

@@ -192,8 +192,25 @@ class StandardScaler(Estimator):
 
     def _fit(self, ds: Dataset) -> StandardScalerModel:
         assert isinstance(ds, ArrayDataset), "StandardScaler needs array data"
-        n = ds.n
         s, sq = _moments(ds.data)
+        return self.finalize((s, sq, ds.n))
+
+    # -- streaming fit (accumulate/finalize protocol) ----------------------
+    def accumulate(self, carry, chunk):
+        """Fold one chunk's column sums / sums-of-squares into the carry
+        (padded rows are zero, so the moments stay exact); the resident
+        ``_fit`` is the one-chunk special case of this."""
+        assert isinstance(chunk, ArrayDataset), \
+            "StandardScaler streams over array chunks"
+        if carry is None:
+            s, sq = _moments(chunk.data)
+            return (s, sq, chunk.n)
+        S, SQ, n = carry
+        S, SQ = _accum_moments(S, SQ, chunk.data)
+        return (S, SQ, n + chunk.n)
+
+    def finalize(self, carry) -> StandardScalerModel:
+        s, sq, n = carry
         mean = np.asarray(s, dtype=np.float64) / n
         if not self.normalize_std_dev:
             return StandardScalerModel(mean.astype(np.float32))
@@ -210,6 +227,11 @@ class StandardScaler(Estimator):
 @jax.jit
 def _moments(X):
     return jnp.sum(X, axis=0), jnp.sum(X * X, axis=0)
+
+
+@jax.jit
+def _accum_moments(S, SQ, X):
+    return S + jnp.sum(X, axis=0), SQ + jnp.sum(X * X, axis=0)
 
 
 from ...workflow.transformer import HostTransformer  # noqa: E402

@@ -126,6 +126,12 @@ class Densify(Transformer):
 
         if isinstance(ds, ArrayDataset):
             return ds
+        from ...parallel.dataset import is_streaming
+
+        if is_streaming(ds):
+            # StreamingDataset: chunks are already dense device arrays;
+            # collect() here would silently materialize the stream
+            return ds
         items = ds.collect()
         dense = [
             np.asarray(

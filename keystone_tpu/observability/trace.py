@@ -115,6 +115,14 @@ class PipelineTrace:
         self.auto_cache: List[Dict[str, Any]] = []
         self.node_choices: List[Dict[str, Any]] = []
         self.solver_decisions: List[Dict[str, Any]] = []
+        #: most recent streamed-ingest chunk entries (bounded tail —
+        #: an out-of-core fit can stream millions of chunks, so exact
+        #: aggregates live in ``chunk_stats`` and only CHUNK_TAIL raw
+        #: entries are retained for inspection)
+        self.chunks: List[Dict[str, Any]] = []
+        self.chunk_stats: Dict[str, float] = {
+            "count": 0, "ingest_stall_s": 0.0, "nbytes": 0.0,
+            "occupancy_sum": 0.0}
         self.meta: Dict[str, Any] = {}
         self.wall_s: float = 0.0
         self._t0: Optional[float] = None
@@ -188,6 +196,33 @@ class PipelineTrace:
     def record_solver_decision(self, entry: Dict[str, Any]) -> None:
         self.solver_decisions.append(entry)
 
+    #: raw per-chunk entries retained (the aggregates in ``chunk_stats``
+    #: are exact over ALL chunks regardless)
+    CHUNK_TAIL = 512
+
+    def record_chunk(self, entry: Dict[str, Any]) -> None:
+        """One streamed ingest chunk (``parallel.streaming``): source
+        tag, chunk index, true row count, device footprint, the time the
+        consumer stalled waiting for ingest, and the prefetch-buffer
+        occupancy at hand-off. The per-chunk stall attribution is the
+        evidence behind 'ingest overlaps compute' claims. Aggregates
+        are exact; raw entries keep only the most recent ``CHUNK_TAIL``
+        (an out-of-core fit can stream unboundedly many chunks)."""
+        s = self.chunk_stats
+        s["count"] += 1
+        s["ingest_stall_s"] += float(entry.get("ingest_stall_s", 0.0))
+        s["nbytes"] += float(entry.get("nbytes", 0.0))
+        s["occupancy_sum"] += float(entry.get("prefetch_occupancy", 0.0))
+        self.chunks.append(entry)
+        if len(self.chunks) > self.CHUNK_TAIL:
+            del self.chunks[: len(self.chunks) - self.CHUNK_TAIL]
+
+    def ingest_stall_s(self) -> float:
+        """Total consumer-side ingest stall across ALL streamed chunks
+        (exact aggregate) — compare against ``wall_s`` for the overlap
+        share."""
+        return float(self.chunk_stats["ingest_stall_s"])
+
     # -- views ------------------------------------------------------------
     def node_ids(self) -> set:
         return {r.node_id for r in self.nodes}
@@ -209,6 +244,8 @@ class PipelineTrace:
             "auto_cache": list(self.auto_cache),
             "node_choices": list(self.node_choices),
             "solver_decisions": list(self.solver_decisions),
+            "chunks": list(self.chunks),
+            "chunk_stats": dict(self.chunk_stats),
         }
 
     def to_json(self, indent: Optional[int] = 2) -> str:
@@ -225,6 +262,21 @@ class PipelineTrace:
         tr.auto_cache = list(data.get("auto_cache", []))
         tr.node_choices = list(data.get("node_choices", []))
         tr.solver_decisions = list(data.get("solver_decisions", []))
+        tr.chunks = list(data.get("chunks", []))
+        stats = data.get("chunk_stats")
+        if stats is None and tr.chunks:  # older artifact: rebuild
+            stats = {
+                "count": len(tr.chunks),
+                "ingest_stall_s": sum(
+                    float(c.get("ingest_stall_s", 0.0)) for c in tr.chunks),
+                "nbytes": sum(
+                    float(c.get("nbytes", 0.0)) for c in tr.chunks),
+                "occupancy_sum": sum(
+                    float(c.get("prefetch_occupancy", 0.0))
+                    for c in tr.chunks),
+            }
+        if stats is not None:
+            tr.chunk_stats = dict(stats)
         return tr
 
     def summary(self, top: int = 0) -> str:
@@ -265,12 +317,23 @@ class PipelineTrace:
                 f"node(s) {sel} under budget "
                 f"{rep.get('budget_bytes', 0) / (1 << 20):.0f} MiB "
                 f"(profiled {len(rep.get('profiles', {}))} nodes)")
+        if self.chunk_stats["count"]:
+            count = int(self.chunk_stats["count"])
+            stall = self.ingest_stall_s()
+            share = (100.0 * stall / self.wall_s) if self.wall_s else 0.0
+            lines.append(
+                f"streamed ingest: {count} chunk(s), "
+                f"stall {stall:.3f}s ({share:.1f}% of wall), "
+                f"mean prefetch occupancy "
+                f"{self.chunk_stats['occupancy_sum'] / count:.2f}")
         for d in self.solver_decisions:
             costs = ", ".join(
                 f"{k}={v:.3g}s" for k, v in d.get("costs", {}).items())
+            sp = d.get("sparsity")
+            sp = "?" if sp is None else f"{sp:.3g}"  # trimmed artifacts
             lines.append(
                 f"solver choice @ n={d.get('n')} d={d.get('d')} "
-                f"k={d.get('k')} sparsity={d.get('sparsity'):.3g}: "
+                f"k={d.get('k')} sparsity={sp}: "
                 f"{d.get('chosen')} ({costs}) "
                 f"[weights: {d.get('provenance', {}).get('source', '?')}]")
         return "\n".join(lines)

@@ -1,0 +1,24 @@
+"""Distributed-collection substrate: mesh, datasets, streaming ingest."""
+from .dataset import (
+    ArrayDataset,
+    Dataset,
+    HostDataset,
+    as_dataset,
+    device_nbytes,
+    ensure_array,
+    to_numpy,
+)
+from .streaming import StreamingDataset, fit_streaming, is_streamable
+
+__all__ = [
+    "ArrayDataset",
+    "Dataset",
+    "HostDataset",
+    "StreamingDataset",
+    "as_dataset",
+    "device_nbytes",
+    "ensure_array",
+    "fit_streaming",
+    "is_streamable",
+    "to_numpy",
+]

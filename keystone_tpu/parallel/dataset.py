@@ -40,6 +40,15 @@ def _pad_to(x: np.ndarray, rows: int) -> np.ndarray:
     return np.pad(x, pad)
 
 
+def is_streaming(ds: Any) -> bool:
+    """True for chunked streaming datasets (``parallel.streaming``).
+    Duck-typed on the chunk API so layers imported BELOW the streaming
+    module (this one, ``workflow.transformer``, node rules) share one
+    predicate without an import cycle; everything dispatching on
+    streams goes through here."""
+    return isinstance(ds, Dataset) and hasattr(ds, "map_chunks")
+
+
 class Dataset:
     """Abstract distributed collection of items."""
 
@@ -302,6 +311,11 @@ def device_nbytes(value: Any) -> float:
         per = sum(
             float(getattr(it, "nbytes", 64)) for it in sample) / len(sample)
         return per * len(items)
+    if is_streaming(value):
+        # StreamingDataset: device residency is the bounded prefetch
+        # buffer plus the working chunk — NOT the logical dataset size.
+        # This is the number the out-of-core HBM-budget assertion reads.
+        return float(value.buffered_nbytes())
     if isinstance(value, Dataset):
         # unknown future subclass: nominal per-item charge — never
         # collect() here, that's the gather this hot path must not do
@@ -333,6 +347,14 @@ def ensure_array(ds: "Dataset", mesh: Optional[Mesh] = None) -> "ArrayDataset":
         return ds
     if isinstance(ds, (np.ndarray, jnp.ndarray)):
         return ArrayDataset.from_numpy(np.asarray(ds), mesh)
+    if is_streaming(ds):
+        raise TypeError(
+            "a StreamingDataset cannot be implicitly promoted to a "
+            "device-resident ArrayDataset (that would materialize the "
+            "whole stream in HBM — the exact thing streaming exists to "
+            "avoid). Fit with a streamable estimator "
+            "(parallel.streaming.fit_streaming), or call "
+            ".materialize() explicitly if the stream is known to fit.")
     assert isinstance(ds, HostDataset), type(ds)
     return ds.to_device(mesh)
 

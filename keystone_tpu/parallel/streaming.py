@@ -1,0 +1,555 @@
+"""Streaming chunked execution: double-buffered host->device ingest.
+
+The reference framework never materializes a whole featurized dataset:
+Spark streams partitions through narrow stages and the solvers reduce
+per-partition Gram/cross products (SURVEY.md section 3.2). The TPU port
+lost that property — ``ArrayDataset`` requires the full dataset
+device-resident before any fit. This module restores it:
+
+* :class:`StreamingDataset` — yields fixed-shape, zero-padded, masked
+  :class:`~keystone_tpu.parallel.dataset.ArrayDataset` chunks from a
+  host source (item iterables, pre-chunked decode pools like
+  ``loaders.image_loader_utils.iter_decoded_chunks``, resident numpy).
+  A background prefetch thread stages (pad + ``device_put``) the next
+  chunks behind a bounded queue (``prefetch_depth``, default 2 — a
+  double buffer), so chunk *i+1* decodes/uploads while chunk *i*
+  computes. Every chunk is padded to the SAME ``chunk_size`` rows, so
+  per-chunk transformer programs compile once per chain structure
+  (PERFORMANCE.md rules 5-6) and the second epoch compiles nothing.
+
+* the **accumulate/finalize protocol** — a streamable estimator
+  implements ``accumulate(carry, chunk[, labels_chunk]) -> carry`` and
+  ``finalize(carry) -> Transformer``; :func:`fit_streaming` drives the
+  chunk loop. LeastSquares/BlockLS accumulate Gram + cross products via
+  the fused ``ops.pallas_kernels.gram_cross`` streaming kernel,
+  StandardScaler accumulates moments — a fit never holds the full
+  featurized matrix in HBM, so datasets larger than HBM fit out-of-core
+  (device residency is bounded by ``device_nbytes(stream)``: the
+  prefetch buffer plus one working chunk).
+
+Observability: consuming a stream feeds the process metrics
+(``streaming.ingest_stall_s`` histogram — time the device-side consumer
+waited on ingest; ``streaming.prefetch_occupancy`` gauge;
+``streaming.chunks_total`` counter) and, when a
+:class:`~keystone_tpu.observability.PipelineTrace` is active, per-chunk
+trace entries with ingest-stall attribution.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..observability.metrics import MetricsRegistry
+from ..observability.trace import current_trace
+from .dataset import ArrayDataset, Dataset, HostDataset, _pad_to, device_nbytes
+from .mesh import batch_sharding, get_mesh, num_data_shards
+
+_DONE = object()
+
+
+class _SourceError:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+class _IterLedger:
+    """One active ``chunks()`` iteration's contribution to the shared
+    residency (so concurrent iterations — e.g. a data stream and a
+    labels view derived from the same root — compose instead of
+    clobbering each other's accounting)."""
+
+    __slots__ = ("buffered", "working")
+
+    def __init__(self) -> None:
+        self.buffered = 0.0
+        self.working = 0.0
+
+
+class _Residency:
+    """Thread-safe device-residency ledger for one prefetch pipeline:
+    bytes staged in the queue + working chunks, with a peak high-water
+    mark. One instance is shared by a root stream and all its derived
+    (mapped) views; each live ``chunks()`` iteration tracks its own
+    contribution through an :class:`_IterLedger`, and closing an
+    iteration removes exactly that contribution — never another
+    iteration's."""
+
+    __slots__ = ("_lock", "buffered", "working", "chunk_nbytes", "peak")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.buffered = 0.0
+        self.working = 0.0
+        self.chunk_nbytes = 0.0
+        self.peak = 0.0
+
+    def stage(self, it: _IterLedger, nbytes: float) -> None:
+        with self._lock:
+            self.chunk_nbytes = nbytes
+            it.buffered += nbytes
+            self.buffered += nbytes
+            self.peak = max(self.peak, self.buffered + self.working)
+
+    def hand_off(self, it: _IterLedger, nbytes: float) -> None:
+        with self._lock:
+            self.buffered -= nbytes
+            it.buffered -= nbytes
+            # this iteration's previous working chunk is released;
+            # other iterations' working chunks stay counted
+            self.working += nbytes - it.working
+            it.working = nbytes
+
+    def close(self, it: _IterLedger) -> None:
+        """Remove one finished iteration's residual contribution (its
+        still-buffered chunks and working chunk)."""
+        with self._lock:
+            self.buffered -= it.buffered
+            self.working -= it.working
+            it.buffered = 0.0
+            it.working = 0.0
+
+    def live(self) -> float:
+        with self._lock:
+            return self.buffered + self.working
+
+
+class StreamingDataset(Dataset):
+    """Chunked, prefetched view of a host data source.
+
+    ``chunk_source`` is a CALLABLE returning a fresh iterator of host
+    chunks (so the stream is re-iterable: multi-pass estimators and
+    repeated epochs re-open the source); each host chunk is a pytree of
+    numpy-like arrays sharing a leading dim of at most ``chunk_size``
+    rows. Chunks are padded with zero rows to exactly ``chunk_size``
+    (rounded up to a shard multiple), staged to the mesh on a background
+    thread, and yielded as masked :class:`ArrayDataset`\\ s whose ``n``
+    is the chunk's true row count — the zero-pad invariant linear
+    reductions rely on holds per chunk.
+
+    ``n`` (the total item count) may be known or unknown (None); the
+    static analyzer carries either through ``DatasetSpec``.
+    """
+
+    def __init__(self, chunk_source: Callable[[], Iterator[Any]],
+                 chunk_size: int, n: Optional[int] = None,
+                 mesh: Optional[Mesh] = None, prefetch_depth: int = 2,
+                 tag: Optional[str] = None,
+                 _transforms: Tuple[Callable, ...] = ()):
+        if not callable(chunk_source):
+            raise TypeError(
+                "chunk_source must be a callable returning a fresh chunk "
+                "iterator (one-shot generators cannot support re-iteration "
+                "— wrap the construction in a function)")
+        if prefetch_depth < 1:
+            raise ValueError("prefetch_depth must be >= 1")
+        self.mesh = mesh or get_mesh()
+        # every chunk pads to one fixed shape: a shard-divisible row
+        # count means ONE compiled program per chain serves all chunks
+        self.chunk_size = _round_up(int(chunk_size),
+                                    num_data_shards(self.mesh))
+        self.n = None if n is None else int(n)
+        self.prefetch_depth = int(prefetch_depth)
+        self.tag = tag
+        self._chunk_source = chunk_source
+        self._transforms = tuple(_transforms)
+        # device-residency accounting (the out-of-core budget evidence):
+        # bytes sitting in the prefetch queue plus the working chunk.
+        # SHARED between a root stream and every map/map_chunks
+        # derivation of it — only one prefetch pipeline runs, and the
+        # budget must be readable from whichever handle the caller kept.
+        self._residency = _Residency()
+
+    # -- derivation --------------------------------------------------------
+    def _derive(self, transform: Callable[[ArrayDataset], ArrayDataset],
+                tag: Optional[str] = None) -> "StreamingDataset":
+        out = StreamingDataset(
+            self._chunk_source, self.chunk_size, n=self.n, mesh=self.mesh,
+            prefetch_depth=self.prefetch_depth, tag=tag or self.tag,
+            _transforms=self._transforms + (transform,))
+        out._residency = self._residency  # shared budget accounting
+        return out
+
+    def map(self, fn: Callable[[Any], Any]) -> "StreamingDataset":
+        """Per-item device transform, applied chunk-wise (lazy: nothing
+        runs until the stream is consumed)."""
+        return self._derive(lambda ad: ad.map(fn))
+
+    def map_chunks(
+        self, fn: Callable[[ArrayDataset], ArrayDataset]
+    ) -> "StreamingDataset":
+        """Chunk-level transform (an ``ArrayDataset -> ArrayDataset``
+        function, e.g. a transformer's ``apply_dataset``), lazy."""
+        return self._derive(fn)
+
+    def __len__(self) -> int:
+        if self.n is None:
+            raise TypeError(
+                "StreamingDataset length is unknown (n=None); consume the "
+                "stream or construct with an explicit n")
+        return self.n
+
+    # -- staging -----------------------------------------------------------
+    def _stage(self, raw: Any) -> ArrayDataset:
+        """Pad a host chunk to ``chunk_size`` rows and put it on the mesh
+        (runs on the prefetch thread; jax device transfers are
+        thread-safe and async, so the upload overlaps the consumer's
+        compute)."""
+        leaves = jax.tree_util.tree_leaves(raw)
+        if not leaves:
+            raise ValueError("empty chunk from source")
+        rows = int(np.shape(leaves[0])[0])
+        if rows > self.chunk_size:
+            raise ValueError(
+                f"source chunk has {rows} rows > chunk_size "
+                f"{self.chunk_size}")
+        sh = batch_sharding(self.mesh)
+        data = jax.tree_util.tree_map(
+            lambda x: jax.device_put(
+                _pad_to(np.asarray(x), self.chunk_size), sh), raw)
+        return ArrayDataset(data, rows, self.mesh, _already_sharded=True)
+
+    def chunks(self) -> Iterator[ArrayDataset]:
+        """Iterate device chunks with background prefetch. Each call
+        re-opens the source (a fresh epoch); breaking out of the loop
+        stops the producer thread."""
+        reg = MetricsRegistry.get_or_create()
+        # the queue itself is unbounded; SLOTS is the bound, acquired
+        # BEFORE staging so at most prefetch_depth chunks are ever
+        # staged-or-queued at once. Gating the queue alone would let the
+        # producer stage chunk depth+1 while blocked on a full queue,
+        # putting (depth + 2) chunks live against the documented
+        # (depth + 1)-chunk budget (review finding, reproduced).
+        q: queue.Queue = queue.Queue()
+        slots = threading.Semaphore(self.prefetch_depth)
+        stop = threading.Event()
+        it_ledger = _IterLedger()
+
+        def acquire_slot() -> bool:
+            while not stop.is_set():
+                if slots.acquire(timeout=0.05):
+                    return True
+            return False
+
+        def produce():
+            try:
+                for raw in self._chunk_source():
+                    if not acquire_slot():
+                        return
+                    ad = self._stage(raw)
+                    nbytes = device_nbytes(ad)
+                    self._residency.stage(it_ledger, nbytes)
+                    q.put((ad, nbytes))
+                q.put(_DONE)
+            except BaseException as exc:  # surfaced on the consumer side
+                q.put(_SourceError(exc))
+            finally:
+                if stop.is_set():
+                    # the consumer is gone (early exit) — it may have
+                    # closed the ledger while this thread was still
+                    # inside _stage() (its bounded join timed out), so
+                    # remove whatever this iteration still holds;
+                    # close() is idempotent over an already-zeroed
+                    # ledger, so racing the consumer's close is safe
+                    self._residency.close(it_ledger)
+
+        producer = threading.Thread(
+            target=produce, name="keystone-stream-prefetch", daemon=True)
+        producer.start()
+        seen = 0
+        rows_seen = 0
+        complete = False
+        trace = current_trace()
+        try:
+            while True:
+                t0 = time.perf_counter()
+                item = q.get()
+                stall = time.perf_counter() - t0
+                if item is _DONE:
+                    complete = True
+                    break
+                if isinstance(item, _SourceError):
+                    raise item.exc
+                ad, nbytes = item
+                occupancy = q.qsize()
+                self._residency.hand_off(it_ledger, nbytes)
+                # the chunk left the buffer: free its staging slot so
+                # the producer can stage the next one while this chunk
+                # computes — steady state is depth staged + 1 working
+                slots.release()
+                reg.histogram("streaming.ingest_stall_s").observe(stall)
+                reg.gauge("streaming.prefetch_occupancy").set(occupancy)
+                reg.counter("streaming.chunks_total").inc()
+                if trace is not None:
+                    trace.record_chunk({
+                        "source": self.tag or "stream",
+                        "chunk": seen,
+                        "n": ad.n,
+                        "padded_n": ad.padded_n,
+                        "nbytes": nbytes,
+                        "ingest_stall_s": stall,
+                        "prefetch_occupancy": occupancy,
+                    })
+                out = ad
+                for f in self._transforms:
+                    out = f(out)
+                yield out
+                seen += 1
+                rows_seen += ad.n
+        finally:
+            stop.set()
+            # join BEFORE closing the ledger: a producer mid-_stage()
+            # at early exit would otherwise call stage() after the
+            # close and permanently inflate the shared residency (the
+            # next epoch's budget assert would then trip spuriously);
+            # close() removes only THIS iteration's contribution, so a
+            # concurrently running sibling iteration stays accounted
+            producer.join(timeout=5.0)
+            self._residency.close(it_ledger)
+        if complete and self.n is None:
+            self.n = rows_seen  # a full pass pins the unknown length
+
+    def __iter__(self) -> Iterator[ArrayDataset]:
+        return self.chunks()
+
+    def buffered_nbytes(self) -> float:
+        """Current device residency of this stream: chunks staged in the
+        prefetch buffer plus the working chunk handed to the consumer.
+        ``parallel.dataset.device_nbytes`` reports this for streams, so
+        the out-of-core HBM bound is assertable from the outside."""
+        return self._residency.live()
+
+    def chunk_nbytes(self) -> float:
+        """Footprint of one staged chunk (the working-set unit of the
+        HBM budget: budget >= (prefetch_depth + 1) * chunk_nbytes)."""
+        return self._residency.chunk_nbytes
+
+    @property
+    def peak_device_nbytes(self) -> float:
+        """High-water mark of the stream's device residency (shared
+        across a root stream and its derived views)."""
+        return self._residency.peak
+
+    # -- element spec (static analysis) ------------------------------------
+    def element(self) -> Optional[Any]:
+        """Per-item element spec (``jax.ShapeDtypeStruct`` pytree) if it
+        can be described without consuming the stream, else None. Known
+        exactly for numpy/item-backed sources (their first item is
+        inspectable); chunked opaque sources return None -> the analyzer
+        carries an Unknown element but still knows it is a stream."""
+        probe = getattr(self, "_element_probe", None)
+        if probe is None:
+            return None
+        return probe()
+
+    # -- materialization ---------------------------------------------------
+    def materialize(self) -> ArrayDataset:
+        """Collect every chunk to one resident ArrayDataset (parity
+        tests, small streams). Defeats the purpose for big data — the
+        point of streaming is never doing this."""
+        parts: List[Any] = []
+        n = 0
+        for chunk in self.chunks():
+            parts.append(chunk.numpy())
+            n += chunk.n
+        if not parts:
+            raise ValueError("empty stream")
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: np.concatenate(xs, axis=0), *parts)
+        return ArrayDataset(stacked, n, self.mesh, tag=self.tag)
+
+    def collect(self) -> List[Any]:
+        return self.materialize().collect()
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def from_chunks(factory: Callable[[], Iterator[Any]], chunk_size: int,
+                    n: Optional[int] = None, **kw) -> "StreamingDataset":
+        """Stream pre-stacked host chunks from ``factory()`` (e.g. the
+        tar decode pool via ``loaders.image_loader_utils``)."""
+        return StreamingDataset(factory, chunk_size, n=n, **kw)
+
+    @staticmethod
+    def from_items(items: Optional[Sequence[Any]] = None, *,
+                   source: Optional[Callable[[], Iterable[Any]]] = None,
+                   chunk_size: int = 256, **kw) -> "StreamingDataset":
+        """Stream per-item pytrees (a sequence, or ``source=`` callable
+        yielding items), stacked into chunks of ``chunk_size``."""
+        if (items is None) == (source is None):
+            raise TypeError("pass exactly one of items or source=")
+        if source is None:
+            seq = list(items)
+            source = lambda: iter(seq)  # noqa: E731
+            kw.setdefault("n", len(seq))
+
+        def chunked():
+            buf: List[Any] = []
+            for it in source():
+                buf.append(it)
+                if len(buf) == chunk_size:
+                    yield jax.tree_util.tree_map(
+                        lambda *xs: np.stack(xs), *buf)
+                    buf = []
+            if buf:
+                yield jax.tree_util.tree_map(lambda *xs: np.stack(xs), *buf)
+
+        out = StreamingDataset(chunked, chunk_size, **kw)
+        if items is not None and seq:
+            from ..analysis.spec import struct_of
+
+            out._element_probe = lambda: struct_of(seq[0])
+        return out
+
+    @staticmethod
+    def from_numpy(array: Any, chunk_size: int, mesh: Optional[Mesh] = None,
+                   **kw) -> "StreamingDataset":
+        """Chunk a resident host pytree (the parity/testing path, and
+        the honest way to bound HBM when host RAM holds what HBM
+        cannot)."""
+        leaves = jax.tree_util.tree_leaves(array)
+        if not leaves:
+            raise ValueError("empty pytree")
+        total = int(np.shape(leaves[0])[0])
+
+        def chunked():
+            for lo in range(0, total, chunk_size):
+                yield jax.tree_util.tree_map(
+                    lambda x: np.asarray(x)[lo:lo + chunk_size], array)
+
+        out = StreamingDataset(chunked, chunk_size, n=total, mesh=mesh, **kw)
+        out._element_probe = lambda: jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(
+                tuple(np.shape(x)[1:]), np.asarray(x).dtype), array)
+        return out
+
+    @staticmethod
+    def from_host_dataset(ds: HostDataset, chunk_size: int,
+                          **kw) -> "StreamingDataset":
+        return StreamingDataset.from_items(
+            [np.asarray(x) for x in ds.items], chunk_size=chunk_size, **kw)
+
+
+# -- accumulate/finalize protocol ------------------------------------------
+
+def is_streamable(estimator: Any) -> bool:
+    """True when ``estimator`` implements the streaming fit protocol:
+    ``accumulate(carry, chunk[, labels_chunk])`` + ``finalize(carry)``."""
+    return callable(getattr(estimator, "accumulate", None)) and callable(
+        getattr(estimator, "finalize", None))
+
+
+def _non_streamable_error(estimator: Any) -> TypeError:
+    label = getattr(estimator, "label", None)
+    name = label() if callable(label) else type(estimator).__name__
+    return TypeError(
+        f"estimator {name!r} cannot fit a StreamingDataset: it does not "
+        "implement the streaming protocol (accumulate(carry, chunk[, "
+        "labels]) / finalize(carry)). Materialize the stream first "
+        "(StreamingDataset.materialize()) if it fits in HBM, or use a "
+        "streamable estimator (LeastSquares family, StandardScaler). "
+        "`python -m keystone_tpu check` flags this statically as "
+        "'non-streamable-fit'.")
+
+
+def _paired_chunks(data: StreamingDataset,
+                   labels: Any) -> Iterator[Tuple[ArrayDataset,
+                                                  Optional[ArrayDataset]]]:
+    """Yield (data_chunk, labels_chunk) with IDENTICAL padded shapes.
+
+    ``labels`` may be None (plain estimators), an aligned
+    StreamingDataset (chunk row counts must match), or a resident
+    dataset/array sliced by running offset (labels are k-wide — tiny
+    next to the streamed features, so residency is fine).
+    """
+    if labels is None:
+        for chunk in data.chunks():
+            yield chunk, None
+        return
+    if isinstance(labels, StreamingDataset):
+        data_it, labels_it = data.chunks(), labels.chunks()
+        for chunk in data_it:
+            try:
+                lchunk = next(labels_it)
+            except StopIteration:
+                raise ValueError(
+                    "labels stream ended before the data stream")
+            if lchunk.n != chunk.n:
+                raise ValueError(
+                    f"misaligned streams: data chunk has {chunk.n} rows, "
+                    f"labels chunk has {lchunk.n}")
+            yield chunk, lchunk
+        # the mirrored check: leftover label chunks mean the pairs were
+        # row-shifted — silently truncating would fit a wrong model
+        try:
+            next(labels_it)
+        except StopIteration:
+            return
+        raise ValueError("misaligned streams: labels stream has more "
+                         "rows than the data stream")
+    # resident labels: slice rows to follow the stream
+    from .dataset import to_numpy
+
+    host = to_numpy(labels)
+    sh = batch_sharding(data.mesh)
+    off = 0
+    for chunk in data.chunks():
+        rows = host[off:off + chunk.n]
+        if rows.shape[0] != chunk.n:
+            raise ValueError(
+                f"labels exhausted at row {off}: stream yielded more "
+                f"rows than len(labels)={host.shape[0]}")
+        off += chunk.n
+        padded = jax.device_put(_pad_to(rows, chunk.padded_n), sh)
+        yield chunk, ArrayDataset(
+            padded, chunk.n, data.mesh, _already_sharded=True)
+    if off != host.shape[0]:
+        raise ValueError(
+            f"misaligned labels: the data stream yielded {off} rows but "
+            f"len(labels)={host.shape[0]} — refusing to silently "
+            "truncate")
+
+
+def fit_streaming(estimator: Any, data: StreamingDataset,
+                  labels: Any = None, hbm_budget: Optional[float] = None):
+    """Drive a streamable estimator over a chunked dataset: one
+    ``accumulate`` per chunk, then ``finalize`` — the featurized matrix
+    never exists on device, only the carry (Gram/cross/moments) and the
+    bounded prefetch buffer do.
+
+    ``hbm_budget`` (bytes), when given, asserts after every chunk that
+    the stream's device residency (prefetch buffer + working chunk) has
+    stayed within ``budget``: the out-of-core guarantee, checkable.
+    """
+    if not is_streamable(estimator):
+        raise _non_streamable_error(estimator)
+    takes_labels = labels is not None
+    carry = None
+    chunks_seen = 0
+    for chunk, lchunk in _paired_chunks(data, labels):
+        if takes_labels:
+            carry = estimator.accumulate(carry, chunk, lchunk)
+        else:
+            carry = estimator.accumulate(carry, chunk)
+        chunks_seen += 1
+        if hbm_budget is not None:
+            resident = data.buffered_nbytes()
+            if resident > hbm_budget:
+                raise MemoryError(
+                    f"streamed fit exceeded its HBM budget: "
+                    f"{resident:.0f} B resident > {hbm_budget:.0f} B "
+                    f"(chunk {chunks_seen}; shrink chunk_size or "
+                    "prefetch_depth)")
+    if carry is None:
+        raise ValueError("empty stream: nothing to fit")
+    return estimator.finalize(carry)

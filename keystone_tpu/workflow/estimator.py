@@ -19,17 +19,31 @@ from .transformer import Transformer
 class Estimator(EstimatorOperator):
     def fit(self, data: Any) -> Transformer:
         """Eagerly fit on a dataset (or raw arrays), returning the fitted
-        transformer (reference ``Estimator.fit``, Estimator.scala:20)."""
+        transformer (reference ``Estimator.fit``, Estimator.scala:20).
+
+        A :class:`~keystone_tpu.parallel.streaming.StreamingDataset`
+        routes through the accumulate/finalize protocol
+        (``parallel.streaming.fit_streaming``): the fit consumes one
+        bounded chunk at a time and never materializes the dataset in
+        HBM. Non-streamable estimators raise a clear error (flagged
+        statically as ``non-streamable-fit`` by the check CLI)."""
+        from ..parallel.streaming import StreamingDataset, fit_streaming
         from .pipeline import PipelineDataset
 
         if isinstance(data, PipelineDataset):
             data = data.get()
+        if isinstance(data, StreamingDataset):
+            return fit_streaming(self, data)
         return self._fit(as_dataset(data))
 
     def _fit(self, ds: Dataset) -> Transformer:
         raise NotImplementedError
 
     def fit_datasets(self, inputs):
+        from ..parallel.streaming import StreamingDataset, fit_streaming
+
+        if isinstance(inputs[0], StreamingDataset):
+            return fit_streaming(self, inputs[0])
         return self._fit(inputs[0])
 
     def with_data(self, data: DataInput) -> Pipeline:

@@ -18,18 +18,39 @@ from .transformer import Transformer
 
 class LabelEstimator(EstimatorOperator):
     def fit(self, data: Any, labels: Any) -> Transformer:
+        """Eager fit; a streamed ``data`` routes through the
+        accumulate/finalize protocol (``labels`` may be an aligned
+        StreamingDataset or a resident dataset sliced chunk-wise)."""
+        from ..parallel.streaming import StreamingDataset, fit_streaming
         from .pipeline import PipelineDataset
 
         if isinstance(data, PipelineDataset):
             data = data.get()
         if isinstance(labels, PipelineDataset):
             labels = labels.get()
+        if isinstance(data, StreamingDataset):
+            return fit_streaming(self, data, labels)
+        if isinstance(labels, StreamingDataset):
+            raise TypeError(
+                f"{self.label()}: labels are a StreamingDataset but the "
+                "data is resident — the chunk loop is driven by the DATA "
+                "stream. Stream the data too (chunk sizes must align), or "
+                "materialize() the labels (they are k-wide, usually tiny).")
         return self._fit(as_dataset(data), as_dataset(labels))
 
     def _fit(self, ds: Dataset, labels: Dataset) -> Transformer:
         raise NotImplementedError
 
     def fit_datasets(self, inputs):
+        from ..parallel.streaming import StreamingDataset, fit_streaming
+
+        if isinstance(inputs[0], StreamingDataset):
+            return fit_streaming(self, inputs[0], inputs[1])
+        if isinstance(inputs[1], StreamingDataset):
+            raise TypeError(
+                f"{self.label()}: labels are a StreamingDataset but the "
+                "data is resident — the chunk loop is driven by the DATA "
+                "stream. Stream the data too, or materialize() the labels.")
         return self._fit(inputs[0], inputs[1])
 
     def with_data(self, data: DataInput, labels: DataInput) -> Pipeline:

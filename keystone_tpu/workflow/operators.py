@@ -206,6 +206,8 @@ class TransformerOperator(Operator):
             n=min(ns) if ns else None,  # zip semantics across inputs
             host=all(d.host for d in datasets),
             sparsity=dense_sparsity(out),
+            # mapping a stream yields a stream (chunk-wise application)
+            streaming=any(d.streaming for d in datasets),
         )
 
 
@@ -276,7 +278,8 @@ class DelegatingOperator(Operator):
         if isinstance(data[0], DatumSpec):
             return DatumSpec(out)
         return DatasetSpec(out, n=data[0].n, host=data[0].host,
-                           sparsity=dense_sparsity(out))
+                           sparsity=dense_sparsity(out),
+                           streaming=data[0].streaming)
 
     def label(self) -> str:
         return "Delegate"

@@ -35,7 +35,7 @@ from ..operators import (
     ExpressionOperator,
     Operator,
 )
-from .node_rule import _sample_dataset
+from .node_rule import _dataset_len, _sample_dataset
 from .rule import Rule
 
 
@@ -159,7 +159,7 @@ def profile_graph(
     for n in graph.nodes:
         op = graph.get_operator(n)
         if isinstance(op, DatasetOperator):
-            full_n = max(full_n, len(op.dataset))
+            full_n = max(full_n, _dataset_len(op.dataset))
 
     shards = num_data_shards(get_mesh())
     samples_by_node: Dict[NodeId, List[SampleProfile]] = {}

@@ -22,6 +22,13 @@ Only nodes with DEFAULT dataset semantics fuse — anything overriding
 stages, Cacher materialization points) keeps its node boundary, except
 nodes marked ``fusion_safe`` (whose override is an optimized
 equivalent of the default per-item map).
+
+Fused chains stream: ``FusedTransformer``/``FusedGatherTransformer``
+inherit the default ``apply_dataset``, whose StreamingDataset branch
+applies the whole fused program per chunk — one structure-keyed compile
+serves every chunk (all chunks share one padded shape) and every refit,
+so the ingest-overlapped path pays zero extra compiles
+(``tests/test_streaming.py::test_fused_chain_streams_per_chunk``).
 """
 from __future__ import annotations
 

@@ -36,7 +36,12 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
-from ...parallel.dataset import ArrayDataset, Dataset, HostDataset
+from ...parallel.dataset import (
+    ArrayDataset,
+    Dataset,
+    HostDataset,
+    is_streaming,
+)
 from ...parallel.mesh import get_mesh, num_data_shards
 from ..graph import Graph
 from ..graph_ids import GraphId, NodeId
@@ -58,6 +63,14 @@ def _sample_dataset(ds: Dataset, size: int) -> Dataset:
     partitions), avoiding head bias on ordered datasets."""
     import numpy as np
 
+    if is_streaming(ds):
+        # sample from the FIRST chunk only: bounded device/host cost by
+        # construction. collect()/len() on a stream would materialize it
+        # (or raise on unknown n) — the exact thing streaming forbids.
+        # Head bias is acceptable for a ~96-item cost-model sample.
+        for chunk in ds.chunks():
+            return _sample_dataset(chunk, size)
+        raise ValueError("cannot sample an empty stream")
     n = len(ds)
     take = min(size, n)
     idx = np.unique(np.linspace(0, n - 1, take).astype(np.int64))
@@ -69,6 +82,16 @@ def _sample_dataset(ds: Dataset, size: int) -> Dataset:
         return ArrayDataset(data, len(idx), ds.mesh)
     items = ds.collect()
     return HostDataset([items[i] for i in idx])
+
+
+def _dataset_len(ds: Dataset) -> int:
+    """len(ds), tolerating unknown-length streams (0 — callers take the
+    max over the graph's datasets, and stream-fed optimizable nodes are
+    excluded from sampling before this matters)."""
+    try:
+        return len(ds)
+    except TypeError:
+        return 0
 
 
 class NodeOptimizationRule(Rule):
@@ -102,7 +125,7 @@ class NodeOptimizationRule(Rule):
             op = graph.get_operator(node)
             if isinstance(op, DatasetOperator):
                 if node in relevant:
-                    n = max(n, len(op.dataset))
+                    n = max(n, _dataset_len(op.dataset))
                 sampled = sampled.set_operator(
                     node, DatasetOperator(
                         _sample_dataset(op.dataset, self.sample_size)))
@@ -210,6 +233,24 @@ class NodeOptimizationRule(Rule):
             "provenance": provenance,
         })
 
+    @staticmethod
+    def _feeds_streaming(graph: Graph, node: NodeId) -> bool:
+        """True when any dataset feeding ``node`` is a StreamingDataset:
+        the sampled path is off-limits there (executing the prefix on a
+        materialized sample is exactly the materialization streaming
+        exists to avoid)."""
+        anc: set = set()
+        for d in graph.get_dependencies(node):
+            anc.add(d)
+            anc |= graph.get_ancestors(d)
+        for a in anc:
+            if not isinstance(a, NodeId) or a not in graph.nodes:
+                continue
+            op = graph.get_operator(a)
+            if isinstance(op, DatasetOperator) and is_streaming(op.dataset):
+                return True
+        return False
+
     # -- rule entry -------------------------------------------------------
     def apply(self, graph: Graph) -> Graph:
         import time
@@ -243,6 +284,13 @@ class NodeOptimizationRule(Rule):
             if static is not None:
                 choice, n = static
                 provenance = "static"
+            elif self._feeds_streaming(graph, node):
+                # no static shapes AND streamed input: leave the
+                # optimizable node in place — a streamable estimator
+                # makes its cost-model choice at finalize time from the
+                # exact accumulated (n, d, k), and a non-streamable one
+                # raises the clear non-streamable-fit error at fit
+                continue
             else:
                 provenance = "sampled"
                 if isinstance(op, OptimizableLabelEstimator):

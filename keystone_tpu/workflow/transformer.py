@@ -16,7 +16,7 @@ from typing import Any, Callable, Sequence
 import jax
 import numpy as np
 
-from ..parallel.dataset import ArrayDataset, Dataset, HostDataset
+from ..parallel.dataset import ArrayDataset, Dataset, HostDataset, is_streaming
 from .operators import TransformerOperator
 from .pipeline import Chainable, Pipeline
 from .graph import Graph
@@ -133,6 +133,12 @@ class Transformer(TransformerOperator, Chainable):
     def apply_dataset(self, ds: Dataset) -> Dataset:
         if isinstance(ds, ArrayDataset):
             return ds.map_batch(self._batched())
+        if is_streaming(ds):
+            # per-chunk apply: every chunk shares one padded shape, so
+            # the chain compiles once (fitted params ride as jit
+            # arguments via the usual structure-keyed programs) and
+            # chunk i+1's ingest overlaps chunk i's compute
+            return ds.map_chunks(self.apply_dataset)
         return ds.map(self.apply)
 
     def _batched(self) -> Callable:
@@ -249,6 +255,12 @@ class HostTransformer(Transformer):
     """
 
     def apply_dataset(self, ds: Dataset) -> Dataset:
+        if is_streaming(ds):
+            raise TypeError(
+                f"host stage {self.label()!r} cannot consume a "
+                "StreamingDataset: chunks are device-resident and a host "
+                "stage would sync every chunk back. Run host stages "
+                "before building the stream, or materialize() it.")
         if isinstance(ds, ArrayDataset):
             ds = HostDataset(ds.collect())
         return ds.map(self.apply)
@@ -266,7 +278,11 @@ class HostTransformer(Transformer):
 
         out = super().abstract_eval(dep_specs)
         if isinstance(out, DatasetSpec):
-            # the batch path collects to host before mapping
+            # the batch path collects to host before mapping; streaming
+            # is preserved so the host-stage-on-stream lint (and any
+            # downstream streaming diagnostics) see the true provenance
+            # — at runtime this combination raises in apply_dataset
             return DatasetSpec(out.element, n=out.n, host=True,
-                               sparsity=out.sparsity)
+                               sparsity=out.sparsity,
+                               streaming=out.streaming)
         return out

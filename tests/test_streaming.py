@@ -1,0 +1,617 @@
+"""Streaming chunked execution: prefetcher mechanics, streamed-vs-
+resident estimator parity, out-of-core HBM bounds, and the
+non-streamable-fit lint (ISSUE 3 tentpole)."""
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from keystone_tpu.nodes.learning.least_squares import LeastSquaresEstimator
+from keystone_tpu.nodes.learning.linear import (
+    BlockLeastSquaresEstimator,
+    LinearMapEstimator,
+)
+from keystone_tpu.nodes.stats import StandardScaler, StandardScalerModel
+from keystone_tpu.parallel.dataset import (
+    ArrayDataset,
+    device_nbytes,
+    ensure_array,
+)
+from keystone_tpu.parallel.streaming import (
+    StreamingDataset,
+    fit_streaming,
+    is_streamable,
+)
+
+
+def _xy(n=600, d=24, k=3, seed=0):
+    rng = np.random.RandomState(seed)
+    X = (rng.randn(n, d) * (1.0 + rng.rand(d)) + rng.randn(d)).astype(
+        np.float32)
+    W = rng.randn(d, k).astype(np.float32)
+    Y = (X @ W + 0.1 * rng.randn(n, k)).astype(np.float32)
+    return X, Y
+
+
+# -- prefetcher mechanics ---------------------------------------------------
+
+def test_chunks_order_shapes_and_ragged_tail():
+    X = np.arange(100 * 4, dtype=np.float32).reshape(100, 4)
+    stream = StreamingDataset.from_numpy(X, chunk_size=32)
+    chunks = list(stream.chunks())
+    # ragged tail: 32, 32, 32, 4 — every chunk padded to one shape
+    assert [c.n for c in chunks] == [32, 32, 32, 4]
+    assert len({c.padded_n for c in chunks}) == 1
+    got = np.concatenate([c.numpy() for c in chunks])
+    np.testing.assert_array_equal(got, X)
+    # the tail chunk's pad rows hold zeros (the invariant reductions use)
+    tail = np.asarray(chunks[-1].data)
+    assert np.all(tail[chunks[-1].n:] == 0)
+
+
+def test_chunk_size_rounds_to_shard_multiple():
+    X = np.zeros((40, 2), np.float32)
+    stream = StreamingDataset.from_numpy(X, chunk_size=10)
+    assert stream.chunk_size % 8 == 0  # 8-device test mesh
+
+
+def test_reiteration_and_unknown_n_learned():
+    X = np.random.RandomState(0).rand(50, 3).astype(np.float32)
+
+    def factory():
+        for lo in range(0, 50, 16):
+            yield X[lo:lo + 16]
+
+    stream = StreamingDataset.from_chunks(factory, chunk_size=16)
+    with pytest.raises(TypeError):
+        len(stream)  # n unknown before a pass
+    assert sum(c.n for c in stream.chunks()) == 50
+    assert len(stream) == 50  # a completed pass pins n
+    # second epoch re-opens the source
+    assert sum(c.n for c in stream.chunks()) == 50
+
+
+def test_source_error_propagates():
+    def factory():
+        yield np.zeros((8, 2), np.float32)
+        raise RuntimeError("decode failed")
+
+    stream = StreamingDataset.from_chunks(factory, chunk_size=8)
+    with pytest.raises(RuntimeError, match="decode failed"):
+        list(stream.chunks())
+
+
+def test_early_break_stops_producer():
+    started = threading.active_count()
+
+    def factory():
+        for _ in range(1000):
+            yield np.zeros((8, 2), np.float32)
+
+    stream = StreamingDataset.from_chunks(factory, chunk_size=8)
+    for i, _ in enumerate(stream.chunks()):
+        if i == 2:
+            break
+    deadline = time.time() + 5.0
+    while threading.active_count() > started and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= started
+
+
+def test_map_is_lazy_and_chunkwise():
+    X = np.random.RandomState(0).rand(64, 5).astype(np.float32)
+    stream = StreamingDataset.from_numpy(X, chunk_size=24).map(
+        lambda x: x * 2.0)
+    got = stream.materialize().numpy()
+    np.testing.assert_allclose(got, X * 2.0, rtol=1e-6)
+
+
+# -- streamed-vs-resident estimator parity ----------------------------------
+
+@pytest.mark.parametrize("chunk_size", [64, 96, 200])
+def test_least_squares_streamed_matches_resident(chunk_size):
+    """Acceptance: streamed LeastSquares fit matches the device-resident
+    fit within 1e-5 relative weight error with identical argmax
+    predictions, across chunk sizes including a ragged last chunk."""
+    X, Y = _xy()
+    ds, ls = ArrayDataset.from_numpy(X), ArrayDataset.from_numpy(Y)
+    resident = LinearMapEstimator(lam=0.1)._fit(ds, ls)
+    streamed = fit_streaming(
+        LinearMapEstimator(lam=0.1),
+        StreamingDataset.from_numpy(X, chunk_size=chunk_size),
+        StreamingDataset.from_numpy(Y, chunk_size=chunk_size))
+    w_r = np.asarray(resident.weights)
+    w_s = np.asarray(streamed.weights)
+    assert np.abs(w_r - w_s).max() <= 1e-5 * np.abs(w_r).max()
+    pred_r = np.argmax(np.asarray(
+        ensure_array(resident.apply_dataset(ds)).numpy()), axis=1)
+    pred_s = np.argmax(np.asarray(
+        ensure_array(streamed.apply_dataset(ds)).numpy()), axis=1)
+    np.testing.assert_array_equal(pred_r, pred_s)
+
+
+@pytest.mark.parametrize("chunk_size", [96, 250])
+def test_block_ls_streamed_matches_resident(chunk_size):
+    X, Y = _xy()
+    ds, ls = ArrayDataset.from_numpy(X), ArrayDataset.from_numpy(Y)
+    est = BlockLeastSquaresEstimator(10, 3, lam=0.1)
+    resident = est._fit(ds, ls)
+    streamed = fit_streaming(
+        BlockLeastSquaresEstimator(10, 3, lam=0.1),
+        StreamingDataset.from_numpy(X, chunk_size=chunk_size), ls)
+    w_r = np.asarray(resident.weights)
+    w_s = np.asarray(streamed.weights)
+    assert np.abs(w_r - w_s).max() <= 1e-5 * np.abs(w_r).max()
+    # block structure preserved
+    assert len(streamed.block_weights) == len(resident.block_weights)
+    pred_r = np.argmax(np.asarray(
+        ensure_array(resident.apply_dataset(ds)).numpy()), axis=1)
+    pred_s = np.argmax(np.asarray(
+        ensure_array(streamed.apply_dataset(ds)).numpy()), axis=1)
+    np.testing.assert_array_equal(pred_r, pred_s)
+
+
+def test_scaler_streamed_matches_resident():
+    X, _ = _xy()
+    resident = StandardScaler()._fit(ArrayDataset.from_numpy(X))
+    streamed = fit_streaming(
+        StandardScaler(), StreamingDataset.from_numpy(X, chunk_size=88))
+    np.testing.assert_allclose(resident.mean, streamed.mean, rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(resident.std, streamed.std, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_auto_solver_streamed_finalize_and_decision():
+    """LeastSquaresEstimator streams via the shared Gram carry and picks
+    a gram-capable solver by cost model at finalize, recording the
+    decision with shape_source=streamed."""
+    from keystone_tpu.observability import PipelineTrace
+
+    X, Y = _xy(n=400)
+    est = LeastSquaresEstimator(lam=0.1)
+    assert is_streamable(est)
+    with PipelineTrace("t") as tr:
+        model = fit_streaming(
+            est, StreamingDataset.from_numpy(X, chunk_size=160), Y)
+    assert len(tr.solver_decisions) == 1
+    d = tr.solver_decisions[0]
+    assert d["shape_source"] == "streamed"
+    assert d["n"] == 400
+    assert d["chosen"] in ("LinearMapEstimator",
+                           "BlockLeastSquaresEstimator")
+    # the fitted model predicts like the resident exact solve
+    resident = LinearMapEstimator(lam=0.1)._fit(
+        ArrayDataset.from_numpy(X), ArrayDataset.from_numpy(Y))
+    ds = ArrayDataset.from_numpy(X)
+    pred_r = np.argmax(np.asarray(
+        ensure_array(resident.apply_dataset(ds)).numpy()), axis=1)
+    pred_s = np.argmax(np.asarray(
+        ensure_array(model.apply_dataset(ds)).numpy()), axis=1)
+    assert (pred_r == pred_s).mean() > 0.99
+
+
+def test_label_estimator_fit_routes_streams():
+    """LabelEstimator.fit / Estimator.fit route StreamingDatasets through
+    the protocol (resident labels are sliced chunk-wise)."""
+    X, Y = _xy(n=300)
+    model = LinearMapEstimator(lam=0.1).fit(
+        StreamingDataset.from_numpy(X, chunk_size=128), Y)
+    resident = LinearMapEstimator(lam=0.1)._fit(
+        ArrayDataset.from_numpy(X), ArrayDataset.from_numpy(Y))
+    assert np.abs(np.asarray(model.weights)
+                  - np.asarray(resident.weights)).max() <= 1e-4
+    scaler = StandardScaler().fit(
+        StreamingDataset.from_numpy(X, chunk_size=128))
+    assert isinstance(scaler, StandardScalerModel)
+
+
+def test_streamed_labels_with_resident_data_raise():
+    """The chunk loop is data-driven: streamed labels + resident data
+    is rejected with a clear error at fit time AND flagged statically."""
+    from keystone_tpu.analysis.diagnostics import check_graph
+
+    X, Y = _xy(n=160)
+    lstream = StreamingDataset.from_numpy(Y, chunk_size=80)
+    with pytest.raises(TypeError, match="labels are a StreamingDataset"):
+        LinearMapEstimator(lam=0.1).fit(X, lstream)
+    p = LinearMapEstimator(lam=0.1).with_data(
+        ArrayDataset.from_numpy(X), lstream)
+    rep = check_graph(
+        p._graph, {p._source: jax.ShapeDtypeStruct((24,), np.float32)},
+        name="labels-stream")
+    hits = [d for d in rep.diagnostics if d.code == "non-streamable-fit"]
+    assert hits and "LABELS" in hits[0].message
+
+
+def test_misaligned_label_stream_raises():
+    X, Y = _xy(n=200)
+    with pytest.raises(ValueError, match="misaligned|ended"):
+        fit_streaming(
+            LinearMapEstimator(lam=0.1),
+            StreamingDataset.from_numpy(X, chunk_size=64),
+            StreamingDataset.from_numpy(Y[:100], chunk_size=64))
+
+
+# -- per-chunk transformer application --------------------------------------
+
+def test_transformer_chain_applies_per_chunk():
+    """scaler >> linear model applied through apply_dataset on a stream
+    matches the resident application exactly (per-chunk structure-keyed
+    programs, padded rows re-masked)."""
+    X, Y = _xy(n=200)
+    ds = ArrayDataset.from_numpy(X)
+    scaler = StandardScaler()._fit(ds)
+    model = LinearMapEstimator(lam=0.1)._fit(ds, ArrayDataset.from_numpy(Y))
+    resident = model.apply_dataset(scaler.apply_dataset(ds)).numpy()
+    stream = StreamingDataset.from_numpy(X, chunk_size=64)
+    streamed = model.apply_dataset(
+        scaler.apply_dataset(stream)).materialize().numpy()
+    np.testing.assert_allclose(resident, streamed, rtol=2e-5, atol=2e-5)
+
+
+def test_fused_chain_streams_per_chunk():
+    """A FusedTransformer (scaler >> linear model) applies per chunk
+    through ONE param-threaded program and matches the resident fused
+    output — fusion and streaming compose."""
+    from keystone_tpu.nodes.learning.linear import LinearMapper
+    from keystone_tpu.workflow.optimizer.fusion import FusedTransformer
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(200, 16).astype(np.float32)
+    fused = FusedTransformer([
+        StandardScalerModel(rng.randn(16).astype(np.float32),
+                            (0.5 + rng.rand(16)).astype(np.float32)),
+        LinearMapper(rng.randn(16, 4).astype(np.float32),
+                     intercept=rng.randn(4).astype(np.float32)),
+    ])
+    resident = fused.apply_dataset(ArrayDataset.from_numpy(X)).numpy()
+    streamed = fused.apply_dataset(
+        StreamingDataset.from_numpy(X, chunk_size=64)).materialize().numpy()
+    np.testing.assert_allclose(resident, streamed, rtol=1e-5, atol=1e-5)
+
+
+def test_host_transformer_rejects_stream():
+    from keystone_tpu.workflow.transformer import HostTransformer
+
+    class H(HostTransformer):
+        def apply(self, x):
+            return x
+
+    X, _ = _xy(n=64)
+    with pytest.raises(TypeError, match="host stage"):
+        H().apply_dataset(StreamingDataset.from_numpy(X, chunk_size=32))
+
+
+def test_second_streamed_epoch_compiles_nothing():
+    """Acceptance: zero recompiles on the second streamed epoch — all
+    chunks (ragged tail included) share one padded shape, so the chain's
+    structure-keyed programs compile once in epoch one."""
+    import io
+    import logging
+
+    X, Y = _xy(n=300)
+    ds = ArrayDataset.from_numpy(X)
+    scaler = StandardScaler()._fit(ds)
+    model = LinearMapEstimator(lam=0.1)._fit(ds, ArrayDataset.from_numpy(Y))
+
+    def epoch():
+        stream = StreamingDataset.from_numpy(X, chunk_size=128)
+        out = model.apply_dataset(scaler.apply_dataset(stream))
+        for chunk in out.chunks():
+            jax.block_until_ready(chunk.data)
+        # a streamed refit epoch too: accumulate + finalize
+        fit_streaming(LinearMapEstimator(lam=0.1),
+                      StreamingDataset.from_numpy(X, chunk_size=128), Y)
+
+    epoch()  # warm: one compile per chunk-shape program
+
+    jax.config.update("jax_log_compiles", True)
+    buf = io.StringIO()
+    handler = logging.StreamHandler(buf)
+    loggers = [logging.getLogger("jax._src.interpreters.pxla"),
+               logging.getLogger("jax._src.dispatch")]
+    for lg in loggers:
+        lg.addHandler(handler)
+        lg.setLevel(logging.WARNING)
+    try:
+        epoch()
+    finally:
+        jax.config.update("jax_log_compiles", False)
+        for lg in loggers:
+            lg.removeHandler(handler)
+    compiles = [ln for ln in buf.getvalue().splitlines()
+                if "Compiling" in ln]
+    assert not compiles, compiles
+
+
+# -- out-of-core HBM bounds -------------------------------------------------
+
+def test_device_residency_bounded():
+    """Acceptance: device_nbytes of the stream never exceeds the budget
+    (prefetch buffer + chunk working set) while a fit runs on data whose
+    TOTAL size exceeds that budget many times over."""
+    n, d, chunk, depth = 2048, 16, 64, 2
+    X = np.random.RandomState(0).rand(n, d).astype(np.float32)
+    Y = np.random.RandomState(1).rand(n, 2).astype(np.float32)
+    stream = StreamingDataset.from_numpy(
+        X, chunk_size=chunk, prefetch_depth=depth)
+    chunk_bytes = chunk * d * 4
+    budget = (depth + 1) * chunk_bytes + 4096
+    total_bytes = n * d * 4
+    assert total_bytes > 5 * budget  # the dataset genuinely exceeds it
+    seen = []
+    probe = stream.map_chunks(
+        lambda ad: (seen.append(device_nbytes(stream)), ad)[1])
+    fit_streaming(LinearMapEstimator(lam=0.1), probe, Y,
+                  hbm_budget=budget)
+    assert seen and max(seen) <= budget
+    assert stream.peak_device_nbytes <= budget
+
+
+def test_residency_holds_depth_plus_one_with_slow_consumer():
+    """The documented bound is (prefetch_depth + 1) chunks — depth
+    staged-or-queued plus one working. A consumer slower than the
+    producer must not let the producer stage a (depth + 2)th chunk
+    (staging is slot-gated BEFORE device_put, not after)."""
+    n, d, chunk, depth = 512, 8, 64, 2
+    X = np.random.RandomState(0).rand(n, d).astype(np.float32)
+    stream = StreamingDataset.from_numpy(
+        X, chunk_size=chunk, prefetch_depth=depth)
+    chunk_bytes = chunk * d * 4
+    bound = (depth + 1) * chunk_bytes
+    peaks = []
+    for _ in stream.chunks():
+        time.sleep(0.05)  # slow consumer: the producer runs far ahead
+        peaks.append(stream.buffered_nbytes())
+    assert max(peaks) <= bound, (max(peaks), bound)
+    assert stream.peak_device_nbytes <= bound, (
+        stream.peak_device_nbytes, bound)
+
+
+def test_labels_longer_than_stream_raise():
+    X, Y = _xy(n=200)
+    # streamed labels longer than the data stream
+    with pytest.raises(ValueError, match="misaligned"):
+        fit_streaming(
+            LinearMapEstimator(lam=0.1),
+            StreamingDataset.from_numpy(X[:128], chunk_size=64),
+            StreamingDataset.from_numpy(Y, chunk_size=64))
+    # resident labels longer than the data stream
+    with pytest.raises(ValueError, match="misaligned|truncate"):
+        fit_streaming(
+            LinearMapEstimator(lam=0.1),
+            StreamingDataset.from_numpy(X[:128], chunk_size=64), Y)
+
+
+def test_hbm_budget_violation_raises():
+    X, Y = _xy(n=256)
+    stream = StreamingDataset.from_numpy(X, chunk_size=64)
+    with pytest.raises(MemoryError, match="HBM budget"):
+        fit_streaming(LinearMapEstimator(lam=0.1), stream, Y,
+                      hbm_budget=16.0)  # absurdly small
+
+
+def test_ensure_array_refuses_silent_materialize():
+    X, _ = _xy(n=64)
+    with pytest.raises(TypeError, match="materialize"):
+        ensure_array(StreamingDataset.from_numpy(X, chunk_size=32))
+
+
+# -- observability ----------------------------------------------------------
+
+def test_stream_metrics_and_trace_chunks():
+    from keystone_tpu.observability import MetricsRegistry, PipelineTrace
+
+    X, _ = _xy(n=200)
+    with PipelineTrace("stream-test") as tr:
+        list(StreamingDataset.from_numpy(
+            X, chunk_size=64, tag="unit").chunks())
+    assert len(tr.chunks) == 4
+    assert {c["source"] for c in tr.chunks} == {"unit"}
+    assert all("ingest_stall_s" in c and "prefetch_occupancy" in c
+               for c in tr.chunks)
+    assert tr.ingest_stall_s() >= 0.0
+    # round trip
+    rt = type(tr).from_json(tr.to_json())
+    assert len(rt.chunks) == 4
+    assert "streamed ingest" in tr.summary()
+    snap = MetricsRegistry.get_or_create().snapshot()
+    assert snap["counters"]["streaming.chunks_total"] >= 4
+    assert "streaming.ingest_stall_s" in snap["histograms"]
+
+
+def test_concurrent_derived_iterations_keep_ledger_consistent():
+    """Two concurrent iterations over views derived from ONE root (data
+    + labels split of a zipped stream) must compose in the shared
+    residency ledger: never negative, and back to zero when both
+    finish."""
+    X, Y = _xy(n=256)
+    both = StreamingDataset.from_numpy({"x": X, "y": Y}, chunk_size=64)
+
+    def pick(key):
+        return lambda ad: ArrayDataset(
+            ad.data[key], ad.n, ad.mesh, _already_sharded=True)
+
+    xs, ys = both.map_chunks(pick("x")), both.map_chunks(pick("y"))
+    lows = []
+    probe = xs.map_chunks(
+        lambda ad: (lows.append(both.buffered_nbytes()), ad)[1])
+    model = fit_streaming(LinearMapEstimator(lam=0.1), probe, ys)
+    assert min(lows) >= 0.0
+    assert both.buffered_nbytes() == 0.0
+    resident = LinearMapEstimator(lam=0.1)._fit(
+        ArrayDataset.from_numpy(X), ArrayDataset.from_numpy(Y))
+    assert np.abs(np.asarray(model.weights)
+                  - np.asarray(resident.weights)).max() <= 1e-4
+
+
+def test_trace_chunk_entries_are_bounded():
+    from keystone_tpu.observability import PipelineTrace
+
+    tr = PipelineTrace("cap")
+    for i in range(tr.CHUNK_TAIL + 100):
+        tr.record_chunk({"chunk": i, "ingest_stall_s": 0.001,
+                         "nbytes": 10.0, "prefetch_occupancy": 1})
+    assert len(tr.chunks) == tr.CHUNK_TAIL
+    # aggregates stay exact over ALL chunks
+    assert tr.chunk_stats["count"] == tr.CHUNK_TAIL + 100
+    assert abs(tr.ingest_stall_s()
+               - 0.001 * (tr.CHUNK_TAIL + 100)) < 1e-9
+    rt = PipelineTrace.from_json(tr.to_json())
+    assert rt.chunk_stats["count"] == tr.CHUNK_TAIL + 100
+
+
+# -- static analysis / lint -------------------------------------------------
+
+def test_dataset_spec_streaming_flag():
+    from keystone_tpu.analysis.spec import DatasetSpec, dataset_spec
+
+    X, _ = _xy(n=80)
+    spec = dataset_spec(StreamingDataset.from_numpy(X, chunk_size=40))
+    assert isinstance(spec, DatasetSpec)
+    assert spec.streaming and spec.n == 80
+    assert spec.element.shape == (24,)
+    assert "streaming" in repr(spec)
+
+
+def test_non_streamable_fit_lint_fires_and_names_node():
+    from keystone_tpu.analysis.diagnostics import check_graph
+    from keystone_tpu.nodes.learning.pca import ColumnPCAEstimator
+
+    X, _ = _xy(n=80)
+    stream = StreamingDataset.from_numpy(X, chunk_size=40)
+    p = ColumnPCAEstimator(4).with_data(stream)
+    rep = check_graph(
+        p._graph, {p._source: jax.ShapeDtypeStruct((24,), np.float32)},
+        name="pca-stream")
+    hits = [d for d in rep.diagnostics if d.code == "non-streamable-fit"]
+    assert len(hits) == 1
+    assert "ColumnPCAEstimator" in hits[0].operator
+    assert "accumulate" in hits[0].message
+
+
+def test_streamable_fit_lint_clean():
+    from keystone_tpu.analysis.diagnostics import check_graph
+
+    X, Y = _xy(n=80)
+    p = LinearMapEstimator(lam=0.1).with_data(
+        StreamingDataset.from_numpy(X, chunk_size=40),
+        StreamingDataset.from_numpy(Y, chunk_size=40))
+    rep = check_graph(
+        p._graph, {p._source: jax.ShapeDtypeStruct((24,), np.float32)},
+        name="lin-stream")
+    assert not [d for d in rep.diagnostics
+                if d.code == "non-streamable-fit"]
+
+
+def test_host_stage_on_stream_lint_fires():
+    """A HostTransformer fed a stream fails at runtime; the static
+    checker must say so BEFORE execution, naming the stage (the
+    streaming flag also survives the host stage, so downstream
+    diagnostics are not mis-attributed)."""
+    from keystone_tpu.analysis.diagnostics import check_graph
+    from keystone_tpu.nodes.util.sparse import Sparsify
+
+    X, Y = _xy(n=80)
+    stream = StreamingDataset.from_numpy(X, chunk_size=40)
+    g = LinearMapEstimator(lam=0.1).with_data(
+        stream, ArrayDataset.from_numpy(Y))._graph
+    # splice the host stage between the stream and the estimator
+    est_node = next(
+        n for n in g.nodes
+        if type(g.get_operator(n)).__name__ == "LinearMapEstimator")
+    deps = g.get_dependencies(est_node)
+    g2, host_node = g.add_node(Sparsify(), (deps[0],))
+    g2 = g2.set_dependencies(est_node, (host_node,) + tuple(deps[1:]))
+    rep = check_graph(g2, {}, name="host-on-stream")
+    hits = [d for d in rep.diagnostics
+            if d.code == "host-stage-on-stream"]
+    assert len(hits) == 1 and "Sparsify" in hits[0].operator
+
+
+def test_trace_summary_tolerates_trimmed_solver_decisions():
+    from keystone_tpu.observability import PipelineTrace
+
+    tr = PipelineTrace("trimmed")
+    tr.record_solver_decision({"n": 10, "d": 4, "k": 2,
+                               "chosen": "LinearMapEstimator"})
+    assert "sparsity=?" in tr.summary()
+
+
+def test_non_streamable_runtime_error_is_clear():
+    from keystone_tpu.nodes.learning.pca import ColumnPCAEstimator
+
+    X, _ = _xy(n=80)
+    with pytest.raises(TypeError) as exc:
+        ColumnPCAEstimator(4).fit(
+            StreamingDataset.from_numpy(X, chunk_size=40))
+    msg = str(exc.value)
+    assert "ColumnPCAEstimator" in msg
+    assert "accumulate" in msg and "non-streamable-fit" in msg
+
+
+def test_pipeline_streamed_fit_never_materializes(monkeypatch):
+    """Full graph path: an auto-solver pipeline fit on a StreamingDataset
+    must pick a STREAMABLE solver (static choice restricted to the
+    gram-capable surface; the Densify prefix passes streams through) and
+    must never materialize the stream."""
+    from keystone_tpu import Pipeline, transformer
+    from keystone_tpu.observability import PipelineTrace
+
+    X, Y = _xy(n=320, d=16, k=3)
+    train = StreamingDataset.from_numpy(X, chunk_size=128, tag="pipe")
+    labels = ArrayDataset.from_numpy(Y)
+
+    def boom(self):
+        raise AssertionError("stream was materialized during pipeline fit")
+
+    monkeypatch.setattr(StreamingDataset, "materialize", boom)
+    ident = transformer(lambda x: x * 1.0)
+    with PipelineTrace("pipe") as tr:
+        pipe = ident.and_then(LeastSquaresEstimator(lam=1e-2),
+                              train, labels)
+        fitted = pipe.fit()
+        out = fitted.apply(ArrayDataset.from_numpy(X)).get().numpy()
+    assert out.shape == (320, 3)
+    assert tr.solver_decisions, "no solver decision traced"
+    d = tr.solver_decisions[-1]
+    assert d["chosen"] in ("LinearMapEstimator",
+                           "BlockLeastSquaresEstimator"), d
+    assert d.get("streaming_restricted") is True
+    assert len(tr.chunks) > 0  # the fit actually consumed the stream
+
+
+# -- loader glue ------------------------------------------------------------
+
+def test_stream_tar_images(tmp_path):
+    import io as _io
+    import tarfile
+
+    from PIL import Image as PILImage
+
+    from keystone_tpu.loaders.image_loader_utils import stream_tar_images
+
+    side, n_imgs = 16, 10
+    rng = np.random.RandomState(0)
+    tar_path = tmp_path / "imgs.tar"
+    with tarfile.open(tar_path, "w") as tf:
+        for i in range(n_imgs):
+            arr = (rng.rand(side, side, 3) * 255).astype(np.uint8)
+            buf = _io.BytesIO()
+            PILImage.fromarray(arr).save(buf, format="PNG")
+            data = buf.getvalue()
+            info = tarfile.TarInfo(f"img{i:03d}.png")
+            info.size = len(data)
+            tf.addfile(info, _io.BytesIO(data))
+
+    stream = stream_tar_images([str(tar_path)], chunk_size=4, n=n_imgs)
+    chunks = list(stream.chunks())
+    assert [c.n for c in chunks] == [4, 4, 2]
+    assert all(np.asarray(c.data).shape[1:] == (side, side, 3)
+               for c in chunks)
+    # decoded content round-trips (PNG is lossless)
+    total = sum(c.n for c in chunks)
+    assert total == n_imgs

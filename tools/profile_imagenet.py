@@ -126,6 +126,21 @@ def main():
     print(f"full featurize: {1e3 * total / N_IMGS:.2f} ms/img "
           f"= {N_IMGS / total:.1f} img/s/chip", flush=True)
 
+    # batch-64 measurement (VERDICT r5 item 3): the bigger vmap batch
+    # amortizes per-dispatch overhead ~+10% — worth taking only when the
+    # host can feed it, which bench.py's rehearsal section validates via
+    # the streaming prefetcher; here the delta itself is recorded.
+    # Skipped in --small (tiny shapes make the comparison meaningless).
+    if not SMALL and N_IMGS != 64:
+        imgs64 = jax.device_put(rng.rand(64, H, W).astype(np.float32))
+        fence(imgs64)
+        fn64 = jax.jit(jax.vmap(prefix_fn(6, pca, gmm)))
+        dt64 = timeit(fn64, imgs64)
+        print(f"batch 64: {1e3 * dt64 / 64:.2f} ms/img "
+              f"= {64 / dt64:.1f} img/s/chip "
+              f"({100.0 * (64 / dt64) / (N_IMGS / total) - 100.0:+.1f}% "
+              f"vs batch {N_IMGS})", flush=True)
+
     # LCS branch, timed whole
     from keystone_tpu.nodes.images.extractors import LCSExtractor
     lcs = LCSExtractor()
