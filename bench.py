@@ -110,11 +110,44 @@ _DURATIONS_PATH = os.path.join(
 
 
 def _load_durations() -> dict:
+    """Read the persisted per-section duration estimates, validating
+    them: a corrupt or hand-edited file (bad JSON, non-dict, negative /
+    non-numeric / non-finite durations) is discarded with a warning and
+    regenerated by the next clean runs — never allowed to crash the
+    bench or poison the budget scheduler."""
+    import sys
+
     try:
         with open(_DURATIONS_PATH) as f:
-            return json.load(f)
-    except Exception:
+            raw = json.load(f)
+    except FileNotFoundError:
         return {}
+    except (json.JSONDecodeError, UnicodeDecodeError, OSError) as exc:
+        print(f"bench: discarding unreadable {_DURATIONS_PATH} "
+              f"({type(exc).__name__}: {exc}); section durations will "
+              "be re-measured", file=sys.stderr)
+        return {}
+    if not isinstance(raw, dict):
+        print(f"bench: discarding {_DURATIONS_PATH} (expected a JSON "
+              f"object, got {type(raw).__name__}); section durations "
+              "will be re-measured", file=sys.stderr)
+        return {}
+    out, bad = {}, []
+    for key, value in raw.items():
+        ok = (isinstance(key, str)
+              and isinstance(value, (int, float))
+              and not isinstance(value, bool)
+              and np.isfinite(value) and value > 0)
+        if ok:
+            out[key] = float(value)
+        else:
+            bad.append(key)
+    if bad:
+        print(f"bench: ignoring {len(bad)} invalid duration "
+              f"entr{'y' if len(bad) == 1 else 'ies'} in "
+              f"{_DURATIONS_PATH} ({', '.join(map(str, bad[:5]))}); "
+              "those sections will be re-measured", file=sys.stderr)
+    return out
 
 
 def _record_duration(name: str, seconds: float) -> None:

@@ -17,6 +17,12 @@ from .observability import (
 from .parallel.dataset import ArrayDataset, Dataset, HostDataset, as_dataset
 from .parallel.mesh import get_mesh, make_mesh, mesh_scope, set_mesh
 from .parallel.streaming import StreamingDataset, fit_streaming, is_streamable
+from .resilience import (
+    FaultPlan,
+    IngestTimeoutError,
+    Quarantine,
+    RetryPolicy,
+)
 from .workflow import (
     Cacher,
     Estimator,
@@ -45,6 +51,10 @@ __all__ = [
     "as_dataset",
     "fit_streaming",
     "is_streamable",
+    "FaultPlan",
+    "IngestTimeoutError",
+    "Quarantine",
+    "RetryPolicy",
     "get_mesh",
     "make_mesh",
     "mesh_scope",

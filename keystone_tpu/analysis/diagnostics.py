@@ -177,6 +177,48 @@ def host_coercions_in_funcdef(fdef) -> List[tuple]:
     return hits
 
 
+#: directories (under ``keystone_tpu/``) where a silent swallow-all
+#: handler is banned: ingest and workflow code is exactly where "skip
+#: the error and keep going" turns a flaky disk or corrupt record into
+#: silent data loss — the resilience layer (retry / quarantine) is the
+#: sanctioned way to tolerate failures there. tools/lint.py enforces.
+SWALLOW_ALL_SCOPES = ("loaders", "parallel", "workflow")
+
+
+def swallow_all_handlers(tree) -> List[tuple]:
+    """``(lineno, description)`` for exception handlers that swallow
+    everything silently: a bare ``except:`` (any body), or an
+    ``except Exception/BaseException`` handler whose body is only
+    ``pass``/``...``. Handlers that narrow the exception type, re-raise,
+    log, or compute a fallback are fine — the lint targets the pattern
+    that makes failures disappear without a trace."""
+    hits = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            hits.append((node.lineno, "bare `except:`"))
+            continue
+        exc_type = node.type
+        elts = (exc_type.elts if isinstance(exc_type, ast.Tuple)
+                else [exc_type])
+        names = [e.attr if isinstance(e, ast.Attribute)
+                 else getattr(e, "id", "") for e in elts]
+        if not any(n in ("Exception", "BaseException") for n in names):
+            continue
+        body_is_noop = all(
+            isinstance(stmt, ast.Pass)
+            or (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)
+                and (stmt.value.value is Ellipsis
+                     or isinstance(stmt.value.value, str)))
+            for stmt in node.body)
+        if body_is_noop:
+            hits.append((node.lineno,
+                         f"`except {'/'.join(names)}: pass`"))
+    return hits
+
+
 def apply_body_host_coercions(cls) -> List[str]:
     """Names of ``np.*`` host coercions applied to the item argument in
     ``cls.apply`` — the static (AST) form of the host-sync lint."""
@@ -255,7 +297,10 @@ def host_stage_on_stream_lint(analysis: Analysis) -> List[Diagnostic]:
                     "streaming dataset; chunks are device-resident and "
                     "a host stage would sync every one back (this "
                     "raises at runtime). Run host stages before "
-                    "building the stream, or materialize() it")))
+                    "building the stream, or materialize() it "
+                    "(fix-hint: README 'Streaming ingest' / "
+                    "'Resilience' document the streaming fit and "
+                    "checkpoint/resume API)")))
     return out
 
 
@@ -295,7 +340,9 @@ def non_streamable_fit_lint(analysis: Analysis) -> List[Diagnostic]:
                     "would have to materialize the whole stream in "
                     "HBM. Use a streamable estimator (LeastSquares "
                     "family, StandardScaler) or materialize() the "
-                    "stream explicitly if it fits")))
+                    "stream explicitly if it fits (fix-hint: README "
+                    "'Streaming ingest' / 'Resilience' document the "
+                    "streaming fit and checkpoint/resume API)")))
         elif not streamed[0]:
             # streamable estimator, but only a NON-data dependency
             # (labels) streams: the chunk loop is driven by the data
@@ -308,7 +355,9 @@ def non_streamable_fit_lint(analysis: Analysis) -> List[Diagnostic]:
                     "input but resident data; the streamed chunk loop "
                     "is driven by the data input. Stream the data too "
                     "(aligned chunk sizes), or materialize() the "
-                    "labels")))
+                    "labels (fix-hint: README 'Streaming ingest' / "
+                    "'Resilience' document the streaming fit and "
+                    "checkpoint/resume API)")))
     return out
 
 

@@ -6,6 +6,14 @@ convention: float32 (H, W, C) arrays with values in [0, 255].
 
 Ragged image sizes stay host-side (HostDataset); pipelines resize/crop
 or extract fixed-size features before moving to device arrays.
+
+Resilience (:mod:`keystone_tpu.resilience`): tar-member reads and image
+decodes retry transient failures under a :class:`RetryPolicy`
+(``ingest.read`` / ``ingest.decode`` fault-injection sites exercise the
+real paths), and undecodable records are routed to a
+:class:`Quarantine` — skipped but accounted, with the fit failing
+loudly once the bad-record budget is exceeded — instead of being
+silently dropped.
 """
 from __future__ import annotations
 
@@ -21,6 +29,9 @@ from typing import Callable, Iterator, List, Optional, Sequence
 import numpy as np
 
 from ..parallel.dataset import HostDataset
+from ..resilience.faults import inject
+from ..resilience.quarantine import Quarantine
+from ..resilience.retry import RetryPolicy, default_retry_policy
 
 
 @dataclass
@@ -99,11 +110,14 @@ def list_archive_paths(data_path: str, process_shard: bool = True) -> List[str]:
 
 
 def _iter_tar_entries(
-    tar_path: str, name_prefix: Optional[str] = None
+    tar_path: str, name_prefix: Optional[str] = None,
+    retry: Optional[RetryPolicy] = None,
 ) -> Iterator[tuple]:
     """Yield (entry_name, raw_bytes) for each matching file in a tar —
     the single source of mode selection and entry filtering shared by
-    :func:`iter_tar_images` and :func:`load_tar_files`."""
+    :func:`iter_tar_images` and :func:`load_tar_files`. Per-member
+    reads retry transient I/O errors when a ``retry`` policy is given
+    (the ``ingest.read`` fault site sits inside the attempt)."""
     mode = "r:gz" if tar_path.endswith(".gz") else "r"
     with tarfile.open(tar_path, mode) as tf:
         for entry in tf:
@@ -111,36 +125,75 @@ def _iter_tar_entries(
                 continue
             if name_prefix and not entry.name.startswith(name_prefix):
                 continue
-            fobj = tf.extractfile(entry)
-            if fobj is None:
+
+            def read(entry=entry):
+                inject("ingest.read",
+                       context=f"{tar_path}::{entry.name}")
+                fobj = tf.extractfile(entry)
+                return None if fobj is None else fobj.read()
+
+            raw = (read() if retry is None
+                   else retry.call(read, site="ingest.read"))
+            if raw is None:
                 continue
-            yield entry.name, fobj.read()
+            yield entry.name, raw
+
+
+def _decode_with_retry(raw: bytes, context: str,
+                       retry: Optional[RetryPolicy]):
+    """One record's decode behind the retry policy; the
+    ``ingest.decode`` fault site lives inside the attempt so injected
+    transient faults exercise the real retry path. Returns None for
+    genuinely undecodable bytes (the quarantine case)."""
+
+    def attempt():
+        inject("ingest.decode", context=context)
+        return decode_image(raw)
+
+    if retry is None:
+        return attempt()
+    return retry.call(attempt, site="ingest.decode")
 
 
 def iter_tar_images(
-    tar_path: str, name_prefix: Optional[str] = None
+    tar_path: str, name_prefix: Optional[str] = None,
+    quarantine: Optional[Quarantine] = None,
+    retry_policy: Optional[RetryPolicy] = None,
 ) -> Iterator[tuple]:
     """Yield (entry_name, decoded_image) for each image file in a tar
-    (reference ``ImageLoaderUtils.loadFile``)."""
-    for name, raw in _iter_tar_entries(tar_path, name_prefix):
-        img = decode_image(raw)
+    (reference ``ImageLoaderUtils.loadFile``) — the serial (unpooled)
+    decode path. With a ``quarantine``, undecodable members are
+    skipped-but-accounted instead of silently dropped."""
+    for name, raw in _iter_tar_entries(tar_path, name_prefix,
+                                       retry=retry_policy):
+        img = _decode_with_retry(raw, f"{tar_path}::{name}", retry_policy)
         if img is not None:
+            if quarantine is not None:
+                quarantine.record_ok()
             yield name, img
+        elif quarantine is not None:
+            quarantine.quarantine(f"{tar_path}::{name}",
+                                  "undecodable image bytes")
 
 
 def _pooled_decoded(
     archive_paths: Sequence[str],
     name_prefix: Optional[str] = None,
     on_archive_end: Optional[Callable[[str, Optional[Exception], int], None]] = None,
+    quarantine: Optional[Quarantine] = None,
+    retry_policy: Optional[RetryPolicy] = None,
 ) -> Iterator[tuple]:
     """Yield ``(entry_name, decoded_image)`` from every archive, decode
     on a thread pool behind a bounded in-flight window — the ONE home of
     the pool/window/per-archive-recovery machinery shared by
     :func:`iter_decoded_chunks` and :func:`load_tar_files`.
 
-    Order is deterministic (archive order, then entry order);
-    undecodable entries are dropped. An archive that raises mid-stream
-    (non-archive file, truncation) stops there but keeps what was read.
+    Order is deterministic (archive order, then entry order). With a
+    ``quarantine``, undecodable entries are skipped-but-accounted (and
+    the budget enforced); without one they are dropped as before.
+    Transient read/decode failures retry under ``retry_policy``. An
+    archive that raises mid-stream (non-archive file, truncation) stops
+    there but keeps what was read.
     ``on_archive_end(path, error_or_None, n_images_yielded)`` fires per
     archive so callers implement their own skip/warn/raise policy.
     """
@@ -155,18 +208,27 @@ def _pooled_decoded(
         def drain(n):
             out = []
             while len(pending) > n:
-                name, fut = pending.popleft()
-                img = fut.result()
+                name, ctx, fut = pending.popleft()
+                img = fut.result()  # retry exhaustion re-raises here
                 if img is not None:
+                    if quarantine is not None:
+                        quarantine.record_ok()
                     out.append((name, img))
+                elif quarantine is not None:
+                    # skipped but accounted — never silently missing
+                    # from the counts; raises once the budget is blown
+                    quarantine.quarantine(ctx, "undecodable image bytes")
             return out
 
         for path in archive_paths:
             n_from_archive = 0
             err: Optional[Exception] = None
             try:
-                for name, raw in _iter_tar_entries(path, name_prefix):
-                    pending.append((name, pool.submit(decode_image, raw)))
+                for name, raw in _iter_tar_entries(path, name_prefix,
+                                                   retry=retry_policy):
+                    ctx = f"{path}::{name}"
+                    pending.append((name, ctx, pool.submit(
+                        _decode_with_retry, raw, ctx, retry_policy)))
                     for item in drain(window):
                         n_from_archive += 1
                         yield item
@@ -186,6 +248,8 @@ def iter_decoded_chunks(
     archive_paths: Sequence[str],
     chunk_size: int,
     name_prefix: Optional[str] = None,
+    quarantine: Optional[Quarantine] = None,
+    retry_policy: Optional[RetryPolicy] = None,
 ) -> Iterator[List[tuple]]:
     """Stream archives as chunks of ``chunk_size`` decoded images.
 
@@ -207,7 +271,9 @@ def iter_decoded_chunks(
                 "%d entries read before the error", path, err, n)
 
     out: list = []
-    for item in _pooled_decoded(archive_paths, name_prefix, on_end):
+    for item in _pooled_decoded(archive_paths, name_prefix, on_end,
+                                quarantine=quarantine,
+                                retry_policy=retry_policy):
         out.append(item)
         while len(out) >= chunk_size:
             yield out[:chunk_size]
@@ -233,6 +299,8 @@ def stream_tar_images(
     prepare: Optional[Callable[[List[tuple]], np.ndarray]] = None,
     name_prefix: Optional[str] = None,
     n: Optional[int] = None,
+    quarantine: Optional[Quarantine] = None,
+    retry_policy: Optional[RetryPolicy] = None,
     **stream_kw,
 ):
     """tar archives -> threaded decode pool -> double-buffered device
@@ -246,6 +314,13 @@ def stream_tar_images(
     as-is (uniform-size archives). ``n`` is the total image count when
     known (streams from unindexed tars leave it None; a completed pass
     pins it).
+
+    Resilience defaults: reads/decodes retry transients under
+    ``retry_policy`` (shared default policy when None) and corrupt
+    members land in ``quarantine`` (a fresh default-budget
+    :class:`Quarantine` when None) — attached to the returned stream as
+    ``.quarantine`` so callers can pass it to ``fit_streaming`` or
+    inspect the manifest.
     """
     from ..parallel.streaming import StreamingDataset
 
@@ -253,14 +328,21 @@ def stream_tar_images(
         def prepare(batch):
             return np.stack([img for _, img in batch])
 
+    tag = f"tar:{archive_paths[0]}" if archive_paths else "tar"
+    if quarantine is None:
+        quarantine = Quarantine(label=tag)
+    if retry_policy is None:
+        retry_policy = default_retry_policy()
+
     def factory():
         for batch in iter_decoded_chunks(
-                archive_paths, chunk_size, name_prefix):
+                archive_paths, chunk_size, name_prefix,
+                quarantine=quarantine, retry_policy=retry_policy):
             yield prepare(batch)
 
-    tag = f"tar:{archive_paths[0]}" if archive_paths else "tar"
     return StreamingDataset.from_chunks(
-        factory, chunk_size, n=n, tag=tag, **stream_kw)
+        factory, chunk_size, n=n, tag=tag, retry_policy=retry_policy,
+        quarantine=quarantine, **stream_kw)
 
 
 def load_tar_files(
@@ -268,6 +350,8 @@ def load_tar_files(
     labels_map: Callable[[str], object],
     image_builder: Callable[[np.ndarray, object, str], object],
     name_prefix: Optional[str] = None,
+    quarantine: Optional[Quarantine] = None,
+    retry_policy: Optional[RetryPolicy] = None,
 ) -> HostDataset:
     """Load every image from every archive, applying the label mapping
     (reference ``ImageLoaderUtils.loadFiles``).
@@ -297,7 +381,9 @@ def load_tar_files(
                 "from it", path, err, n)
             opened_any = True
 
-    for name, img in _pooled_decoded(archive_paths, name_prefix, on_end):
+    for name, img in _pooled_decoded(archive_paths, name_prefix, on_end,
+                                     quarantine=quarantine,
+                                     retry_policy=retry_policy):
         # only a decoded image proves the path held real data;
         # None-decodes must not suppress the final ReadError
         opened_any = True

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import threading
 import time
 from dataclasses import asdict, dataclass, field
 from typing import Any, Dict, Iterator, List, Optional
@@ -123,6 +124,15 @@ class PipelineTrace:
         self.chunk_stats: Dict[str, float] = {
             "count": 0, "ingest_stall_s": 0.0, "nbytes": 0.0,
             "occupancy_sum": 0.0}
+        #: resilience events (retries, quarantines, checkpoint
+        #: saves/restores, watchdog trips, injected faults) — same
+        #: bounded-tail-plus-exact-counts shape as ``chunks``
+        self.resilience: List[Dict[str, Any]] = []
+        self.resilience_stats: Dict[str, float] = {}
+        # resilience events fire from decode/prefetch worker threads
+        # concurrently; the read-modify-write on the stats dict needs a
+        # real lock for the "counts stay exact" contract to hold
+        self._resilience_lock = threading.Lock()
         self.meta: Dict[str, Any] = {}
         self.wall_s: float = 0.0
         self._t0: Optional[float] = None
@@ -217,6 +227,26 @@ class PipelineTrace:
         if len(self.chunks) > self.CHUNK_TAIL:
             del self.chunks[: len(self.chunks) - self.CHUNK_TAIL]
 
+    #: raw resilience entries retained (per-event counts in
+    #: ``resilience_stats`` stay exact)
+    RESILIENCE_TAIL = 512
+
+    def record_resilience(self, entry: Dict[str, Any]) -> None:
+        """One resilience event (:mod:`keystone_tpu.resilience.events`):
+        ``entry["event"]`` is the kind (retry / retry_exhausted /
+        quarantine / checkpoint_save / checkpoint_restore /
+        watchdog_trip / fault_injected), the rest is site context. May
+        be called from ingest worker threads (append-only under the
+        GIL, like ``record_chunk``)."""
+        event = str(entry.get("event", "other"))
+        with self._resilience_lock:
+            self.resilience_stats[event] = (
+                self.resilience_stats.get(event, 0) + 1)
+            self.resilience.append(entry)
+            if len(self.resilience) > self.RESILIENCE_TAIL:
+                del self.resilience[: len(self.resilience)
+                                    - self.RESILIENCE_TAIL]
+
     def ingest_stall_s(self) -> float:
         """Total consumer-side ingest stall across ALL streamed chunks
         (exact aggregate) — compare against ``wall_s`` for the overlap
@@ -246,6 +276,8 @@ class PipelineTrace:
             "solver_decisions": list(self.solver_decisions),
             "chunks": list(self.chunks),
             "chunk_stats": dict(self.chunk_stats),
+            "resilience": list(self.resilience),
+            "resilience_stats": dict(self.resilience_stats),
         }
 
     def to_json(self, indent: Optional[int] = 2) -> str:
@@ -277,6 +309,13 @@ class PipelineTrace:
             }
         if stats is not None:
             tr.chunk_stats = dict(stats)
+        tr.resilience = list(data.get("resilience", []))
+        tr.resilience_stats = dict(data.get("resilience_stats", {}))
+        if not tr.resilience_stats and tr.resilience:  # older artifact
+            for e in tr.resilience:
+                ev = str(e.get("event", "other"))
+                tr.resilience_stats[ev] = (
+                    tr.resilience_stats.get(ev, 0) + 1)
         return tr
 
     def summary(self, top: int = 0) -> str:
@@ -326,6 +365,11 @@ class PipelineTrace:
                 f"stall {stall:.3f}s ({share:.1f}% of wall), "
                 f"mean prefetch occupancy "
                 f"{self.chunk_stats['occupancy_sum'] / count:.2f}")
+        if self.resilience_stats:
+            counts = " ".join(
+                f"{k}={int(v)}" for k, v in sorted(
+                    self.resilience_stats.items()))
+            lines.append(f"resilience events: {counts}")
         for d in self.solver_decisions:
             costs = ", ".join(
                 f"{k}={v:.3g}s" for k, v in d.get("costs", {}).items())

@@ -33,6 +33,14 @@ waited on ingest; ``streaming.prefetch_occupancy`` gauge;
 ``streaming.chunks_total`` counter) and, when a
 :class:`~keystone_tpu.observability.PipelineTrace` is active, per-chunk
 trace entries with ingest-stall attribution.
+
+Resilience (:mod:`keystone_tpu.resilience`): chunk staging retries
+transient failures under a :class:`RetryPolicy`; a producer watchdog
+(``stall_timeout_s``) converts a hung source into a clear
+:class:`IngestTimeoutError` instead of an indefinite consumer block;
+and :func:`fit_streaming` checkpoints its (cursor, carry, quarantine)
+state every ``checkpoint_every`` chunks so a killed multi-hour fit
+resumes bit-comparably instead of restarting.
 """
 from __future__ import annotations
 
@@ -47,6 +55,13 @@ from jax.sharding import Mesh
 
 from ..observability.metrics import MetricsRegistry
 from ..observability.trace import current_trace
+from ..resilience.events import record_event
+from ..resilience.faults import inject
+from ..resilience.retry import (
+    IngestTimeoutError,
+    RetryPolicy,
+    default_retry_policy,
+)
 from .dataset import ArrayDataset, Dataset, HostDataset, _pad_to, device_nbytes
 from .mesh import batch_sharding, get_mesh, num_data_shards
 
@@ -146,6 +161,9 @@ class StreamingDataset(Dataset):
                  chunk_size: int, n: Optional[int] = None,
                  mesh: Optional[Mesh] = None, prefetch_depth: int = 2,
                  tag: Optional[str] = None,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 stall_timeout_s: Optional[float] = None,
+                 quarantine: Any = None,
                  _transforms: Tuple[Callable, ...] = ()):
         if not callable(chunk_source):
             raise TypeError(
@@ -162,6 +180,18 @@ class StreamingDataset(Dataset):
         self.n = None if n is None else int(n)
         self.prefetch_depth = int(prefetch_depth)
         self.tag = tag
+        # device staging retries transient failures (one try/except per
+        # chunk when healthy — the <2% resilience-overhead budget);
+        # stall_timeout_s arms the producer watchdog: None = wait
+        # forever, like a plain queue (dead producers still raise)
+        self.retry_policy = retry_policy or default_retry_policy()
+        self.stall_timeout_s = (None if stall_timeout_s is None
+                                else float(stall_timeout_s))
+        #: the corrupt-record quarantine the source feeds, when it has
+        #: one (``stream_tar_images`` wires its decode pool here) —
+        #: carried through ``map``/``map_chunks`` derivations so a
+        #: featurized view still exposes the ingest accounting
+        self.quarantine = quarantine
         self._chunk_source = chunk_source
         self._transforms = tuple(_transforms)
         # device-residency accounting (the out-of-core budget evidence):
@@ -177,6 +207,9 @@ class StreamingDataset(Dataset):
         out = StreamingDataset(
             self._chunk_source, self.chunk_size, n=self.n, mesh=self.mesh,
             prefetch_depth=self.prefetch_depth, tag=tag or self.tag,
+            retry_policy=self.retry_policy,
+            stall_timeout_s=self.stall_timeout_s,
+            quarantine=self.quarantine,
             _transforms=self._transforms + (transform,))
         out._residency = self._residency  # shared budget accounting
         return out
@@ -205,7 +238,10 @@ class StreamingDataset(Dataset):
         """Pad a host chunk to ``chunk_size`` rows and put it on the mesh
         (runs on the prefetch thread; jax device transfers are
         thread-safe and async, so the upload overlaps the consumer's
-        compute)."""
+        compute). Transient staging failures retry under the stream's
+        :class:`RetryPolicy` (the ``ingest.stage`` fault-injection site
+        lives inside the attempt, so injected faults exercise this exact
+        path)."""
         leaves = jax.tree_util.tree_leaves(raw)
         if not leaves:
             raise ValueError("empty chunk from source")
@@ -214,10 +250,15 @@ class StreamingDataset(Dataset):
             raise ValueError(
                 f"source chunk has {rows} rows > chunk_size "
                 f"{self.chunk_size}")
-        sh = batch_sharding(self.mesh)
-        data = jax.tree_util.tree_map(
-            lambda x: jax.device_put(
-                _pad_to(np.asarray(x), self.chunk_size), sh), raw)
+
+        def put() -> Any:
+            inject("ingest.stage", context=self.tag or "stream")
+            sh = batch_sharding(self.mesh)
+            return jax.tree_util.tree_map(
+                lambda x: jax.device_put(
+                    _pad_to(np.asarray(x), self.chunk_size), sh), raw)
+
+        data = self.retry_policy.call(put, site="ingest.stage")
         return ArrayDataset(data, rows, self.mesh, _already_sharded=True)
 
     def chunks(self) -> Iterator[ArrayDataset]:
@@ -245,6 +286,10 @@ class StreamingDataset(Dataset):
         def produce():
             try:
                 for raw in self._chunk_source():
+                    # named fault site for producer hangs/stalls; abort
+                    # wakes a "hang" injection when the consumer leaves
+                    inject("ingest.produce", context=self.tag or "stream",
+                           abort=stop.is_set)
                     if not acquire_slot():
                         return
                     ad = self._stage(raw)
@@ -271,10 +316,51 @@ class StreamingDataset(Dataset):
         rows_seen = 0
         complete = False
         trace = current_trace()
+        def get_with_watchdog(t0: float):
+            """Heartbeat loop around ``q.get``: wakes once a second to
+            notice a dead producer thread (nothing more is coming —
+            raise instead of blocking forever) and, when
+            ``stall_timeout_s`` is set, enforces the ingest deadline.
+            Zero-cost while chunks flow: the timeout only matters when
+            the consumer is already starved."""
+            deadline = (None if self.stall_timeout_s is None
+                        else t0 + self.stall_timeout_s)
+            while True:
+                wait = 1.0
+                if deadline is not None:
+                    wait = min(wait, max(deadline - time.perf_counter(),
+                                         0.01))
+                try:
+                    return q.get(timeout=wait)
+                except queue.Empty:
+                    starved_s = time.perf_counter() - t0
+                    if not producer.is_alive() and q.empty():
+                        record_event("watchdog_trip",
+                                     source=self.tag or "stream",
+                                     reason="producer_died", chunk=seen)
+                        raise IngestTimeoutError(
+                            f"stream {self.tag or '<untagged>'}: the "
+                            f"producer thread died without completing "
+                            f"the stream (after chunk {seen})")
+                    if (deadline is not None
+                            and time.perf_counter() >= deadline):
+                        record_event("watchdog_trip",
+                                     source=self.tag or "stream",
+                                     reason="stall_deadline", chunk=seen,
+                                     stall_s=starved_s)
+                        raise IngestTimeoutError(
+                            f"stream {self.tag or '<untagged>'}: no "
+                            f"chunk from the producer in "
+                            f"{starved_s:.1f}s (stall_timeout_s="
+                            f"{self.stall_timeout_s:g}, after chunk "
+                            f"{seen}; producer thread alive) — hung "
+                            "source? Raise stall_timeout_s if the "
+                            "source is legitimately this slow.")
+
         try:
             while True:
                 t0 = time.perf_counter()
-                item = q.get()
+                item = get_with_watchdog(t0)
                 stall = time.perf_counter() - t0
                 if item is _DONE:
                     complete = True
@@ -459,7 +545,8 @@ def _non_streamable_error(estimator: Any) -> TypeError:
         "(StreamingDataset.materialize()) if it fits in HBM, or use a "
         "streamable estimator (LeastSquares family, StandardScaler). "
         "`python -m keystone_tpu check` flags this statically as "
-        "'non-streamable-fit'.")
+        "'non-streamable-fit'. README 'Streaming ingest' / 'Resilience' "
+        "document the streaming fit and checkpoint/resume API.")
 
 
 def _paired_chunks(data: StreamingDataset,
@@ -521,7 +608,10 @@ def _paired_chunks(data: StreamingDataset,
 
 
 def fit_streaming(estimator: Any, data: StreamingDataset,
-                  labels: Any = None, hbm_budget: Optional[float] = None):
+                  labels: Any = None, hbm_budget: Optional[float] = None,
+                  checkpoint_dir: Optional[str] = None,
+                  checkpoint_every: Optional[int] = None,
+                  quarantine: Any = None):
     """Drive a streamable estimator over a chunked dataset: one
     ``accumulate`` per chunk, then ``finalize`` — the featurized matrix
     never exists on device, only the carry (Gram/cross/moments) and the
@@ -530,13 +620,60 @@ def fit_streaming(estimator: Any, data: StreamingDataset,
     ``hbm_budget`` (bytes), when given, asserts after every chunk that
     the stream's device residency (prefetch buffer + working chunk) has
     stayed within ``budget``: the out-of-core guarantee, checkable.
+
+    Checkpoint/resume (:mod:`keystone_tpu.resilience`): with
+    ``checkpoint_dir`` set, every ``checkpoint_every`` chunks (default
+    16) the (chunk cursor, estimator carry, quarantine state, config
+    fingerprint) is snapshotted atomically. A later call with the same
+    configuration resumes from the snapshot — already-accumulated
+    chunks are re-ingested but NOT re-accumulated, so the resumed
+    weights are bit-comparable with an uninterrupted run. A snapshot
+    from a DIFFERENT configuration (estimator params, chunk size,
+    labels kind) raises ``CheckpointMismatchError`` instead of silently
+    resuming wrong state; the snapshot is cleared after a successful
+    finalize.
+
+    ``quarantine`` (a :class:`~keystone_tpu.resilience.Quarantine`,
+    usually the one wired into the stream's decode pool) rides the
+    checkpoint so a resumed fit keeps its corrupt-record accounting.
     """
     if not is_streamable(estimator):
         raise _non_streamable_error(estimator)
-    takes_labels = labels is not None
+    if checkpoint_every is not None and checkpoint_dir is None:
+        raise ValueError("checkpoint_every requires checkpoint_dir")
+    if quarantine is None:
+        # a stream built by a quarantining loader carries its own
+        # (stream_tar_images); use it so checkpoints keep the accounting
+        quarantine = getattr(data, "quarantine", None)
+    ckpt = None
+    fingerprint = None
+    start_chunk = 0
     carry = None
+    if checkpoint_dir is not None:
+        from ..resilience.stream_checkpoint import (
+            StreamCheckpoint,
+            fit_fingerprint,
+        )
+
+        checkpoint_every = (16 if checkpoint_every is None
+                            else int(checkpoint_every))
+        if checkpoint_every < 1:
+            raise ValueError("checkpoint_every must be >= 1")
+        fingerprint = fit_fingerprint(estimator, data, labels)
+        ckpt = StreamCheckpoint(checkpoint_dir)
+        snap = ckpt.load(fingerprint)
+        if snap is not None:
+            start_chunk = int(snap["cursor"])
+            carry = snap["carry"]
+            if quarantine is not None and snap.get("quarantine"):
+                quarantine.restore(snap["quarantine"])
+    takes_labels = labels is not None
     chunks_seen = 0
+    idx = -1
     for chunk, lchunk in _paired_chunks(data, labels):
+        idx += 1
+        if idx < start_chunk:
+            continue  # resume replay: already folded into the carry
         if takes_labels:
             carry = estimator.accumulate(carry, chunk, lchunk)
         else:
@@ -550,6 +687,12 @@ def fit_streaming(estimator: Any, data: StreamingDataset,
                     f"{resident:.0f} B resident > {hbm_budget:.0f} B "
                     f"(chunk {chunks_seen}; shrink chunk_size or "
                     "prefetch_depth)")
+        if ckpt is not None and (idx + 1) % checkpoint_every == 0:
+            ckpt.save(fingerprint, idx + 1, carry,
+                      None if quarantine is None else quarantine.state())
     if carry is None:
         raise ValueError("empty stream: nothing to fit")
-    return estimator.finalize(carry)
+    model = estimator.finalize(carry)
+    if ckpt is not None:
+        ckpt.clear()
+    return model

@@ -1,0 +1,71 @@
+"""Resilient execution for TPU-native pipelines.
+
+The reference framework inherited fault tolerance from Spark (task
+retry, lineage recomputation, RDD checkpointing); keystone_tpu runs on
+bare threads + jax and gets none of that for free. This package is the
+in-tree substrate, wired through the streaming ingest
+(:mod:`keystone_tpu.parallel.streaming`), the tar decode pool
+(:mod:`keystone_tpu.loaders.image_loader_utils`) and the estimator fit
+surface:
+
+* :mod:`.retry` — :class:`RetryPolicy` (exponential backoff + seeded
+  jitter, per-attempt timeout, retryable-exception classification) for
+  host record reads/decodes and device staging; the consumer-side
+  producer watchdog raises :class:`IngestTimeoutError` instead of
+  blocking forever on a hung source.
+* :mod:`.quarantine` — :class:`Quarantine`: corrupt records are
+  skipped-but-accounted under a ``max_bad_fraction`` budget; the fit
+  fails loudly, naming the source, when the budget is exceeded.
+* :mod:`.stream_checkpoint` — :class:`StreamCheckpoint` +
+  :func:`fit_fingerprint`: atomic snapshot/resume of a streaming fit's
+  (cursor, carry, quarantine) state, bit-comparable with an
+  uninterrupted run; mismatched config fingerprints refuse to resume.
+* :mod:`.faults` — :class:`FaultPlan`/:func:`inject`: a seeded,
+  deterministic fault-injection harness at named ingest sites, so every
+  guarantee above has a test that exercises the real code path.
+
+All events flow through :mod:`.events` into ``resilience.*`` metrics
+counters and the active :class:`~keystone_tpu.observability.PipelineTrace`.
+"""
+from .events import record_event
+from .faults import FaultPlan, FaultSpec, InjectedFaultError, inject
+from .quarantine import (
+    CorruptRecordError,
+    Quarantine,
+    QuarantineBudgetExceededError,
+)
+from .retry import (
+    AttemptTimeoutError,
+    IngestTimeoutError,
+    RetryExhaustedError,
+    RetryPolicy,
+    TransientError,
+    default_retry_policy,
+)
+from .stream_checkpoint import (
+    CheckpointCorruptError,
+    CheckpointMismatchError,
+    StreamCheckpoint,
+    fit_fingerprint,
+)
+
+__all__ = [
+    "AttemptTimeoutError",
+    "CheckpointCorruptError",
+    "CheckpointMismatchError",
+    "CorruptRecordError",
+    "FaultPlan",
+    "FaultSpec",
+    "IngestTimeoutError",
+    "InjectedFaultError",
+    "Quarantine",
+    "QuarantineBudgetExceededError",
+    "RetryExhaustedError",
+    "RetryPolicy",
+    "StreamCheckpoint",
+    "TransientError",
+    "default_retry_policy",
+    "fit_fingerprint",
+    "inject",
+    "record_event",
+]

@@ -1,0 +1,33 @@
+"""One funnel for resilience telemetry.
+
+Every resilience event (a retry, a quarantined record, a checkpoint
+write/restore, a watchdog trip, an injected fault) flows through
+:func:`record_event`, which increments the matching
+``resilience.<event>`` counter in the process
+:class:`~keystone_tpu.observability.MetricsRegistry` and — when a
+:class:`~keystone_tpu.observability.PipelineTrace` is active — appends a
+structured entry to the trace's resilience stream. Sites never talk to
+the metrics/trace layers directly, so the event vocabulary stays in one
+place:
+
+    retry, retry_exhausted, quarantine, checkpoint_save,
+    checkpoint_restore, watchdog_trip, fault_injected
+
+Events may fire from prefetch/decode worker threads; both sinks are
+append-only under the GIL, matching how the streaming layer already
+feeds them.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from ..observability.metrics import MetricsRegistry
+from ..observability.trace import current_trace
+
+
+def record_event(event: str, **fields: Any) -> None:
+    """Count ``resilience.<event>`` and trace the structured entry."""
+    MetricsRegistry.get_or_create().counter(f"resilience.{event}").inc()
+    trace = current_trace()
+    if trace is not None:
+        trace.record_resilience({"event": event, **fields})

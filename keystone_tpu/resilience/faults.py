@@ -1,0 +1,168 @@
+"""Seeded, deterministic fault injection at named sites.
+
+The resilience layer's guarantees (retry absorbs transients, corrupt
+records quarantine, a hung producer trips the watchdog, a killed fit
+resumes) are worth nothing asserted — they need tests that exercise the
+REAL code paths. The ingest/staging code therefore calls
+:func:`inject` at named sites:
+
+====================  =====================================================
+site                  where
+====================  =====================================================
+``ingest.read``       per tar-member raw read (``loaders._iter_tar_entries``)
+``ingest.decode``     per image decode attempt (tar decode pool)
+``ingest.stage``      per chunk ``device_put`` staging (prefetcher)
+``ingest.produce``    per chunk in the prefetch producer loop
+====================  =====================================================
+
+``inject`` is a single global read when no plan is active — zero cost
+in production. Under ``with FaultPlan(seed) as plan:`` each visit to a
+site consults the plan's specs:
+
+* ``kind="error"`` raises (default
+  :class:`~keystone_tpu.resilience.retry.TransientError`; pass
+  ``error=`` for corrupt-record or fatal flavors),
+* ``kind="latency"`` sleeps ``delay_s`` (an I/O latency spike),
+* ``kind="hang"`` blocks until the plan exits, the caller's ``abort``
+  callback goes true, or ``delay_s`` elapses — a hung producer.
+
+Injection is deterministic: ``rate`` draws come from the plan's seeded
+RNG, and ``after``/``count`` give exact "fail once, after the k-th
+visit" placement (the kill-and-resume tests are built on this).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .events import record_event
+from .retry import TransientError
+
+
+class InjectedFaultError(TransientError):
+    """Default injected failure: transient, so the retry path absorbs
+    it. Pass ``error=`` to :meth:`FaultPlan.add` for other flavors."""
+
+
+@dataclass
+class FaultSpec:
+    """One injection rule at one site."""
+
+    site: str
+    kind: str = "error"          # error | latency | hang
+    rate: float = 1.0            # per-visit injection probability
+    after: int = 0               # skip the first `after` visits entirely
+    count: Optional[int] = None  # at most this many injections
+    error: Optional[Callable[[str], BaseException]] = None
+    delay_s: float = 0.05        # latency duration / hang cap
+    visits: int = field(default=0, compare=False)
+    injected: int = field(default=0, compare=False)
+
+
+_ACTIVE: Optional["FaultPlan"] = None
+
+
+class FaultPlan:
+    """A seeded set of :class:`FaultSpec` rules, active inside ``with``.
+
+    Usage::
+
+        plan = (FaultPlan(seed=7)
+                .add("ingest.decode", rate=0.1)             # transient
+                .add("ingest.produce", kind="hang", after=3, count=1))
+        with plan:
+            fit_streaming(est, stream, labels)
+        assert plan.injections("ingest.decode") > 0
+    """
+
+    def __init__(self, seed: int = 0):
+        self._rng = np.random.RandomState(seed)
+        self._specs: Dict[str, List[FaultSpec]] = {}
+        self._lock = threading.Lock()
+        self._release = threading.Event()
+        self.log: List[Dict[str, Any]] = []
+
+    def add(self, site: str, kind: str = "error", rate: float = 1.0,
+            after: int = 0, count: Optional[int] = None,
+            error: Optional[Callable[[str], BaseException]] = None,
+            delay_s: float = 0.05) -> "FaultPlan":
+        if kind not in ("error", "latency", "hang"):
+            raise ValueError(f"unknown fault kind {kind!r}")
+        if not 0.0 < rate <= 1.0:
+            raise ValueError("rate must be in (0, 1]")
+        spec = FaultSpec(site=site, kind=kind, rate=rate, after=int(after),
+                         count=count, error=error, delay_s=float(delay_s))
+        self._specs.setdefault(site, []).append(spec)
+        return self
+
+    # -- activation --------------------------------------------------------
+    def __enter__(self) -> "FaultPlan":
+        global _ACTIVE
+        if _ACTIVE is not None:
+            raise RuntimeError("another FaultPlan is already active")
+        self._release.clear()
+        _ACTIVE = self
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _ACTIVE
+        _ACTIVE = None
+        # wake every hung site so daemon threads blocked in a "hang"
+        # injection finish instead of lingering for delay_s
+        self._release.set()
+
+    # -- introspection -----------------------------------------------------
+    def injections(self, site: Optional[str] = None) -> int:
+        with self._lock:
+            return len([e for e in self.log
+                        if site is None or e["site"] == site])
+
+    # -- the injection point ----------------------------------------------
+    def fire(self, site: str, context: Any,
+             abort: Optional[Callable[[], bool]] = None) -> None:
+        specs = self._specs.get(site)
+        if not specs:
+            return
+        for spec in specs:
+            with self._lock:
+                spec.visits += 1
+                if spec.visits <= spec.after:
+                    continue
+                if spec.count is not None and spec.injected >= spec.count:
+                    continue
+                if spec.rate < 1.0 and float(self._rng.rand()) >= spec.rate:
+                    continue
+                spec.injected += 1
+                self.log.append({"site": site, "kind": spec.kind,
+                                 "context": context})
+            record_event("fault_injected", site=site, kind=spec.kind,
+                         context=str(context))
+            if spec.kind == "latency":
+                time.sleep(spec.delay_s)
+            elif spec.kind == "hang":
+                deadline = time.perf_counter() + spec.delay_s
+                while (not self._release.wait(0.02)
+                       and not (abort is not None and abort())
+                       and time.perf_counter() < deadline):
+                    pass
+            else:
+                exc = (spec.error(f"injected fault at {site} ({context})")
+                       if spec.error is not None else
+                       InjectedFaultError(
+                           f"injected fault at {site} ({context})"))
+                raise exc
+
+
+def inject(site: str, context: Any = None,
+           abort: Optional[Callable[[], bool]] = None) -> None:
+    """The per-site hook: a no-op (one global read) unless a
+    :class:`FaultPlan` is active. ``abort`` lets long "hang" injections
+    end early when the caller is shutting down (the stream producer
+    passes its stop event)."""
+    plan = _ACTIVE
+    if plan is not None:
+        plan.fire(site, context, abort)

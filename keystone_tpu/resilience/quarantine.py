@@ -1,0 +1,154 @@
+"""Corrupt-record quarantine with a bad-fraction budget.
+
+The reference framework inherited "skip the bad record, keep the job
+alive" from Spark task semantics; the TPU port's ingest (tar decode
+pool, streaming prefetcher) previously either dropped undecodable
+records *silently* or died on the first one. A :class:`Quarantine`
+makes the middle path explicit:
+
+* a bad record is **skipped but accounted**: its source identity and
+  reason land in the in-memory manifest (and, when ``manifest_path`` is
+  set, an append-only JSONL file), the ``resilience.quarantine`` counter
+  and the active :class:`~keystone_tpu.observability.PipelineTrace`
+  record it;
+* the fit **fails loudly** once bad records exceed the
+  ``max_bad_fraction`` budget — graceful degradation, never silent data
+  loss. The error names the last quarantined source.
+
+Records are keyed by source identity (``archive.tar::member.jpg``), so
+a resumed/replayed pass re-encountering the same bad record counts it
+once — the property checkpoint/resume relies on.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, List, Optional
+
+from .events import record_event
+
+
+class CorruptRecordError(Exception):
+    """A record that can never be read correctly (truncated image,
+    garbage bytes) — the NON-retryable counterpart of
+    :class:`~keystone_tpu.resilience.retry.TransientError`: retrying a
+    corrupt record wastes attempts, quarantining it is the answer."""
+
+
+class QuarantineBudgetExceededError(RuntimeError):
+    """Raised when quarantined records exceed ``max_bad_fraction``."""
+
+
+class Quarantine:
+    """Skip-but-account sink for corrupt records; see module docstring.
+
+    ``max_bad_fraction`` is the budget: the quarantine raises once
+    ``bad > max_bad_fraction * max(records_seen, min_records)``. The
+    ``min_records`` floor keeps a bad record early in the stream (1 bad
+    of 2 seen = 50%) from killing a run whose true bad fraction is tiny;
+    it also makes the budget check safe during a checkpoint-resume
+    replay, where bad counts are restored before good records recount.
+    """
+
+    #: raw manifest entries retained in memory (counts stay exact)
+    MANIFEST_TAIL = 1000
+
+    def __init__(self, max_bad_fraction: float = 0.01,
+                 min_records: int = 100,
+                 manifest_path: Optional[str] = None,
+                 label: str = "ingest"):
+        if not 0.0 <= max_bad_fraction <= 1.0:
+            raise ValueError("max_bad_fraction must be in [0, 1]")
+        self.max_bad_fraction = float(max_bad_fraction)
+        self.min_records = int(min_records)
+        self.manifest_path = manifest_path
+        self.label = label
+        self.records: List[Dict[str, Any]] = []
+        self.bad_count = 0
+        self.ok_count = 0
+        self._keys: set = set()
+        self._lock = threading.Lock()
+
+    # -- accounting --------------------------------------------------------
+    def record_ok(self, n: int = 1) -> None:
+        """Count ``n`` good records (called by the ingest path that can
+        also see bad ones, so the fraction's denominator is honest)."""
+        with self._lock:
+            self.ok_count += int(n)
+
+    def quarantine(self, source: str, reason: str,
+                   site: str = "ingest.decode") -> None:
+        """Quarantine one bad record, then enforce the budget.
+
+        Idempotent per ``source``: a replayed pass (checkpoint resume,
+        second epoch) re-hitting the same record does not double-count.
+        """
+        entry = {"source": str(source), "reason": str(reason),
+                 "site": site}
+        with self._lock:
+            if entry["source"] in self._keys:
+                return
+            self._keys.add(entry["source"])
+            self.bad_count += 1
+            self.records.append(entry)
+            if len(self.records) > self.MANIFEST_TAIL:
+                del self.records[: len(self.records) - self.MANIFEST_TAIL]
+        record_event("quarantine", **entry)
+        if self.manifest_path:
+            try:
+                with open(self.manifest_path, "a") as f:
+                    f.write(json.dumps(entry) + "\n")
+            except OSError as exc:
+                # a full/unwritable manifest disk must not kill the fit;
+                # the in-memory manifest and metrics still hold the record
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "quarantine manifest %s unwritable (%s); entry kept "
+                    "in memory only", self.manifest_path, exc)
+        self.check_budget(last_source=entry["source"])
+
+    # -- budget ------------------------------------------------------------
+    def seen(self) -> int:
+        return self.bad_count + self.ok_count
+
+    def bad_fraction(self) -> float:
+        return self.bad_count / max(self.seen(), 1)
+
+    def check_budget(self, last_source: Optional[str] = None) -> None:
+        allowed = self.max_bad_fraction * max(self.seen(),
+                                              self.min_records)
+        if self.bad_count > allowed:
+            raise QuarantineBudgetExceededError(
+                f"{self.label}: {self.bad_count} corrupt record(s) out of "
+                f"{self.seen()} seen exceeds the quarantine budget "
+                f"(max_bad_fraction={self.max_bad_fraction:g}, "
+                f"min_records={self.min_records}). Last quarantined "
+                f"source: {last_source or (self.records[-1]['source'] if self.records else '?')}. "
+                "The data is worse than the budget allows — fix the "
+                "source or raise max_bad_fraction explicitly.")
+
+    # -- checkpoint state --------------------------------------------------
+    def state(self) -> Dict[str, Any]:
+        """Snapshot for a streaming-fit checkpoint: the bad-record
+        manifest and keys (ok counts are NOT persisted — a resume
+        replays the stream from the start, recounting good records)."""
+        with self._lock:
+            return {"records": list(self.records),
+                    "keys": sorted(self.records and self._keys or ()),
+                    "bad_count": self.bad_count}
+
+    def restore(self, state: Dict[str, Any]) -> None:
+        """Restore a :meth:`state` snapshot (checkpoint resume). Good
+        counts reset to zero: the replay re-decodes every record, so
+        they recount naturally while restored bad keys dedupe."""
+        with self._lock:
+            self.records = list(state.get("records", ()))
+            self._keys = set(state.get("keys", ()))
+            self.bad_count = int(state.get("bad_count", len(self.records)))
+            self.ok_count = 0
+
+    def summary(self) -> str:
+        return (f"quarantine[{self.label}]: {self.bad_count} bad / "
+                f"{self.seen()} seen "
+                f"(budget {self.max_bad_fraction:g})")

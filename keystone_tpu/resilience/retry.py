@@ -1,0 +1,187 @@
+"""Retry with exponential backoff + jitter, and the ingest watchdog error.
+
+The reference framework got task retry for free from Spark (a flaky
+disk read killed one task, the scheduler reran it); the TPU port runs
+its ingest on bare threads, so one transient I/O error previously
+killed a multi-hour streamed fit. :class:`RetryPolicy` is the in-tree
+replacement, applied at the record level (tar reads, image decodes) and
+the chunk level (``device_put`` staging in the prefetcher):
+
+* **classification** — only :meth:`RetryPolicy.is_retryable` exceptions
+  are retried. Transient things (``TransientError``, timeouts, generic
+  ``OSError``) are; deterministic ones (missing file, permission,
+  :class:`~keystone_tpu.resilience.quarantine.CorruptRecordError`) are
+  not — retrying a corrupt JPEG three times just burns backoff time.
+* **exponential backoff + seeded jitter** — ``backoff_s * multiplier^i``
+  capped at ``max_backoff_s``, stretched by up to ``jitter`` uniform
+  randomness from a seeded RNG (deterministic under the fault harness).
+* **per-attempt timeout** — with ``attempt_timeout_s`` set, an attempt
+  runs on a helper thread and is abandoned (counted as a transient
+  failure) when it overruns. The abandoned thread is daemonic and may
+  linger until its blocking call returns; use only around calls that do
+  eventually return.
+
+Every retry feeds ``resilience.retry`` metrics and the active trace
+(:mod:`.events`); exhaustion raises :class:`RetryExhaustedError` with
+the final failure as ``__cause__``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Optional, Tuple, Type
+
+import numpy as np
+
+from .events import record_event
+from .quarantine import CorruptRecordError
+
+
+class TransientError(Exception):
+    """Base class for failures that are worth retrying."""
+
+
+class AttemptTimeoutError(TransientError):
+    """An attempt overran its per-attempt timeout (counts as transient:
+    the next attempt may be served from a recovered disk/device)."""
+
+
+class RetryExhaustedError(RuntimeError):
+    """Every attempt failed; ``__cause__`` is the last failure."""
+
+    def __init__(self, site: str, attempts: int,
+                 last: BaseException):
+        super().__init__(
+            f"{site}: all {attempts} attempt(s) failed; last error: "
+            f"{type(last).__name__}: {last}")
+        self.site = site
+        self.attempts = attempts
+
+
+class IngestTimeoutError(RuntimeError):
+    """The streaming consumer's producer watchdog tripped: the source
+    produced no chunk within its deadline (hung disk/decoder/producer).
+    Raised instead of blocking the fit forever."""
+
+
+#: worth retrying by default: explicit transients, timeouts, and generic
+#: I/O errors (a flaky NFS read raises plain OSError)
+DEFAULT_RETRYABLE: Tuple[Type[BaseException], ...] = (
+    TransientError, TimeoutError, InterruptedError, ConnectionError,
+    OSError)
+
+#: never retried even though they subclass a retryable type: these are
+#: deterministic — the retry would fail identically three times, slower
+DEFAULT_NON_RETRYABLE: Tuple[Type[BaseException], ...] = (
+    FileNotFoundError, PermissionError, IsADirectoryError,
+    NotADirectoryError, CorruptRecordError)
+
+
+class RetryPolicy:
+    """Configurable retry/backoff; see module docstring.
+
+    One policy instance may be shared across threads (the tar decode
+    pool retries records concurrently): the jitter RNG is guarded by a
+    lock, everything else is immutable.
+    """
+
+    def __init__(self, max_attempts: int = 3, backoff_s: float = 0.05,
+                 multiplier: float = 2.0, max_backoff_s: float = 2.0,
+                 jitter: float = 0.5,
+                 attempt_timeout_s: Optional[float] = None,
+                 retryable: Tuple[Type[BaseException], ...]
+                 = DEFAULT_RETRYABLE,
+                 non_retryable: Tuple[Type[BaseException], ...]
+                 = DEFAULT_NON_RETRYABLE,
+                 seed: int = 0):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = int(max_attempts)
+        self.backoff_s = float(backoff_s)
+        self.multiplier = float(multiplier)
+        self.max_backoff_s = float(max_backoff_s)
+        self.jitter = float(jitter)
+        self.attempt_timeout_s = attempt_timeout_s
+        self.retryable = tuple(retryable)
+        self.non_retryable = tuple(non_retryable)
+        self._rng = np.random.RandomState(seed)
+        self._lock = threading.Lock()
+
+    # -- classification ----------------------------------------------------
+    def is_retryable(self, exc: BaseException) -> bool:
+        return isinstance(exc, self.retryable) and not isinstance(
+            exc, self.non_retryable)
+
+    # -- backoff -----------------------------------------------------------
+    def backoff(self, attempt: int) -> float:
+        """Sleep before attempt ``attempt + 1`` (``attempt`` is
+        1-based): exponential base stretched by seeded jitter."""
+        base = min(self.backoff_s * self.multiplier ** (attempt - 1),
+                   self.max_backoff_s)
+        with self._lock:
+            j = float(self._rng.rand())
+        return base * (1.0 + self.jitter * j)
+
+    # -- the driver --------------------------------------------------------
+    def _attempt(self, fn: Callable[..., Any], args, kwargs) -> Any:
+        if self.attempt_timeout_s is None:
+            return fn(*args, **kwargs)
+        done = threading.Event()
+        box: list = []
+
+        def run():
+            try:
+                box.append(("ok", fn(*args, **kwargs)))
+            except BaseException as exc:
+                box.append(("err", exc))
+            finally:
+                done.set()
+
+        t = threading.Thread(target=run, daemon=True,
+                             name="keystone-retry-attempt")
+        t.start()
+        if not done.wait(self.attempt_timeout_s):
+            raise AttemptTimeoutError(
+                f"attempt exceeded {self.attempt_timeout_s:g}s "
+                "(abandoned; counted as transient)")
+        kind, value = box[0]
+        if kind == "err":
+            raise value
+        return value
+
+    def call(self, fn: Callable[..., Any], *args: Any,
+             site: str = "retry", **kwargs: Any) -> Any:
+        """Run ``fn`` under the policy. Non-retryable exceptions
+        propagate unchanged on the first failure; retryable ones are
+        retried with backoff and finally wrapped in
+        :class:`RetryExhaustedError` (``__cause__`` = last failure)."""
+        last: Optional[BaseException] = None
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                return self._attempt(fn, args, kwargs)
+            except BaseException as exc:
+                if not self.is_retryable(exc):
+                    raise
+                last = exc
+                record_event("retry", site=site, attempt=attempt,
+                             error=f"{type(exc).__name__}: {exc}")
+                if attempt < self.max_attempts:
+                    time.sleep(self.backoff(attempt))
+        record_event("retry_exhausted", site=site,
+                     attempts=self.max_attempts,
+                     error=f"{type(last).__name__}: {last}")
+        raise RetryExhaustedError(site, self.max_attempts, last) from last
+
+
+#: shared default policy: 3 attempts, 50 ms base backoff. Module-level
+#: so every ingest site that is not given an explicit policy shares one
+#: jitter RNG (deterministic under a fixed seed) and zero per-call
+#: construction cost.
+_DEFAULT_POLICY: Optional[RetryPolicy] = None
+
+
+def default_retry_policy() -> RetryPolicy:
+    global _DEFAULT_POLICY
+    if _DEFAULT_POLICY is None:
+        _DEFAULT_POLICY = RetryPolicy()
+    return _DEFAULT_POLICY

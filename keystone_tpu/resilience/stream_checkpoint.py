@@ -1,0 +1,210 @@
+"""Checkpoint/resume for streaming fits.
+
+The reference leaned on Spark lineage + optional RDD checkpointing: a
+lost executor recomputed its partitions, a checkpointed RDD restarted
+from disk. A streamed TPU fit has exactly one piece of evolving state —
+the estimator carry (Gram/cross/moments) plus the chunk cursor — so
+checkpointing it is cheap (O(d*(d+k)), not O(n)) and resume is exact:
+
+* :class:`StreamCheckpoint` atomically snapshots ``(format version,
+  config fingerprint, chunk cursor, carry, quarantine state)`` via
+  temp-file + ``os.replace`` every N chunks;
+* a resumed ``fit_streaming`` replays the source, SKIPS accumulation
+  for the first ``cursor`` chunks (they are already folded into the
+  restored carry), and continues — the remaining accumulate ops see
+  bit-identical inputs in the same order, so the resumed weights are
+  bit-comparable with an uninterrupted run (f32 host round-trip of the
+  carry is exact);
+* the **fingerprint** binds the snapshot to (estimator config, chunk
+  geometry, labels kind): resuming under ANY change raises
+  :class:`CheckpointMismatchError` instead of silently folding new
+  chunks into a stale carry.
+
+Truncated/corrupt snapshot files raise :class:`CheckpointCorruptError`
+(shared with :mod:`keystone_tpu.utils.checkpoint`) naming the path.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import re
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .events import record_event
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file exists but cannot be read back (truncated
+    write, bad bytes, wrong format/version). The message names the
+    path; deleting the file starts clean."""
+
+
+class CheckpointMismatchError(RuntimeError):
+    """A checkpoint's config fingerprint does not match the current fit
+    — refusing to resume from another pipeline's state."""
+
+
+def atomic_pickle_dump(payload: Any, path: str) -> None:
+    """THE atomic checkpoint write (shared by this module,
+    ``utils.checkpoint`` and the solver checkpoint): pickle to a
+    pid-suffixed temp file, then ``os.replace`` — a crash mid-write
+    leaves the previous artifact intact, never a torn file, and two
+    local runs cannot clobber each other's in-flight temp."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        pickle.dump(payload, f)
+    os.replace(tmp, path)
+
+
+# -- config fingerprint ------------------------------------------------------
+
+def _stable(obj: Any) -> Any:
+    """JSON-able, address-free view of a config value: callables map to
+    their qualified name, arrays to their shape/dtype, everything else
+    to repr with memory addresses stripped (so the fingerprint is
+    stable across processes — the whole point of resume)."""
+    if isinstance(obj, (str, int, float, bool, type(None))):
+        return obj
+    if isinstance(obj, type):
+        return f"type:{obj.__module__}.{obj.__qualname__}"
+    if callable(obj) and hasattr(obj, "__qualname__"):
+        return f"fn:{getattr(obj, '__module__', '?')}.{obj.__qualname__}"
+    if isinstance(obj, (list, tuple)):
+        return [_stable(x) for x in obj]
+    if isinstance(obj, dict):
+        return {str(k): _stable(v) for k, v in sorted(obj.items(),
+                                                      key=lambda kv:
+                                                      str(kv[0]))}
+    if isinstance(obj, np.ndarray):
+        return f"ndarray{tuple(obj.shape)}:{obj.dtype}"
+    return re.sub(r"0x[0-9a-fA-F]+", "0x", repr(obj))
+
+
+def _estimator_key(estimator: Any) -> Any:
+    eq = getattr(estimator, "eq_key", None)
+    if callable(eq):
+        try:
+            return _stable(eq())
+        except TypeError:
+            pass  # eq_key needing arguments: fall through to config
+    cfg = {k: v for k, v in vars(estimator).items()
+           if not k.startswith("_")}
+    return [f"{type(estimator).__module__}.{type(estimator).__qualname__}",
+            _stable(cfg)]
+
+
+def fit_fingerprint(estimator: Any, data: Any,
+                    labels: Any = None) -> str:
+    """Stable id of one streamed-fit configuration: the estimator's
+    config, the stream's padded chunk geometry + source tag, and the
+    labels — resident labels by a CONTENT digest (they are host-side
+    and k-wide, so hashing them is cheap and catches "same shape,
+    different labels"), streamed labels by chunk geometry.
+    ``prefetch_depth`` and retry/watchdog settings are deliberately
+    excluded: they change scheduling, not results, so a resume may
+    tune them.
+
+    Honest limit: the fingerprint cannot see STREAM content without
+    consuming the stream. Swapping the records behind an identical
+    source tag / chunk size (or behind streamed labels) between kill
+    and resume is not detectable here — keep the source stable across
+    a resume, as you would for any replay-based recovery."""
+    if labels is None:
+        labels_key: Any = None
+    elif hasattr(labels, "chunk_size") and hasattr(labels, "chunks"):
+        labels_key = f"stream:chunk_size={labels.chunk_size}"
+    else:
+        from ..parallel.dataset import to_numpy
+
+        arr = np.ascontiguousarray(to_numpy(labels))
+        digest = hashlib.sha256(arr.tobytes()).hexdigest()[:16]
+        labels_key = (f"resident:{tuple(arr.shape)}:{arr.dtype}:"
+                      f"{digest}")
+    element = getattr(data, "element", None)
+    parts = {
+        "estimator": _estimator_key(estimator),
+        "chunk_size": int(getattr(data, "chunk_size", 0)),
+        "data_tag": getattr(data, "tag", None),
+        "data_element": _stable(element() if callable(element) else None),
+        "labels": labels_key,
+    }
+    blob = json.dumps(parts, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+# -- the snapshot file -------------------------------------------------------
+
+class StreamCheckpoint:
+    """Atomic snapshot/restore of one streaming fit's progress."""
+
+    MAGIC = "keystone-stream-fit"
+    VERSION = 1
+
+    def __init__(self, directory: str, name: str = "stream_fit"):
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, f"{name}.ckpt")
+
+    def save(self, fingerprint: str, cursor: int, carry: Any,
+             quarantine_state: Optional[Dict[str, Any]] = None) -> None:
+        """Snapshot after chunk ``cursor - 1``: carry leaves move to
+        host (blocks on the device result — the checkpoint must not
+        capture an in-flight accumulation) and the file replaces the
+        previous snapshot atomically, so a kill mid-write leaves the
+        LAST complete snapshot, never a torn one."""
+        import jax
+
+        host_carry = jax.tree_util.tree_map(np.asarray, carry)
+        atomic_pickle_dump({
+            "magic": self.MAGIC, "version": self.VERSION,
+            "fingerprint": fingerprint, "cursor": int(cursor),
+            "carry": host_carry, "quarantine": quarantine_state,
+        }, self.path)
+        record_event("checkpoint_save", path=self.path, cursor=int(cursor))
+
+    def load(self, fingerprint: str) -> Optional[Dict[str, Any]]:
+        """The last snapshot, or None when none exists. Corrupt files
+        raise :class:`CheckpointCorruptError`; a fingerprint mismatch
+        raises :class:`CheckpointMismatchError` (never silently refits
+        or resumes wrong state)."""
+        if not os.path.exists(self.path):
+            return None
+        try:
+            with open(self.path, "rb") as f:
+                blob = pickle.load(f)
+        except Exception as exc:
+            raise CheckpointCorruptError(
+                f"stream checkpoint {self.path!r} is truncated or "
+                f"corrupt ({type(exc).__name__}: {exc}); delete it to "
+                "start the fit from scratch") from exc
+        if not (isinstance(blob, dict) and blob.get("magic") == self.MAGIC):
+            raise CheckpointCorruptError(
+                f"{self.path!r} is not a keystone stream checkpoint "
+                "(missing format header); delete it to start over")
+        if blob.get("version") != self.VERSION:
+            raise CheckpointCorruptError(
+                f"stream checkpoint {self.path!r} has format version "
+                f"{blob.get('version')!r}, this build reads "
+                f"{self.VERSION}; delete it to start over")
+        if blob.get("fingerprint") != fingerprint:
+            raise CheckpointMismatchError(
+                f"stream checkpoint {self.path!r} was written by a "
+                f"different fit configuration (fingerprint "
+                f"{blob.get('fingerprint')!r} != {fingerprint!r}); "
+                "refusing to resume. Delete the checkpoint directory "
+                "to start over, or restore the original estimator/"
+                "chunk-size/labels configuration")
+        record_event("checkpoint_restore", path=self.path,
+                     cursor=int(blob["cursor"]))
+        return blob
+
+    def clear(self) -> None:
+        """Remove the snapshot after a successful finalize (a stale
+        snapshot must never seed an unrelated later fit)."""
+        try:
+            os.remove(self.path)
+        except FileNotFoundError:
+            pass

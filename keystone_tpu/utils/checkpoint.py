@@ -18,25 +18,72 @@
 """
 from __future__ import annotations
 
+import os
 import pickle
 from typing import Any, Dict
 
 import numpy as np
 
+from ..resilience.stream_checkpoint import (
+    CheckpointCorruptError,
+    atomic_pickle_dump,
+)
 from ..workflow.env import PipelineEnv
 from ..workflow.expression import TransformerExpression
 from ..workflow.pipeline import FittedPipeline
 
+#: Format header carried by every artifact this module writes: a loader
+#: can tell "truncated garbage" from "a checkpoint of the wrong kind"
+#: from "a future format this build cannot read" — each with a clear
+#: error instead of a bare pickle traceback. Headerless files (written
+#: before the header existed) still load.
+_FORMAT = "keystone-checkpoint"
+_VERSION = 1
+
+
+#: the one atomic-write implementation (resilience.stream_checkpoint)
+_atomic_dump = atomic_pickle_dump
+
+
+def _load_checked(path: str, kind: str) -> Any:
+    """Read one artifact back, validating the format header. Corrupt or
+    truncated files raise :class:`CheckpointCorruptError` naming the
+    path; legacy headerless pickles pass through unchanged."""
+    try:
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+    except FileNotFoundError:
+        raise
+    except Exception as exc:
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r} is truncated or corrupt "
+            f"({type(exc).__name__}: {exc}); re-save it or delete the "
+            "file") from exc
+    if isinstance(blob, dict) and blob.get("format") == _FORMAT:
+        if blob.get("version") != _VERSION:
+            raise CheckpointCorruptError(
+                f"checkpoint {path!r} has format version "
+                f"{blob.get('version')!r}; this build reads version "
+                f"{_VERSION}")
+        if blob.get("kind") != kind:
+            raise CheckpointCorruptError(
+                f"checkpoint {path!r} holds a {blob.get('kind')!r} "
+                f"artifact, not the requested {kind!r}")
+        return blob["payload"]
+    return blob  # pre-header artifact: accepted as-is
+
 
 def save_pipeline(pipeline: FittedPipeline, path: str) -> None:
-    with open(path, "wb") as f:
-        pickle.dump(pipeline, f)
+    _atomic_dump({"format": _FORMAT, "version": _VERSION,
+                  "kind": "pipeline", "payload": pipeline}, path)
 
 
 def load_pipeline(path: str) -> FittedPipeline:
-    with open(path, "rb") as f:
-        out = pickle.load(f)
-    assert isinstance(out, FittedPipeline), type(out)
+    out = _load_checked(path, "pipeline")
+    if not isinstance(out, FittedPipeline):
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r} does not hold a FittedPipeline "
+            f"(got {type(out).__name__})")
     return out
 
 
@@ -58,8 +105,8 @@ def save_state(path: str) -> int:
     for prefix, expr in state.items():
         if isinstance(expr, TransformerExpression) and expr.computed:
             out[prefix] = expr.get()
-    with open(path, "wb") as f:
-        pickle.dump(out, f)
+    _atomic_dump({"format": _FORMAT, "version": _VERSION,
+                  "kind": "state", "payload": out}, path)
     return len(out)
 
 
@@ -67,8 +114,11 @@ def load_state(path: str) -> int:
     """Merge persisted fitted transformers into the prefix table; returns
     the number of entries loaded. Pipelines whose prefixes match skip
     refitting (via SavedStateLoadRule)."""
-    with open(path, "rb") as f:
-        saved = pickle.load(f)
+    saved = _load_checked(path, "state")
+    if not isinstance(saved, dict):
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r} does not hold a prefix-state table "
+            f"(got {type(saved).__name__})")
     env = PipelineEnv.get_or_create()
     for prefix, transformer in saved.items():
         # wrap in a thunk: fitted transformers are themselves callable, so
@@ -144,8 +194,6 @@ class SolverCheckpoint:
         return d
 
     def save(self, key, pass_idx: int, models) -> None:
-        import os
-
         import jax
 
         # multi-host: every process runs the solver loop over the same
@@ -155,12 +203,9 @@ class SolverCheckpoint:
         # each other's in-flight file.
         if jax.process_index() != 0:
             return
-        tmp = f"{self.path}.tmp.{os.getpid()}"
-        with open(tmp, "wb") as f:
-            pickle.dump(
-                {"key": key, "pass": pass_idx,
-                 "models": [np.asarray(m) for m in models]}, f)
-        os.replace(tmp, self.path)
+        atomic_pickle_dump(
+            {"key": key, "pass": pass_idx,
+             "models": [np.asarray(m) for m in models]}, self.path)
 
     def clear(self) -> None:
         """Remove the checkpoint after a successful solve so a stale

@@ -17,7 +17,7 @@ from .transformer import Transformer
 
 
 class Estimator(EstimatorOperator):
-    def fit(self, data: Any) -> Transformer:
+    def fit(self, data: Any, **stream_opts: Any) -> Transformer:
         """Eagerly fit on a dataset (or raw arrays), returning the fitted
         transformer (reference ``Estimator.fit``, Estimator.scala:20).
 
@@ -25,15 +25,24 @@ class Estimator(EstimatorOperator):
         routes through the accumulate/finalize protocol
         (``parallel.streaming.fit_streaming``): the fit consumes one
         bounded chunk at a time and never materializes the dataset in
-        HBM. Non-streamable estimators raise a clear error (flagged
-        statically as ``non-streamable-fit`` by the check CLI)."""
+        HBM. ``stream_opts`` (``hbm_budget``, ``checkpoint_dir``,
+        ``checkpoint_every``, ``quarantine`` — see ``fit_streaming``)
+        apply only to streamed fits. Non-streamable estimators raise a
+        clear error (flagged statically as ``non-streamable-fit`` by
+        the check CLI)."""
         from ..parallel.streaming import StreamingDataset, fit_streaming
         from .pipeline import PipelineDataset
 
         if isinstance(data, PipelineDataset):
             data = data.get()
         if isinstance(data, StreamingDataset):
-            return fit_streaming(self, data)
+            return fit_streaming(self, data, **stream_opts)
+        if stream_opts:
+            raise TypeError(
+                f"{self.label()}: streaming fit options "
+                f"{sorted(stream_opts)} require a StreamingDataset "
+                "input (resident fits have no chunk loop to "
+                "checkpoint or budget)")
         return self._fit(as_dataset(data))
 
     def _fit(self, ds: Dataset) -> Transformer:

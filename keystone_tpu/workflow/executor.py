@@ -82,7 +82,7 @@ def _block_on_device(value) -> None:
                     leaf for leaf in jax.tree_util.tree_leaves(attrs)
                     if hasattr(leaf, "block_until_ready")
                 ])
-    except Exception:
+    except (TypeError, ValueError, AttributeError, RuntimeError):
         pass  # host values: nothing to block on
 
 

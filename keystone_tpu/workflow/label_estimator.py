@@ -17,10 +17,15 @@ from .transformer import Transformer
 
 
 class LabelEstimator(EstimatorOperator):
-    def fit(self, data: Any, labels: Any) -> Transformer:
+    def fit(self, data: Any, labels: Any,
+            **stream_opts: Any) -> Transformer:
         """Eager fit; a streamed ``data`` routes through the
         accumulate/finalize protocol (``labels`` may be an aligned
-        StreamingDataset or a resident dataset sliced chunk-wise)."""
+        StreamingDataset or a resident dataset sliced chunk-wise).
+        ``stream_opts`` (``hbm_budget``, ``checkpoint_dir``,
+        ``checkpoint_every``, ``quarantine`` — see
+        ``parallel.streaming.fit_streaming``) apply only to streamed
+        fits."""
         from ..parallel.streaming import StreamingDataset, fit_streaming
         from .pipeline import PipelineDataset
 
@@ -29,13 +34,19 @@ class LabelEstimator(EstimatorOperator):
         if isinstance(labels, PipelineDataset):
             labels = labels.get()
         if isinstance(data, StreamingDataset):
-            return fit_streaming(self, data, labels)
+            return fit_streaming(self, data, labels, **stream_opts)
         if isinstance(labels, StreamingDataset):
             raise TypeError(
                 f"{self.label()}: labels are a StreamingDataset but the "
                 "data is resident — the chunk loop is driven by the DATA "
                 "stream. Stream the data too (chunk sizes must align), or "
                 "materialize() the labels (they are k-wide, usually tiny).")
+        if stream_opts:
+            raise TypeError(
+                f"{self.label()}: streaming fit options "
+                f"{sorted(stream_opts)} require a StreamingDataset "
+                "input (resident fits have no chunk loop to "
+                "checkpoint or budget)")
         return self._fit(as_dataset(data), as_dataset(labels))
 
     def _fit(self, ds: Dataset, labels: Dataset) -> Transformer:

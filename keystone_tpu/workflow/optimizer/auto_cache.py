@@ -252,8 +252,9 @@ def _device_mem_budget() -> float:
         if stats and "bytes_limit" in stats:
             free = stats["bytes_limit"] - stats.get("bytes_in_use", 0)
             return 0.75 * free
-    except Exception:
-        pass
+    except (ImportError, RuntimeError, IndexError, AttributeError,
+            KeyError, TypeError):
+        pass  # no backend / no devices / no memory stats on this platform
     return 0.75 * 8 * (1 << 30)  # assume 8 GiB HBM per chip otherwise
 
 

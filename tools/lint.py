@@ -14,6 +14,12 @@ Three layers, all hermetic (no data, no device buffers):
      pass a string-literal tag — a computed tag makes the global jit
      cache key unstable across sessions, so warm-executable reuse
      silently stops working.
+   - ``swallow-all-handler`` (ingest + workflow code only —
+     ``loaders/``, ``parallel/``, ``workflow/``): no bare ``except:``
+     and no silent ``except Exception: pass`` — exactly where "skip
+     the error and keep going" becomes silent data loss. Tolerating a
+     failure there goes through the resilience layer (RetryPolicy /
+     Quarantine), which accounts for it.
 3. **ruff** (when installed): style/correctness pass over the package.
    Skipped with a notice when the container lacks ruff — layers 1–2
    are the required gate.
@@ -90,6 +96,11 @@ def _unstable_jit_tags(tree: ast.Module):
 
 
 def run_ast_rules() -> int:
+    from keystone_tpu.analysis.diagnostics import (
+        SWALLOW_ALL_SCOPES,
+        swallow_all_handlers,
+    )
+
     failures = 0
     for path in sorted(PKG.rglob("*.py")):
         rel = path.relative_to(REPO)
@@ -110,6 +121,14 @@ def run_ast_rules() -> int:
                   "tag must be a string literal (computed tags break "
                   "warm-executable reuse across sessions)")
             failures += 1
+        if rel.parts[:1] == ("keystone_tpu",) and \
+                rel.parts[1] in SWALLOW_ALL_SCOPES:
+            for lineno, what in swallow_all_handlers(tree):
+                print(f"{rel}:{lineno}: swallow-all-handler: {what} in "
+                      "ingest/workflow code silently loses failures; "
+                      "narrow the exception type, or route it through "
+                      "the resilience layer (RetryPolicy/Quarantine)")
+                failures += 1
     return failures
 
 
