@@ -281,25 +281,39 @@ def _timed_median(work, *, setup=None, reps=None, target_window=2.0,
                  "timing_spread": round((max(times) - min(times)) / med, 3)}
 
 
-def _ingest_stall_probe(n_chunks_per_run):
+def _ingest_stall_probe(n_chunks_per_run, n_images_per_run=None):
     """Snapshot the streaming metrics and return ``share(dt)``: the
     per-run ingest stall as a fraction of ``dt`` seconds. The metrics
     accumulate across every invocation ``_timed_median`` makes
     (estimation calls + window reps), so the stall delta is normalized
     by the observed run count before dividing — the ONE home of that
-    subtlety, shared by the loader and streamed-e2e sections."""
+    subtlety, shared by the loader and streamed-e2e sections.
+
+    ``share.h2d_bytes_per_image()`` reads the ``streaming.h2d_bytes``
+    counter delta the same normalized way: actual wire bytes shipped
+    host->device per image, the number that shows dtype-on-the-wire
+    working (uint8 sources ~1/4 of an f32 wire) next to the wall-time
+    keys."""
     from keystone_tpu.observability import MetricsRegistry
 
     reg = MetricsRegistry.get_or_create()
     stall_h = reg.histogram("streaming.ingest_stall_s")
     chunks_c = reg.counter("streaming.chunks_total")
-    stall0, chunks0 = stall_h.total, chunks_c.value
+    h2d_c = reg.counter("streaming.h2d_bytes")
+    stall0, chunks0, h2d0 = stall_h.total, chunks_c.value, h2d_c.value
+
+    def _runs():
+        return max(1.0, (chunks_c.value - chunks0) / n_chunks_per_run)
 
     def share(dt):
-        runs = max(1.0, (chunks_c.value - chunks0) / n_chunks_per_run)
         return round(min(
-            ((stall_h.total - stall0) / runs) / max(dt, 1e-9), 1.0), 3)
+            ((stall_h.total - stall0) / _runs()) / max(dt, 1e-9), 1.0), 3)
 
+    def h2d_bytes_per_image():
+        per_run = (h2d_c.value - h2d0) / _runs()
+        return round(per_run / max(n_images_per_run or 1, 1), 1)
+
+    share.h2d_bytes_per_image = h2d_bytes_per_image
     return share
 
 
@@ -1283,7 +1297,7 @@ def loader_bench():
         return len(outs)
 
     run_streamed()  # warm (compiles are shared with the serial path)
-    share = _ingest_stall_probe(-(-n_imgs // chunk))
+    share = _ingest_stall_probe(-(-n_imgs // chunk), n_imgs)
     s_dt, s_ev = _timed_median(run_streamed)
     s_per_sec = n_imgs / s_dt
     _emit("tar_loader_sift_streamed_images_per_sec", round(s_per_sec, 1),
@@ -1291,6 +1305,7 @@ def loader_bench():
           prefetch_depth=depth,
           speedup_vs_serial=round(e2e_dt / s_dt, 3),
           ingest_stall_share=share(s_dt),
+          h2d_bytes_per_image=share.h2d_bytes_per_image(),
           image_side=side, n_images=n_imgs, **s_ev)
 
 
@@ -1389,7 +1404,7 @@ def streamed_e2e_bench():
     fit_and_predict()  # warm: one compile per chunk shape, then zero
 
     share = _ingest_stall_probe(
-        -(-n_train // chunk) + -(-n_test // chunk))
+        -(-n_train // chunk) + -(-n_test // chunk), n_train + n_test)
     dt, ev = _timed_median(fit_and_predict)
 
     per_chip = (n_train + n_test) / dt / n_dev
@@ -1400,7 +1415,8 @@ def streamed_e2e_bench():
           hbm_budget_mib=round(budget / (1 << 20), 2),
           peak_stream_mib=round(result["peak_stream"] / (1 << 20), 2),
           gram_carry_mib=round((F * F + F * 10) * 4 / (1 << 20), 2),
-          ingest_stall_share=share(dt), **ev)
+          ingest_stall_share=share(dt),
+          h2d_bytes_per_image=share.h2d_bytes_per_image(), **ev)
 
 
 def _section_cleanup():

@@ -184,6 +184,89 @@ def host_coercions_in_funcdef(fdef) -> List[tuple]:
 #: sanctioned way to tolerate failures there. tools/lint.py enforces.
 SWALLOW_ALL_SCOPES = ("loaders", "parallel", "workflow")
 
+#: directories where the cast-before-transfer rule applies: loader and
+#: device-staging code is where a host-side float widening right before
+#: ``device_put`` quietly ships 4x the bytes the source held (the
+#: pattern the ``StreamingDataset`` wire-dtype machinery removes).
+CAST_BEFORE_TRANSFER_SCOPES = ("loaders", "parallel")
+
+#: dtype spellings that count as a float widening target
+_FLOAT_DTYPE_NAMES = {
+    "float16", "float32", "float64", "bfloat16", "float_", "double",
+}
+
+
+def _is_float_dtype_expr(node) -> bool:
+    """Syntactically a float dtype: ``np.float32`` / ``jnp.float32`` /
+    the builtin ``float`` / a ``"float32"``-style string literal."""
+    if isinstance(node, ast.Attribute):
+        return node.attr in _FLOAT_DTYPE_NAMES
+    if isinstance(node, ast.Name):
+        return node.id in _FLOAT_DTYPE_NAMES or node.id == "float"
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value in _FLOAT_DTYPE_NAMES
+    return False
+
+
+def _own_scope_nodes(fdef):
+    """Walk a function body WITHOUT descending into nested function
+    definitions (each nested def is linted as its own scope), so a cast
+    in one scope and a device_put in an unrelated closure are never
+    conflated into a false co-occurrence. The tradeoff — a split
+    pattern (cast in the outer body, put in a helper closure) is not
+    flagged across the boundary — is the right default for a CI gate:
+    false positives break the gate on legitimate code."""
+    stack = list(fdef.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue  # nested def: its own scope, scanned separately
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def float_casts_before_transfer(tree) -> List[tuple]:
+    """``(lineno, description)`` for host float-widening casts sitting
+    in the same function scope as a ``device_put`` — the
+    cast-before-transfer pattern: widening uint8 records to float on
+    the HOST and then shipping the wide copy quadruples the wire bytes.
+    Detected syntactically (dtypes are not statically known) as the
+    co-occurrence, per function scope (nested defs are separate
+    scopes), of (a) any ``*.device_put(...)`` call and (b) an
+    ``.astype(<float dtype>)`` (positional or ``dtype=`` keyword) or
+    ``np.asarray/array/stack/ascontiguousarray(..., dtype=<float
+    dtype>)`` call. Fix: ship the source dtype and cast on device —
+    ``StreamingDataset``'s ``wire_dtype`` / ``compute_dtype`` do
+    exactly this (README 'Streaming ingest')."""
+    hits = []
+    for fdef in ast.walk(tree):
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        puts = False
+        casts = []
+        for node in _own_scope_nodes(fdef):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            if f.attr == "device_put":
+                puts = True
+            elif f.attr == "astype":
+                dtype_args = list(node.args[:1]) + [
+                    kw.value for kw in node.keywords if kw.arg == "dtype"]
+                if any(_is_float_dtype_expr(a) for a in dtype_args):
+                    casts.append((node.lineno, "astype(float)"))
+            elif f.attr in ("asarray", "array", "stack",
+                            "ascontiguousarray"):
+                for kw in node.keywords:
+                    if kw.arg == "dtype" and _is_float_dtype_expr(kw.value):
+                        casts.append(
+                            (node.lineno, f"{f.attr}(dtype=float)"))
+        if puts and casts:
+            hits.extend(casts)
+    return sorted(set(hits))
+
 
 def swallow_all_handlers(tree) -> List[tuple]:
     """``(lineno, description)`` for exception handlers that swallow

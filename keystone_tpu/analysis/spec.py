@@ -82,6 +82,13 @@ class DatasetSpec(AbstractValue):
     items arrive as bounded device chunks, ``n`` may be unknown (None),
     and only estimators implementing accumulate/finalize can fit on it
     (the ``non-streamable-fit`` lint enforces this statically).
+
+    ``wire_dtype`` (streams only) names the dtype deliberately shipped
+    on the host->device wire when it is narrower than the compute dtype
+    the ``element`` describes (e.g. ``"uint8"`` for image chunks cast
+    back to f32 on device). The element always reports what CONSUMERS
+    see post-cast, so narrowness-on-the-wire is visible to tooling
+    without ever tripping the ``dtype-narrowing`` lint.
     """
 
     element: Any
@@ -89,9 +96,12 @@ class DatasetSpec(AbstractValue):
     host: bool = False
     sparsity: Optional[float] = None
     streaming: bool = False
+    wire_dtype: Optional[str] = None
 
     def __repr__(self) -> str:
         flag = ", streaming" if self.streaming else ""
+        if self.wire_dtype is not None:
+            flag += f", wire={self.wire_dtype}"
         return (f"DatasetSpec(n={self.n}, "
                 f"element={format_element(self.element)}{flag})")
 
@@ -179,14 +189,16 @@ def dataset_spec(ds: Dataset) -> AbstractValue:
 
     if isinstance(ds, StreamingDataset):
         # exact per-chunk element shape when the source can describe it
-        # without being consumed; n is known-or-None by construction
+        # without being consumed (post-cast: what consumers see); n is
+        # known-or-None by construction; a deliberately narrow wire
+        # rides separately so it never reads as dtype narrowing
         element = ds.element()
         if element is None:
             element = Unknown("opaque stream source")
         return DatasetSpec(
             element, n=ds.n, host=False,
             sparsity=None if element_has_unknown(element) else 1.0,
-            streaming=True)
+            streaming=True, wire_dtype=ds.wire_dtype_name())
     if isinstance(ds, HostDataset):
         items = ds.items
         if not items:

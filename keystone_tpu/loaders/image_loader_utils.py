@@ -52,15 +52,19 @@ class MultiLabeledImage:
     filename: Optional[str] = None
 
 
-def decode_image(data: bytes) -> Optional[np.ndarray]:
-    """JPEG/PNG bytes -> float32 (H, W, C) in [0, 255]; None if undecodable
-    (the reference's loadImage returns Option)."""
+def decode_image(data: bytes,
+                 dtype: np.dtype = np.float32) -> Optional[np.ndarray]:
+    """JPEG/PNG bytes -> ``dtype`` (H, W, C) in [0, 255]; None if
+    undecodable (the reference's loadImage returns Option). The decoder
+    works in uint8 underneath, so ``dtype=np.uint8`` is lossless and
+    skips the widening copy — the streamed path decodes uint8 and lets
+    the device cast (4x fewer host->device wire bytes)."""
     try:
         from PIL import Image as PILImage
 
         img = PILImage.open(io.BytesIO(data))
         img = img.convert("RGB")
-        return np.asarray(img, dtype=np.float32)
+        return np.asarray(img, dtype=dtype)
     except Exception:
         return None
 
@@ -140,7 +144,8 @@ def _iter_tar_entries(
 
 
 def _decode_with_retry(raw: bytes, context: str,
-                       retry: Optional[RetryPolicy]):
+                       retry: Optional[RetryPolicy],
+                       decode_dtype: np.dtype = np.float32):
     """One record's decode behind the retry policy; the
     ``ingest.decode`` fault site lives inside the attempt so injected
     transient faults exercise the real retry path. Returns None for
@@ -148,7 +153,7 @@ def _decode_with_retry(raw: bytes, context: str,
 
     def attempt():
         inject("ingest.decode", context=context)
-        return decode_image(raw)
+        return decode_image(raw, dtype=decode_dtype)
 
     if retry is None:
         return attempt()
@@ -182,6 +187,7 @@ def _pooled_decoded(
     on_archive_end: Optional[Callable[[str, Optional[Exception], int], None]] = None,
     quarantine: Optional[Quarantine] = None,
     retry_policy: Optional[RetryPolicy] = None,
+    decode_dtype: np.dtype = np.float32,
 ) -> Iterator[tuple]:
     """Yield ``(entry_name, decoded_image)`` from every archive, decode
     on a thread pool behind a bounded in-flight window — the ONE home of
@@ -228,7 +234,8 @@ def _pooled_decoded(
                                                    retry=retry_policy):
                     ctx = f"{path}::{name}"
                     pending.append((name, ctx, pool.submit(
-                        _decode_with_retry, raw, ctx, retry_policy)))
+                        _decode_with_retry, raw, ctx, retry_policy,
+                        decode_dtype)))
                     for item in drain(window):
                         n_from_archive += 1
                         yield item
@@ -250,6 +257,7 @@ def iter_decoded_chunks(
     name_prefix: Optional[str] = None,
     quarantine: Optional[Quarantine] = None,
     retry_policy: Optional[RetryPolicy] = None,
+    decode_dtype: np.dtype = np.float32,
 ) -> Iterator[List[tuple]]:
     """Stream archives as chunks of ``chunk_size`` decoded images.
 
@@ -273,7 +281,8 @@ def iter_decoded_chunks(
     out: list = []
     for item in _pooled_decoded(archive_paths, name_prefix, on_end,
                                 quarantine=quarantine,
-                                retry_policy=retry_policy):
+                                retry_policy=retry_policy,
+                                decode_dtype=decode_dtype):
         out.append(item)
         while len(out) >= chunk_size:
             yield out[:chunk_size]
@@ -301,6 +310,7 @@ def stream_tar_images(
     n: Optional[int] = None,
     quarantine: Optional[Quarantine] = None,
     retry_policy: Optional[RetryPolicy] = None,
+    decode_dtype: Optional[np.dtype] = None,
     **stream_kw,
 ):
     """tar archives -> threaded decode pool -> double-buffered device
@@ -308,8 +318,18 @@ def stream_tar_images(
     ``parallel.streaming.StreamingDataset``, so chunk *i+1*'s decode AND
     upload run behind the prefetch buffer while chunk *i* computes.
 
+    Dtype on the wire: with no ``prepare`` hook, images are decoded
+    UINT8 (the decoder's native width — lossless for [0, 255] pixels)
+    and shipped uint8 across the host->device link, 1/4 the wire bytes
+    of the old f32 staging; consumers still see float32 [0, 255] chunks
+    because the stream's ``compute_dtype`` casts on device. A custom
+    ``prepare`` keeps the documented float32 decode (its output dtype
+    is whatever it returns — return uint8 and the wire stays narrow);
+    ``decode_dtype`` overrides the decode width either way, and
+    ``wire_dtype=``/``compute_dtype=`` pass through to the stream.
+
     ``prepare`` maps one decoded chunk (a list of ``(entry_name,
-    float32 image)``) to a stacked fixed-shape host array — the hook for
+    image)`` pairs) to a stacked fixed-shape host array — the hook for
     resize/crop/grayscale of ragged archive images; the default stacks
     as-is (uniform-size archives). ``n`` is the total image count when
     known (streams from unindexed tars leave it None; a completed pass
@@ -325,8 +345,17 @@ def stream_tar_images(
     from ..parallel.streaming import StreamingDataset
 
     if prepare is None:
+        if decode_dtype is None:
+            # uint8 on the wire, f32 on device: the default pipeline's
+            # consumers keep seeing float32 [0, 255] images while the
+            # transfer moves 1/4 the bytes
+            decode_dtype = np.uint8
+            stream_kw.setdefault("compute_dtype", np.float32)
+
         def prepare(batch):
             return np.stack([img for _, img in batch])
+    elif decode_dtype is None:
+        decode_dtype = np.float32  # documented prepare() input contract
 
     tag = f"tar:{archive_paths[0]}" if archive_paths else "tar"
     if quarantine is None:
@@ -337,7 +366,8 @@ def stream_tar_images(
     def factory():
         for batch in iter_decoded_chunks(
                 archive_paths, chunk_size, name_prefix,
-                quarantine=quarantine, retry_policy=retry_policy):
+                quarantine=quarantine, retry_policy=retry_policy,
+                decode_dtype=decode_dtype):
             yield prepare(batch)
 
     return StreamingDataset.from_chunks(

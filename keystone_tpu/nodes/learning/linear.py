@@ -17,6 +17,7 @@ import numpy as np
 
 from ...ops import linalg
 from ...parallel.dataset import ensure_array, ArrayDataset, Dataset
+from ...utils.donation import donating_jit
 from ...workflow.label_estimator import LabelEstimator
 from ...workflow.transformer import Transformer
 from ..stats import StandardScalerModel
@@ -282,8 +283,7 @@ def _affine_params(W, mean, inv_std, b):
 # pure sum — chunk order cannot change the result beyond f32 rounding.
 
 
-@jax.jit
-def _gram_carry_update(G, C, sx, sy, X, Y):
+def _gram_carry_update_impl(G, C, sx, sy, X, Y):
     from ...ops.pallas_kernels import gram_cross
 
     X = X.astype(jnp.float32)
@@ -291,6 +291,18 @@ def _gram_carry_update(G, C, sx, sy, X, Y):
     g, c = gram_cross(X, Y)  # fused: one pass over the chunk's rows
     return (G + g, C + c,
             sx + jnp.sum(X, axis=0), sy + jnp.sum(Y, axis=0))
+
+
+#: The per-chunk carry update DONATES the carry buffers (G, C, sx, sy):
+#: XLA writes the updated carry into the old carry's HBM instead of
+#: allocating a fresh (d, d) + (d, k) pair per chunk — a streamed fit
+#: holds ONE carry, with zero per-chunk allocator traffic. The chunk
+#: arrays (X, Y) are NOT donated: the prefetch buffer still owns them.
+#: Callers must treat the passed-in carry as dead after the call
+#: (``fit_streaming``'s loop reassigns immediately, and checkpointing
+#: copies the carry to host BEFORE the next accumulate donates it).
+_gram_carry_update = donating_jit(
+    _gram_carry_update_impl, donate_argnums=(0, 1, 2, 3))
 
 
 def accumulate_gram_carry(carry, chunk, labels):
@@ -320,8 +332,7 @@ def accumulate_gram_carry(carry, chunk, labels):
     return (G, C, sx, sy, n + chunk.n)
 
 
-@jax.jit
-def _finalize_normal_equations(G, C, sx, sy, n, lam):
+def _finalize_normal_equations_impl(G, C, sx, sy, n, lam):
     with linalg.solver_precision():
         x_mean = sx / n
         y_mean = sy / n
@@ -330,8 +341,16 @@ def _finalize_normal_equations(G, C, sx, sy, n, lam):
         return x_mean, y_mean, linalg.ridge_cho_solve(Gc, Cc, lam)
 
 
-@functools.partial(jax.jit, static_argnames=("bounds", "num_iter"))
-def _gram_bcd(G, C, sx, sy, n, lam, bounds, num_iter):
+#: finalize consumes the carry: donate the pieces with a
+#: SHAPE-COMPATIBLE output — C (d,k) -> W, sx -> x_mean, sy -> y_mean.
+#: G (d,d) matches no output, so donating it cannot be honored and
+#: would only emit jax's donated-buffer-not-usable warning per compile
+#: on the backends where donation is real.
+_finalize_normal_equations = donating_jit(
+    _finalize_normal_equations_impl, donate_argnums=(1, 2, 3))
+
+
+def _gram_bcd_impl(G, C, sx, sy, n, lam, bounds, num_iter):
     """Block coordinate descent driven entirely from the accumulated
     Gram/cross carry: the update
 
@@ -368,6 +387,16 @@ def _gram_bcd(G, C, sx, sy, n, lam, bounds, num_iter):
                     rhs, ok=oks[i])
                 W = W.at[lo:hi].set(Wi)
         return tuple(W[lo:hi] for lo, hi in bounds), x_mean, y_mean
+
+
+#: the Gram-form BCD finalize donates the carry pieces XLA can actually
+#: reuse: sx -> x_mean, sy -> y_mean. G (d,d) and C (d,k) match no
+#: output (the weights come back as per-block slices), so donating them
+#: would only trigger the not-usable warning — see
+#: ``_finalize_normal_equations``.
+_gram_bcd = donating_jit(
+    _gram_bcd_impl, donate_argnums=(2, 3),
+    static_argnames=("bounds", "num_iter"))
 
 
 @jax.jit

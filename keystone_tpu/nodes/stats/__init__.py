@@ -226,12 +226,27 @@ class StandardScaler(Estimator):
 
 @jax.jit
 def _moments(X):
+    # promote INTEGER chunks to f32 (a uint8-wire chunk fed straight to
+    # the scaler must not wrap its X*X mod 256); float inputs keep
+    # their width — f64 moments stay f64 under jax_enable_x64
+    if not jnp.issubdtype(X.dtype, jnp.floating):
+        X = X.astype(jnp.float32)
     return jnp.sum(X, axis=0), jnp.sum(X * X, axis=0)
 
 
-@jax.jit
-def _accum_moments(S, SQ, X):
+def _accum_moments_impl(S, SQ, X):
+    if not jnp.issubdtype(X.dtype, jnp.floating):
+        X = X.astype(jnp.float32)
     return S + jnp.sum(X, axis=0), SQ + jnp.sum(X * X, axis=0)
+
+
+from ...utils.donation import donating_jit  # noqa: E402
+
+#: the streamed moment carry donates (S, SQ): the per-chunk update
+#: writes into the old moment buffers instead of reallocating them —
+#: same in-place discipline as the least-squares Gram carry
+#: (``nodes.learning.linear._gram_carry_update``)
+_accum_moments = donating_jit(_accum_moments_impl, donate_argnums=(0, 1))
 
 
 from ...workflow.transformer import HostTransformer  # noqa: E402

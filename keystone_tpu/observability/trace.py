@@ -123,7 +123,7 @@ class PipelineTrace:
         self.chunks: List[Dict[str, Any]] = []
         self.chunk_stats: Dict[str, float] = {
             "count": 0, "ingest_stall_s": 0.0, "nbytes": 0.0,
-            "occupancy_sum": 0.0}
+            "occupancy_sum": 0.0, "h2d_bytes": 0.0}
         #: resilience events (retries, quarantines, checkpoint
         #: saves/restores, watchdog trips, injected faults) — same
         #: bounded-tail-plus-exact-counts shape as ``chunks``
@@ -212,17 +212,23 @@ class PipelineTrace:
 
     def record_chunk(self, entry: Dict[str, Any]) -> None:
         """One streamed ingest chunk (``parallel.streaming``): source
-        tag, chunk index, true row count, device footprint, the time the
-        consumer stalled waiting for ingest, and the prefetch-buffer
-        occupancy at hand-off. The per-chunk stall attribution is the
-        evidence behind 'ingest overlaps compute' claims. Aggregates
-        are exact; raw entries keep only the most recent ``CHUNK_TAIL``
-        (an out-of-core fit can stream unboundedly many chunks)."""
+        tag, chunk index, true row count, device footprint (post-cast
+        working copy), the wire bytes actually shipped host->device
+        (``h2d_bytes`` — narrower than ``nbytes`` when a wire dtype is
+        in play), stage-lane occupancy (``stage_lanes`` per-shard H2D
+        lanes / ``stage_s`` host stage wall), the time the consumer
+        stalled waiting for ingest, and the prefetch-buffer occupancy at
+        hand-off. The per-chunk stall attribution is the evidence behind
+        'ingest overlaps compute' claims. Aggregates are exact; raw
+        entries keep only the most recent ``CHUNK_TAIL`` (an out-of-core
+        fit can stream unboundedly many chunks)."""
         s = self.chunk_stats
         s["count"] += 1
         s["ingest_stall_s"] += float(entry.get("ingest_stall_s", 0.0))
         s["nbytes"] += float(entry.get("nbytes", 0.0))
         s["occupancy_sum"] += float(entry.get("prefetch_occupancy", 0.0))
+        s["h2d_bytes"] = (s.get("h2d_bytes", 0.0)
+                          + float(entry.get("h2d_bytes", 0.0)))
         self.chunks.append(entry)
         if len(self.chunks) > self.CHUNK_TAIL:
             del self.chunks[: len(self.chunks) - self.CHUNK_TAIL]
@@ -306,6 +312,8 @@ class PipelineTrace:
                 "occupancy_sum": sum(
                     float(c.get("prefetch_occupancy", 0.0))
                     for c in tr.chunks),
+                "h2d_bytes": sum(
+                    float(c.get("h2d_bytes", 0.0)) for c in tr.chunks),
             }
         if stats is not None:
             tr.chunk_stats = dict(stats)
@@ -360,9 +368,11 @@ class PipelineTrace:
             count = int(self.chunk_stats["count"])
             stall = self.ingest_stall_s()
             share = (100.0 * stall / self.wall_s) if self.wall_s else 0.0
+            h2d = float(self.chunk_stats.get("h2d_bytes", 0.0))
             lines.append(
                 f"streamed ingest: {count} chunk(s), "
                 f"stall {stall:.3f}s ({share:.1f}% of wall), "
+                f"h2d {h2d / (1 << 20):.1f} MiB, "
                 f"mean prefetch occupancy "
                 f"{self.chunk_stats['occupancy_sum'] / count:.2f}")
         if self.resilience_stats:

@@ -184,7 +184,18 @@ def gram_fits_vmem(d: int, k: int) -> bool:
 
 def gram_cross(X: jax.Array, Y: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Fused (X^T X, X^T Y): Pallas on TPU when the footprint fits
-    VMEM; the einsum fallback keeps the solver precision policy."""
+    VMEM; the einsum fallback keeps the solver precision policy.
+
+    Integer inputs (uint8 wire-dtype chunks fed straight into a Gram
+    accumulate) are promoted to f32 up front in BOTH paths: the pallas
+    wrapper casts internally anyway, and the einsum fallback would
+    otherwise wrap the products mod 256. Inside the surrounding jit the
+    promotion fuses with the first read of each row tile — no separate
+    f32 copy of the chunk is materialized in HBM."""
+    if not jnp.issubdtype(X.dtype, jnp.floating):
+        X = X.astype(jnp.float32)
+    if not jnp.issubdtype(Y.dtype, jnp.floating):
+        Y = Y.astype(jnp.float32)
     if use_pallas() and gram_fits_vmem(X.shape[1], Y.shape[1]):
         return gram_cross_pallas(X, Y)
     from .linalg import SOLVER_PRECISION

@@ -30,7 +30,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import DATA_AXIS, batch_sharding, get_mesh, num_data_shards
+from .mesh import (
+    DATA_AXIS,
+    batch_sharding,
+    get_mesh,
+    h2d_pool,
+    num_data_shards,
+    shard_put,
+)
 
 
 def _pad_to(x: np.ndarray, rows: int) -> np.ndarray:
@@ -205,7 +212,12 @@ def _shard_pytree(data: Any, n: int, mesh: Mesh) -> Any:
         x = np.asarray(x)
         if x.shape[0] != n:
             raise ValueError(f"leading dim {x.shape[0]} != n={n}")
-        return jax.device_put(_pad_to(x, rows), sh)
+        # per-device shard slices fanned over the shared staging pool:
+        # the host slicing + H2D of shard k+1 overlaps the transfer of
+        # shard k (same discipline as the streaming prefetcher's
+        # _stage; mesh.shard_put falls back to one device_put when the
+        # pool is disabled or the mesh has a single data shard)
+        return shard_put(_pad_to(x, rows), sh, h2d_pool())
 
     return jax.tree_util.tree_map(put, data)
 
@@ -313,8 +325,10 @@ def device_nbytes(value: Any) -> float:
         return per * len(items)
     if is_streaming(value):
         # StreamingDataset: device residency is the bounded prefetch
-        # buffer plus the working chunk — NOT the logical dataset size.
-        # This is the number the out-of-core HBM-budget assertion reads.
+        # buffer (wire-dtype bytes) plus the working chunk at its
+        # POST-cast width — NOT the logical dataset size. This is the
+        # number the out-of-core HBM-budget assertion reads, and why a
+        # narrow wire never hides the f32 working copy from budgets.
         return float(value.buffered_nbytes())
     if isinstance(value, Dataset):
         # unknown future subclass: nominal per-item charge — never

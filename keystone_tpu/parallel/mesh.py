@@ -12,7 +12,9 @@ global SparkContext (``pipelines/*`` apps construct one ``sc`` per run).
 from __future__ import annotations
 
 import contextlib
+import os
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Sequence
 
 import jax
@@ -99,6 +101,82 @@ def batch_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
 def replicated_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
     mesh = mesh or get_mesh()
     return NamedSharding(mesh, P())
+
+
+#: shared per-shard H2D staging pool (lazy; every staging site —
+#: streaming prefetch, resident ArrayDataset construction — fans shard
+#: puts through ONE small pool: staging is transfer-bound, not
+#: cpu-bound, so a handful of lanes saturates the host link)
+_H2D_POOL: Optional[ThreadPoolExecutor] = None
+_H2D_POOL_LOCK = threading.Lock()
+
+
+def h2d_workers() -> int:
+    """Configured staging-lane count (``KEYSTONE_H2D_THREADS``, default
+    4; ``<=1`` disables per-shard staging). Raises a clear ValueError on
+    a malformed value — callers that later run on a background thread
+    (``StreamingDataset.__init__``) validate EAGERLY through this, so a
+    bad knob fails at construction, not as an opaque mid-fit
+    ``_SourceError`` from the prefetch thread (the KEYSTONE_MESH_MODEL
+    convention)."""
+    env = os.environ.get("KEYSTONE_H2D_THREADS")
+    if not env:
+        return 4
+    try:
+        return int(env)
+    except ValueError:
+        raise ValueError(
+            f"KEYSTONE_H2D_THREADS must be an integer, got {env!r}"
+        ) from None
+
+
+def h2d_pool() -> Optional[ThreadPoolExecutor]:
+    """The shared staging pool, or None when per-shard staging is
+    disabled (``KEYSTONE_H2D_THREADS=1`` / ``0`` forces the single
+    whole-array ``device_put``)."""
+    workers = h2d_workers()
+    if workers <= 1:
+        return None
+    global _H2D_POOL
+    with _H2D_POOL_LOCK:
+        if _H2D_POOL is None:
+            _H2D_POOL = ThreadPoolExecutor(
+                workers, thread_name_prefix="keystone-h2d")
+        return _H2D_POOL
+
+
+def shard_put(arr, sharding: NamedSharding, pool=None):
+    """Host array -> sharded device array via PER-DEVICE shard puts.
+
+    The whole-array ``jax.device_put(arr, sharding)`` serializes the
+    host->device copies of every shard behind one call; staging each
+    device's row slice from a thread ``pool`` overlaps the host-side
+    slicing + transfer of shard *k+1* with the in-flight transfer of
+    shard *k* (``jax.device_put`` is thread-safe and per-device
+    transfers are independent DMA streams). Slices are numpy VIEWS — no
+    host copy is made per shard — and the shards reassemble with
+    ``jax.make_array_from_single_device_arrays`` (replicated axes get
+    the same slice put to each replica, exactly what
+    ``devices_indices_map`` prescribes).
+
+    With ``pool=None`` or a single addressable device this is exactly
+    ``jax.device_put(arr, sharding)``.
+    """
+    import jax
+
+    if pool is None:
+        return jax.device_put(arr, sharding)
+    try:
+        dev_map = sharding.addressable_devices_indices_map(arr.shape)
+    except Exception:
+        return jax.device_put(arr, sharding)
+    if len(dev_map) <= 1:
+        return jax.device_put(arr, sharding)
+    futures = [pool.submit(jax.device_put, arr[idx], dev)
+               for dev, idx in dev_map.items()]
+    shards = [f.result() for f in futures]
+    return jax.make_array_from_single_device_arrays(
+        arr.shape, sharding, shards)
 
 
 def initialize_distributed(coordinator_address=None, num_processes=None,

@@ -27,12 +27,30 @@ device-resident before any fit. This module restores it:
   (device residency is bounded by ``device_nbytes(stream)``: the
   prefetch buffer plus one working chunk).
 
+* **dtype on the wire** — ``wire_dtype`` narrows each host chunk before
+  the transfer (uint8 image chunks stay uint8 across PCIe/ICI — 4x
+  fewer wire bytes than the f32 the math eventually wants) and a fused
+  on-device cast, prepended to the per-chunk transform chain by the
+  chunk executor, restores the compute dtype (``compute_dtype``, default
+  = the source's native dtype) before any consumer sees the chunk. The
+  residency ledger and ``hbm_budget`` asserts account for the post-cast
+  working copy, so narrowing the wire never hides HBM cost.
+
+* **parallel per-shard staging** — chunks reach the mesh as per-device
+  row-slice ``device_put``\\ s fanned out over a small thread pool
+  (:func:`~keystone_tpu.parallel.mesh.shard_put`), so the host-side
+  slicing + H2D of shard *k+1* overlaps the transfer of shard *k*;
+  full-size chunks skip the host pad copy entirely (only ragged tails
+  pad). ``KEYSTONE_H2D_THREADS=1`` forces the single whole-chunk put.
+
 Observability: consuming a stream feeds the process metrics
 (``streaming.ingest_stall_s`` histogram — time the device-side consumer
 waited on ingest; ``streaming.prefetch_occupancy`` gauge;
-``streaming.chunks_total`` counter) and, when a
+``streaming.chunks_total`` counter; ``streaming.h2d_bytes`` counter —
+actual bytes shipped host->device, post wire-narrowing) and, when a
 :class:`~keystone_tpu.observability.PipelineTrace` is active, per-chunk
-trace entries with ingest-stall attribution.
+trace entries with ingest-stall attribution plus stage-lane occupancy
+(``stage_lanes`` / ``stage_s`` / ``h2d_bytes``).
 
 Resilience (:mod:`keystone_tpu.resilience`): chunk staging retries
 transient failures under a :class:`RetryPolicy`; a producer watchdog
@@ -63,9 +81,80 @@ from ..resilience.retry import (
     default_retry_policy,
 )
 from .dataset import ArrayDataset, Dataset, HostDataset, _pad_to, device_nbytes
-from .mesh import batch_sharding, get_mesh, num_data_shards
+from .mesh import (
+    DATA_AXIS,
+    batch_sharding,
+    get_mesh,
+    h2d_pool as _h2d_pool,
+    h2d_workers,
+    num_data_shards,
+    shard_put,
+)
+
+
+def _dtype_policy(value: Any) -> Any:
+    """Normalize a wire/compute dtype policy: None, a single dtype
+    (np.dtype) applied to EVERY chunk leaf, or a pytree of
+    dtype-or-None matching the chunk structure (mixed trees — narrow
+    the image leaf, leave the integer-label leaf untouched). Pytree
+    policies are structure-validated lazily at first stage."""
+    if value is None:
+        return None
+    try:
+        return np.dtype(value)
+    except TypeError:
+        return value  # pytree policy
+
+
+def _policy_name(policy: Any) -> Optional[str]:
+    """Stable printable identity of a dtype policy (spec/fingerprint)."""
+    if policy is None:
+        return None
+    if isinstance(policy, np.dtype):
+        return policy.name
+    return repr(jax.tree_util.tree_map(
+        lambda d: None if d is None else np.dtype(d).name, policy,
+        is_leaf=lambda x: x is None))
+
+
+def _policy_leaves(policy: Any, treedef: Any, n: int) -> List:
+    """Per-chunk-leaf dtype targets for a normalized policy."""
+    if policy is None:
+        return [None] * n
+    if isinstance(policy, np.dtype):
+        return [policy] * n
+    leaves, td = jax.tree_util.tree_flatten(
+        policy, is_leaf=lambda x: x is None)
+    if td != treedef:
+        raise ValueError(
+            "wire/compute dtype policy structure does not match the "
+            f"chunk structure: policy {td}, chunk {treedef}. Pass a "
+            "single dtype to apply it to every leaf, or a pytree of "
+            "dtype-or-None mirroring the chunk pytree.")
+    return [None if l is None else np.dtype(l) for l in leaves]
 
 _DONE = object()
+
+#: (treedef, target dtypes) -> jitted wire->compute cast program: the
+#: cast depends only on chunk STRUCTURE and dtypes, so every stream of
+#: the same shape family (each refit builds a fresh StreamingDataset)
+#: shares one compiled program — a per-instance memo would recompile
+#: the cast on every refit, breaking the zero-recompile second epoch.
+#: Bounded LRU, same discipline as the dataset/transformer jit memos.
+from ..utils.lru import LruMemo  # noqa: E402
+
+_CAST_JIT_CACHE = LruMemo()
+
+
+def _cast_program(treedef, casts: Tuple) -> Callable:
+    key = ("wire_cast", treedef, tuple(dt.name for dt in casts))
+    fn = _CAST_JIT_CACHE.get(key)
+    if fn is None:
+        cast_tree = jax.tree_util.tree_unflatten(treedef, list(casts))
+        fn = jax.jit(lambda data: jax.tree_util.tree_map(
+            lambda x, t: x.astype(t), data, cast_tree))
+        _CAST_JIT_CACHE.put(key, fn)
+    return fn
 
 
 class _SourceError:
@@ -117,14 +206,22 @@ class _Residency:
             self.buffered += nbytes
             self.peak = max(self.peak, self.buffered + self.working)
 
-    def hand_off(self, it: _IterLedger, nbytes: float) -> None:
+    def hand_off(self, it: _IterLedger, staged_nbytes: float,
+                 work_nbytes: float, transient: float = 0.0) -> None:
+        """One chunk leaves the buffer and becomes the working chunk.
+        ``staged_nbytes`` is the wire-dtype footprint removed from the
+        buffer; ``work_nbytes`` the (possibly post-cast, wider) working
+        footprint; ``transient`` charges the brief co-existence of the
+        wire copy and the cast output against the peak."""
         with self._lock:
-            self.buffered -= nbytes
-            it.buffered -= nbytes
+            self.buffered -= staged_nbytes
+            it.buffered -= staged_nbytes
             # this iteration's previous working chunk is released;
             # other iterations' working chunks stay counted
-            self.working += nbytes - it.working
-            it.working = nbytes
+            self.working += work_nbytes - it.working
+            it.working = work_nbytes
+            self.peak = max(self.peak,
+                            self.buffered + self.working + transient)
 
     def close(self, it: _IterLedger) -> None:
         """Remove one finished iteration's residual contribution (its
@@ -155,6 +252,21 @@ class StreamingDataset(Dataset):
 
     ``n`` (the total item count) may be known or unknown (None); the
     static analyzer carries either through ``DatasetSpec``.
+
+    Dtype on the wire: ``wire_dtype`` (default None = ship each leaf in
+    its source dtype) narrows host chunks before the transfer — a uint8
+    wire moves 1/4 the bytes of an f32 one, and for decoded images
+    (integral values in [0, 255]) the narrowing is lossless.
+    ``compute_dtype`` (default None = restore each leaf's pre-wire
+    source dtype) is what consumers see: the chunk executor prepends ONE
+    fused on-device cast to the transform chain, compiled once per
+    chunk-structure family. Either may be a single dtype — applied to
+    EVERY leaf, so only safe when all leaves share a value range — or a
+    pytree of dtype-or-None mirroring the chunk structure, for mixed
+    trees where e.g. the image leaf narrows and the label leaf must not
+    (``wire_dtype={"x": np.uint8, "y": None}``). The residency ledger
+    and ``hbm_budget`` asserts charge the post-cast working copy, never
+    just the narrow wire bytes.
     """
 
     def __init__(self, chunk_source: Callable[[], Iterator[Any]],
@@ -164,6 +276,8 @@ class StreamingDataset(Dataset):
                  retry_policy: Optional[RetryPolicy] = None,
                  stall_timeout_s: Optional[float] = None,
                  quarantine: Any = None,
+                 wire_dtype: Any = None,
+                 compute_dtype: Any = None,
                  _transforms: Tuple[Callable, ...] = ()):
         if not callable(chunk_source):
             raise TypeError(
@@ -192,6 +306,17 @@ class StreamingDataset(Dataset):
         #: carried through ``map``/``map_chunks`` derivations so a
         #: featurized view still exposes the ingest accounting
         self.quarantine = quarantine
+        #: wire/compute dtype policy: None, a single np.dtype applied
+        #: to EVERY leaf (only safe when all leaves share a value
+        #: range, e.g. single-array chunks), or a pytree of
+        #: dtype-or-None mirroring the chunk structure for mixed trees
+        #: (narrow the image leaf, leave integer labels untouched)
+        self.wire_dtype = _dtype_policy(wire_dtype)
+        self.compute_dtype = _dtype_policy(compute_dtype)
+        # eager knob validation: the staging pool is first touched on
+        # the prefetch thread, where a malformed env var would surface
+        # as an opaque mid-fit source error
+        h2d_workers()
         self._chunk_source = chunk_source
         self._transforms = tuple(_transforms)
         # device-residency accounting (the out-of-core budget evidence):
@@ -210,6 +335,8 @@ class StreamingDataset(Dataset):
             retry_policy=self.retry_policy,
             stall_timeout_s=self.stall_timeout_s,
             quarantine=self.quarantine,
+            wire_dtype=self.wire_dtype,
+            compute_dtype=self.compute_dtype,
             _transforms=self._transforms + (transform,))
         out._residency = self._residency  # shared budget accounting
         return out
@@ -234,15 +361,27 @@ class StreamingDataset(Dataset):
         return self.n
 
     # -- staging -----------------------------------------------------------
-    def _stage(self, raw: Any) -> ArrayDataset:
-        """Pad a host chunk to ``chunk_size`` rows and put it on the mesh
-        (runs on the prefetch thread; jax device transfers are
-        thread-safe and async, so the upload overlaps the consumer's
-        compute). Transient staging failures retry under the stream's
-        :class:`RetryPolicy` (the ``ingest.stage`` fault-injection site
-        lives inside the attempt, so injected faults exercise this exact
-        path)."""
-        leaves = jax.tree_util.tree_leaves(raw)
+    def _stage(self, raw: Any) -> Tuple[ArrayDataset, dict]:
+        """Stage one host chunk onto the mesh (runs on the prefetch
+        thread; jax device transfers are thread-safe and async, so the
+        upload overlaps the consumer's compute):
+
+        * leaves are narrowed to ``wire_dtype`` on the host when set —
+          the only host copy a full-size, native-dtype chunk pays is
+          ZERO (no wire cast, no pad: only ragged tails pad);
+        * each leaf goes up as per-device shard slices fanned over the
+          shared staging pool (:func:`~..mesh.shard_put`), so shard
+          *k+1*'s host slice + H2D overlaps shard *k*'s transfer.
+
+        Returns ``(chunk, meta)`` where ``meta`` carries the wire bytes
+        actually shipped (``h2d_bytes``), the post-cast working
+        footprint (``work_nbytes``), the staging lane count/wall, and
+        the device-cast spec the consumer applies (None when the wire
+        dtype already IS the compute dtype). Transient staging failures
+        retry under the stream's :class:`RetryPolicy` (the
+        ``ingest.stage`` fault-injection site lives inside the
+        attempt)."""
+        leaves, treedef = jax.tree_util.tree_flatten(raw)
         if not leaves:
             raise ValueError("empty chunk from source")
         rows = int(np.shape(leaves[0])[0])
@@ -251,15 +390,79 @@ class StreamingDataset(Dataset):
                 f"source chunk has {rows} rows > chunk_size "
                 f"{self.chunk_size}")
 
-        def put() -> Any:
+        def put() -> Tuple[ArrayDataset, dict]:
             inject("ingest.stage", context=self.tag or "stream")
             sh = batch_sharding(self.mesh)
-            return jax.tree_util.tree_map(
-                lambda x: jax.device_put(
-                    _pad_to(np.asarray(x), self.chunk_size), sh), raw)
+            pool = _h2d_pool()
+            t0 = time.perf_counter()
+            staged: List[Any] = []
+            casts: List[np.dtype] = []
+            # bytes that actually cross the host->device link: a
+            # P('data') batch replicates each row shard across the
+            # non-data mesh axes, so every replica is its own transfer
+            replication = 1
+            for name, size in dict(self.mesh.shape).items():
+                if name != DATA_AXIS:
+                    replication *= int(size)
+            h2d_bytes = 0.0
+            work_nbytes = 0.0
+            needs_cast = False
+            wire_targets = _policy_leaves(self.wire_dtype, treedef,
+                                          len(leaves))
+            compute_targets = _policy_leaves(self.compute_dtype, treedef,
+                                             len(leaves))
+            for x, wire, compute in zip(leaves, wire_targets,
+                                        compute_targets):
+                arr = np.asarray(x)
+                source = arr.dtype
+                if wire is not None and source != wire:
+                    # narrow on host: the wire carries wire bytes
+                    arr = arr.astype(wire)
+                target = compute if compute is not None else source
+                if arr.shape[0] != self.chunk_size:
+                    # ragged tail: pad to the one shared chunk shape.
+                    # The explicit guard (rather than _pad_to's own
+                    # no-op short-circuit) keeps the full-chunk
+                    # zero-copy invariant ASSERTABLE — the regression
+                    # test monkeypatches _pad_to to prove full chunks
+                    # never reach it.
+                    arr = _pad_to(arr, self.chunk_size)
+                h2d_bytes += float(arr.nbytes) * replication
+                work_nbytes += float(arr.size * np.dtype(target).itemsize)
+                needs_cast = needs_cast or target != arr.dtype
+                staged.append(shard_put(arr, sh, pool))
+                casts.append(np.dtype(target))
+            lanes = 1
+            if pool is not None:
+                try:
+                    # actual staging concurrency: shard puts in flight
+                    # are bounded by BOTH the pool and the shard count
+                    lanes = max(1, min(h2d_workers(),
+                                       len(sh.addressable_devices)))
+                except Exception:
+                    lanes = 1
+            data = jax.tree_util.tree_unflatten(treedef, staged)
+            meta = {
+                "h2d_bytes": h2d_bytes,
+                "work_nbytes": work_nbytes,
+                "stage_lanes": lanes,
+                "stage_s": time.perf_counter() - t0,
+                "cast": (treedef, tuple(casts)) if needs_cast else None,
+            }
+            return (ArrayDataset(data, rows, self.mesh,
+                                 _already_sharded=True), meta)
 
-        data = self.retry_policy.call(put, site="ingest.stage")
-        return ArrayDataset(data, rows, self.mesh, _already_sharded=True)
+        return self.retry_policy.call(put, site="ingest.stage")
+
+    def _device_cast(self, ad: ArrayDataset, cast_spec: Tuple) -> ArrayDataset:
+        """The fused wire->compute cast the chunk executor prepends to
+        the transform chain: one GLOBALLY memoized program per chunk
+        structure/dtype family (``_cast_program``), so refits on fresh
+        streams of the same shape compile nothing."""
+        treedef, casts = cast_spec
+        fn = _cast_program(treedef, casts)
+        return ArrayDataset(fn(ad.data), ad.n, self.mesh,
+                            _already_sharded=True)
 
     def chunks(self) -> Iterator[ArrayDataset]:
         """Iterate device chunks with background prefetch. Each call
@@ -292,10 +495,12 @@ class StreamingDataset(Dataset):
                            abort=stop.is_set)
                     if not acquire_slot():
                         return
-                    ad = self._stage(raw)
+                    ad, meta = self._stage(raw)
                     nbytes = device_nbytes(ad)
+                    reg.counter("streaming.h2d_bytes").inc(
+                        meta["h2d_bytes"])
                     self._residency.stage(it_ledger, nbytes)
-                    q.put((ad, nbytes))
+                    q.put((ad, nbytes, meta))
                 q.put(_DONE)
             except BaseException as exc:  # surfaced on the consumer side
                 q.put(_SourceError(exc))
@@ -367,9 +572,14 @@ class StreamingDataset(Dataset):
                     break
                 if isinstance(item, _SourceError):
                     raise item.exc
-                ad, nbytes = item
+                ad, nbytes, meta = item
                 occupancy = q.qsize()
-                self._residency.hand_off(it_ledger, nbytes)
+                cast_spec = meta["cast"]
+                # working footprint is the POST-cast copy; during the
+                # cast the wire copy transiently co-exists with it
+                self._residency.hand_off(
+                    it_ledger, nbytes, meta["work_nbytes"],
+                    transient=nbytes if cast_spec is not None else 0.0)
                 # the chunk left the buffer: free its staging slot so
                 # the producer can stage the next one while this chunk
                 # computes — steady state is depth staged + 1 working
@@ -383,16 +593,27 @@ class StreamingDataset(Dataset):
                         "chunk": seen,
                         "n": ad.n,
                         "padded_n": ad.padded_n,
-                        "nbytes": nbytes,
+                        "nbytes": meta["work_nbytes"],
+                        "h2d_bytes": meta["h2d_bytes"],
+                        "stage_lanes": meta["stage_lanes"],
+                        "stage_s": meta["stage_s"],
                         "ingest_stall_s": stall,
                         "prefetch_occupancy": occupancy,
                     })
                 out = ad
+                chunk_rows = ad.n
+                if cast_spec is not None:
+                    # fused on-device cast to the compute dtype,
+                    # prepended to the transform chain; drop the wire
+                    # copy's reference so it frees as soon as the cast
+                    # completes (the ledger charges it only transiently)
+                    out = self._device_cast(out, cast_spec)
+                    ad = item = None
                 for f in self._transforms:
                     out = f(out)
                 yield out
                 seen += 1
-                rows_seen += ad.n
+                rows_seen += chunk_rows
         finally:
             stop.set()
             # join BEFORE closing the ledger: a producer mid-_stage()
@@ -417,8 +638,13 @@ class StreamingDataset(Dataset):
         return self._residency.live()
 
     def chunk_nbytes(self) -> float:
-        """Footprint of one staged chunk (the working-set unit of the
-        HBM budget: budget >= (prefetch_depth + 1) * chunk_nbytes)."""
+        """Footprint of one STAGED chunk at its wire width. With no
+        wire narrowing the budget unit is simply ``budget >=
+        (prefetch_depth + 1) * chunk_nbytes``; with a narrow wire the
+        working chunk is cast wider on device, so size budgets as
+        ``depth * chunk_nbytes + (compute_itemsize / wire_itemsize) *
+        chunk_nbytes`` plus one transient wire chunk during the cast
+        (e.g. u8 wire -> f32 compute: ``depth * w + 4w + w``)."""
         return self._residency.chunk_nbytes
 
     @property
@@ -433,11 +659,46 @@ class StreamingDataset(Dataset):
         can be described without consuming the stream, else None. Known
         exactly for numpy/item-backed sources (their first item is
         inspectable); chunked opaque sources return None -> the analyzer
-        carries an Unknown element but still knows it is a stream."""
+        carries an Unknown element but still knows it is a stream. The
+        spec describes what CONSUMERS see: with an explicit
+        ``compute_dtype`` the leaves report that dtype (the wire dtype
+        rides separately in ``DatasetSpec.wire_dtype`` so the
+        dtype-narrowing lint never false-fires on a deliberately
+        narrow wire)."""
         probe = getattr(self, "_element_probe", None)
         if probe is None:
             return None
-        return probe()
+        el = probe()
+        if el is None or self.compute_dtype is None:
+            return el
+
+        def recast(s, dt):
+            if dt is None or not isinstance(s, jax.ShapeDtypeStruct):
+                return s
+            return jax.ShapeDtypeStruct(tuple(s.shape), np.dtype(dt))
+
+        if isinstance(self.compute_dtype, np.dtype):
+            return jax.tree_util.tree_map(
+                lambda s: recast(s, self.compute_dtype), el)
+        # pytree policy: per-leaf targets mirror the element tree
+        el_leaves, el_td = jax.tree_util.tree_flatten(el)
+        p_leaves, p_td = jax.tree_util.tree_flatten(
+            self.compute_dtype, is_leaf=lambda x: x is None)
+        if el_td != p_td:
+            return el  # mismatch resolves (or raises) at stage time
+        return jax.tree_util.tree_unflatten(
+            el_td, [recast(s, d) for s, d in zip(el_leaves, p_leaves)])
+
+    def wire_dtype_name(self) -> Optional[str]:
+        """Canonical printable identity of the explicit wire dtype
+        policy (None when the wire carries the source's native dtypes)
+        — folded into ``DatasetSpec`` and the resume fingerprint."""
+        return _policy_name(self.wire_dtype)
+
+    def compute_dtype_name(self) -> Optional[str]:
+        """Printable identity of the compute dtype policy (resume
+        fingerprint)."""
+        return _policy_name(self.compute_dtype)
 
     # -- materialization ---------------------------------------------------
     def materialize(self) -> ArrayDataset:
@@ -636,6 +897,13 @@ def fit_streaming(estimator: Any, data: StreamingDataset,
     ``quarantine`` (a :class:`~keystone_tpu.resilience.Quarantine`,
     usually the one wired into the stream's decode pool) rides the
     checkpoint so a resumed fit keeps its corrupt-record accounting.
+
+    Donated carries (``utils.donation``): on TPU/GPU the accumulate
+    jits donate the carry buffers, so the loop below must never touch a
+    carry after passing it back in — it reassigns immediately, and the
+    checkpoint save copies the carry to HOST (``np.asarray``) before
+    the next accumulate donates it, which is what keeps kill-and-resume
+    bit-identical with donation on.
     """
     if not is_streamable(estimator):
         raise _non_streamable_error(estimator)

@@ -100,8 +100,9 @@ def _estimator_key(estimator: Any) -> Any:
 def fit_fingerprint(estimator: Any, data: Any,
                     labels: Any = None) -> str:
     """Stable id of one streamed-fit configuration: the estimator's
-    config, the stream's padded chunk geometry + source tag, and the
-    labels — resident labels by a CONTENT digest (they are host-side
+    config, the stream's padded chunk geometry + source tag + wire/
+    compute dtype policy, and the labels — resident labels by a CONTENT
+    digest (they are host-side
     and k-wide, so hashing them is cheap and catches "same shape,
     different labels"), streamed labels by chunk geometry.
     ``prefetch_depth`` and retry/watchdog settings are deliberately
@@ -116,7 +117,14 @@ def fit_fingerprint(estimator: Any, data: Any,
     if labels is None:
         labels_key: Any = None
     elif hasattr(labels, "chunk_size") and hasattr(labels, "chunks"):
-        labels_key = f"stream:chunk_size={labels.chunk_size}"
+        # the labels stream's wire/compute policy is numeric identity
+        # too — resuming under a reconfigured labels wire would mix
+        # quantizations in the carry exactly like the data-side case
+        lw = getattr(labels, "wire_dtype_name", None)
+        lc = getattr(labels, "compute_dtype_name", None)
+        labels_key = (f"stream:chunk_size={labels.chunk_size}:"
+                      f"wire={lw() if callable(lw) else None}:"
+                      f"compute={lc() if callable(lc) else None}")
     else:
         from ..parallel.dataset import to_numpy
 
@@ -125,11 +133,24 @@ def fit_fingerprint(estimator: Any, data: Any,
         labels_key = (f"resident:{tuple(arr.shape)}:{arr.dtype}:"
                       f"{digest}")
     element = getattr(data, "element", None)
+
+    def _policy_name(attr: str) -> Optional[str]:
+        name = getattr(data, attr, None)
+        return name() if callable(name) else None
+
     parts = {
         "estimator": _estimator_key(estimator),
         "chunk_size": int(getattr(data, "chunk_size", 0)),
         "data_tag": getattr(data, "tag", None),
         "data_element": _stable(element() if callable(element) else None),
+        # the wire/compute dtype policy is part of the NUMERIC identity
+        # of a streamed fit: a checkpoint written under a uint8 wire
+        # must refuse to resume a run reconfigured to an f32 wire (the
+        # narrowing quantizes values — silently mixing the two carries
+        # would drift the weights with no error anywhere); the name
+        # methods serialize pytree (per-leaf) policies too
+        "wire_dtype": _policy_name("wire_dtype_name"),
+        "compute_dtype": _policy_name("compute_dtype_name"),
         "labels": labels_key,
     }
     blob = json.dumps(parts, sort_keys=True, default=str)

@@ -1,9 +1,12 @@
 """Utilities: checkpointing, profiling (reference ``utils/`` + SURVEY.md
 section 5 auxiliary subsystems)."""
 from .checkpoint import load_pipeline, load_state, save_pipeline, save_state
+from .donation import donating_jit, donation_enabled
 from .profiling import StepTimer, trace
 
 __all__ = [
+    "donating_jit",
+    "donation_enabled",
     "load_pipeline",
     "load_state",
     "save_pipeline",

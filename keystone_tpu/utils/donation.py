@@ -1,0 +1,67 @@
+"""Buffer-donating jit for streaming accumulator carries.
+
+A streamed fit updates its carry (Gram/cross/moment buffers) once per
+chunk: ``carry = accumulate(carry, chunk)``. A plain ``jax.jit`` of that
+update allocates a FRESH output buffer per chunk while the old carry is
+still live in the caller — for a (d, d) Gram at d=4096 that is a 64 MiB
+HBM realloc per chunk, doubling the carry's footprint at every step.
+``donate_argnums`` tells XLA the input buffers die with the call, so the
+update writes the new carry into the old carry's memory: the streamed
+fit's HBM cost for accumulation is ONE carry, not two, with no per-chunk
+allocator traffic.
+
+Donation is a TPU/GPU feature — the CPU backend ignores it and warns per
+dispatch, so test runs (8 virtual CPU devices) would drown in warnings.
+:func:`donating_jit` therefore resolves the backend LAZILY at first call
+(never at import time: probing the backend during module import would
+pin the platform before ``JAX_PLATFORMS``/``jax.config`` overrides run)
+and only donates where the runtime honors it. ``KEYSTONE_DONATE_CARRY=0``
+disables donation everywhere (debugging aid: a donated buffer read after
+the call raises, and turning donation off isolates that class of bug).
+
+Contract for callers: a donated argument's buffer is DEAD after the
+call. Keep no live use of the old carry past the update — checkpointing
+must copy the carry to host (``np.asarray``) BEFORE the next accumulate
+donates it, which is exactly what ``resilience.stream_checkpoint``'s
+save does.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Sequence, Tuple
+
+
+def donation_enabled() -> bool:
+    """True when buffer donation should be requested: the backend
+    supports it (TPU/GPU) and ``KEYSTONE_DONATE_CARRY`` is not ``0``.
+    Resolved per call site at first dispatch, never at import."""
+    if os.environ.get("KEYSTONE_DONATE_CARRY", "").strip() == "0":
+        return False
+    import jax
+
+    return jax.default_backend() in ("tpu", "gpu")
+
+
+def donating_jit(fn: Callable, donate_argnums: Sequence[int],
+                 static_argnames: Tuple[str, ...] = ()) -> Callable:
+    """``jax.jit(fn, donate_argnums=...)`` where the backend honors
+    donation, plain ``jax.jit(fn)`` otherwise. The choice is made at the
+    FIRST call (then memoized), so importing a module full of decorated
+    accumulators never initializes a jax backend."""
+    box: dict = {}
+
+    def wrapper(*args: Any, **kwargs: Any) -> Any:
+        jitted = box.get("fn")
+        if jitted is None:
+            import jax
+
+            donate = tuple(donate_argnums) if donation_enabled() else ()
+            jitted = jax.jit(fn, donate_argnums=donate,
+                             static_argnames=static_argnames)
+            box["fn"] = jitted
+        return jitted(*args, **kwargs)
+
+    wrapper.__name__ = getattr(fn, "__name__", "donating_jit")
+    wrapper.__doc__ = fn.__doc__
+    wrapper.__wrapped__ = fn
+    return wrapper

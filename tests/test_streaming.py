@@ -1,6 +1,8 @@
 """Streaming chunked execution: prefetcher mechanics, streamed-vs-
 resident estimator parity, out-of-core HBM bounds, and the
-non-streamable-fit lint (ISSUE 3 tentpole)."""
+non-streamable-fit lint (ISSUE 3 tentpole); dtype-on-the-wire staging,
+per-shard H2D, donated carries and the cast-before-transfer lint
+(ISSUE 5 tentpole)."""
 import threading
 import time
 
@@ -582,6 +584,442 @@ def test_pipeline_streamed_fit_never_materializes(monkeypatch):
                            "BlockLeastSquaresEstimator"), d
     assert d.get("streaming_restricted") is True
     assert len(tr.chunks) > 0  # the fit actually consumed the stream
+
+
+# -- dtype on the wire (ISSUE 5) --------------------------------------------
+
+def _integral_xy(n=600, d=24, k=3, seed=0):
+    """(X, Y) where X holds exact uint8-representable values, so a
+    uint8 wire round-trips losslessly."""
+    rng = np.random.RandomState(seed)
+    X = rng.randint(0, 256, size=(n, d)).astype(np.float32)
+    W = rng.randn(d, k).astype(np.float32)
+    Y = (X @ W + 0.1 * rng.randn(n, k)).astype(np.float32)
+    return X, Y
+
+
+def test_wire_dtype_narrows_transfer_and_restores_dtype():
+    """A uint8 wire ships 1 byte/element (streaming.h2d_bytes counts
+    actual wire bytes) while consumers still see float32 chunks with
+    the exact source values."""
+    from keystone_tpu.observability import MetricsRegistry
+
+    X, _ = _integral_xy(n=96, d=8)
+    reg = MetricsRegistry.get_or_create()
+    h2d = reg.counter("streaming.h2d_bytes")
+    before = h2d.value
+    stream = StreamingDataset.from_numpy(X, chunk_size=32,
+                                         wire_dtype=np.uint8)
+    chunks = list(stream.chunks())
+    assert all(np.asarray(c.data).dtype == np.float32 for c in chunks)
+    got = np.concatenate([c.numpy() for c in chunks])
+    np.testing.assert_array_equal(got, X)
+    shipped = h2d.value - before
+    expected = sum(c.padded_n for c in chunks) * X.shape[1]  # 1 B/elem
+    assert shipped == expected, (shipped, expected)
+
+
+def test_wire_h2d_bytes_quarter_of_f32_wire():
+    """Acceptance: uint8 wire bytes are exactly 1/4 of the f32 wire for
+    the same source."""
+    from keystone_tpu.observability import MetricsRegistry
+
+    X, _ = _integral_xy(n=128, d=16)
+    h2d = MetricsRegistry.get_or_create().counter("streaming.h2d_bytes")
+
+    def shipped(**kw):
+        before = h2d.value
+        list(StreamingDataset.from_numpy(X, chunk_size=64, **kw).chunks())
+        return h2d.value - before
+
+    wide = shipped()  # native f32 wire
+    narrow = shipped(wire_dtype=np.uint8)
+    assert wide == 4 * narrow, (wide, narrow)
+
+
+def test_compute_dtype_casts_on_device():
+    """A native-uint8 source with compute_dtype=f32 yields f32 chunks
+    (the fused device cast), with the wire staying uint8."""
+    from keystone_tpu.observability import MetricsRegistry
+
+    rng = np.random.RandomState(1)
+    imgs = rng.randint(0, 256, size=(48, 6, 5), dtype=np.uint8)
+    h2d = MetricsRegistry.get_or_create().counter("streaming.h2d_bytes")
+    before = h2d.value
+    stream = StreamingDataset.from_numpy(imgs, chunk_size=16,
+                                         compute_dtype=np.float32)
+    chunks = list(stream.chunks())
+    assert all(np.asarray(c.data).dtype == np.float32 for c in chunks)
+    got = np.concatenate([c.numpy() for c in chunks])
+    np.testing.assert_array_equal(got, imgs.astype(np.float32))
+    # wire stayed uint8: 1 byte per element
+    assert h2d.value - before == sum(
+        c.padded_n for c in chunks) * 6 * 5
+
+
+def test_per_leaf_wire_policy_leaves_labels_untouched():
+    """A pytree wire policy narrows only the leaves it names: the image
+    leaf ships uint8 while the float label leaf rides untouched (a
+    uniform dtype applied to mixed trees would corrupt labels > 255)."""
+    rng = np.random.RandomState(5)
+    X = rng.randint(0, 256, size=(96, 8)).astype(np.float32)
+    Y = (1000.0 * rng.rand(96, 2)).astype(np.float32)  # > 255: must
+    stream = StreamingDataset.from_numpy(                # not narrow
+        {"x": X, "y": Y}, chunk_size=32,
+        wire_dtype={"x": np.uint8, "y": None})
+    parts = [c.numpy() for c in stream.chunks()]
+    got_x = np.concatenate([p["x"] for p in parts])
+    got_y = np.concatenate([p["y"] for p in parts])
+    np.testing.assert_array_equal(got_x, X)  # u8 round trip (integral)
+    np.testing.assert_array_equal(got_y, Y)  # bit-exact: never cast
+    # a mismatched policy structure fails loudly at stage time
+    bad = StreamingDataset.from_numpy(
+        {"x": X, "y": Y}, chunk_size=32,
+        wire_dtype={"x": np.uint8, "z": None})
+    with pytest.raises(ValueError, match="policy structure"):
+        list(bad.chunks())
+    # per-leaf policies serialize into the resume fingerprint
+    assert "uint8" in stream.wire_dtype_name()
+
+
+def test_wire_dtype_streamed_fit_parity():
+    """Streamed fit over a uint8 wire matches the resident fit on the
+    identical (integral) data — the narrowing is lossless end to end,
+    donated-carry accumulate included."""
+    X, Y = _integral_xy()
+    resident = LinearMapEstimator(lam=0.1)._fit(
+        ArrayDataset.from_numpy(X), ArrayDataset.from_numpy(Y))
+    streamed = fit_streaming(
+        LinearMapEstimator(lam=0.1),
+        StreamingDataset.from_numpy(X, chunk_size=96,
+                                    wire_dtype=np.uint8), Y)
+    w_r, w_s = np.asarray(resident.weights), np.asarray(streamed.weights)
+    assert np.abs(w_r - w_s).max() <= 1e-5 * np.abs(w_r).max()
+
+
+def test_residency_accounts_post_cast_working_copy():
+    """The HBM ledger charges the post-cast (f32) working chunk, not
+    just the narrow uint8 wire bytes — wire narrowing must never hide
+    device cost from hbm_budget asserts."""
+    rng = np.random.RandomState(2)
+    imgs = rng.randint(0, 256, size=(256, 16), dtype=np.uint8)
+    stream = StreamingDataset.from_numpy(imgs, chunk_size=64,
+                                         compute_dtype=np.float32)
+    lives = []
+    probe = stream.map_chunks(
+        lambda ad: (lives.append(stream.buffered_nbytes()), ad)[1])
+    for _ in probe.chunks():
+        pass
+    work_f32 = 64 * 16 * 4
+    assert max(lives) >= work_f32  # working copy counted at f32 width
+    assert stream.peak_device_nbytes >= work_f32
+
+
+def test_full_chunk_skips_host_pad(monkeypatch):
+    """Satellite: a chunk that already has exactly chunk_size rows must
+    not touch the pad path at all (ragged tails still do)."""
+    import keystone_tpu.parallel.streaming as streaming_mod
+
+    def boom(*a, **k):
+        raise AssertionError("full chunk paid the host pad copy")
+
+    monkeypatch.setattr(streaming_mod, "_pad_to", boom)
+    X = np.arange(64 * 4, dtype=np.float32).reshape(64, 4)
+    chunks = list(StreamingDataset.from_numpy(X, chunk_size=32).chunks())
+    assert [c.n for c in chunks] == [32, 32]
+    # ragged tail DOES pad — the monkeypatched pad must fire
+    with pytest.raises(AssertionError, match="host pad"):
+        list(StreamingDataset.from_numpy(
+            X[:40], chunk_size=32).chunks())
+
+
+def test_multi_axis_mesh_streamed_parity():
+    """Acceptance: streamed-vs-resident weight parity on a multi-axis
+    (data=4, model=2) mesh — per-shard staging incl. ragged tails,
+    uint8 wire, donated-carry accumulate — for LinearMap, BlockLS and
+    the auto solver."""
+    from keystone_tpu.parallel.mesh import make_mesh, mesh_scope
+
+    from keystone_tpu.observability import MetricsRegistry
+
+    X, Y = _integral_xy(n=520, d=24, k=3, seed=4)  # 520: ragged tail
+    with mesh_scope(make_mesh(jax.devices()[:8], data=4, model=2)):
+        # h2d counts what actually crosses the wire: P('data') rows
+        # replicate over the model axis, so model=2 ships 2x the bytes
+        h2d = MetricsRegistry.get_or_create().counter(
+            "streaming.h2d_bytes")
+        before = h2d.value
+        chunks = list(StreamingDataset.from_numpy(
+            X, chunk_size=96, wire_dtype=np.uint8).chunks())
+        assert h2d.value - before == 2 * sum(
+            c.padded_n for c in chunks) * X.shape[1]
+        ds, ls = ArrayDataset.from_numpy(X), ArrayDataset.from_numpy(Y)
+        # the auto solver streams through the gram carry and finalizes
+        # with an exact-ridge-equivalent solver (d=24 -> one BCD block),
+        # so the exact resident solve is its parity reference
+        ests = [(LinearMapEstimator(lam=0.1), LinearMapEstimator(lam=0.1)),
+                (BlockLeastSquaresEstimator(10, 3, lam=0.1),
+                 BlockLeastSquaresEstimator(10, 3, lam=0.1)),
+                (LeastSquaresEstimator(lam=0.1),
+                 LinearMapEstimator(lam=0.1))]
+        for est, ref in ests:
+            resident = ref._fit(ds, ls)
+            stream = StreamingDataset.from_numpy(
+                X, chunk_size=96, wire_dtype=np.uint8)
+            assert stream.chunk_size % 4 == 0  # data-shard multiple
+            streamed = fit_streaming(est, stream, Y)
+            w_r = np.asarray(getattr(resident, "weights"))
+            w_s = np.asarray(getattr(streamed, "weights"))
+            assert np.abs(w_r - w_s).max() <= 1e-5 * np.abs(w_r).max(), \
+                type(est).__name__
+            pred_r = np.argmax(np.asarray(
+                ensure_array(resident.apply_dataset(ds)).numpy()), axis=1)
+            pred_s = np.argmax(np.asarray(
+                ensure_array(streamed.apply_dataset(ds)).numpy()), axis=1)
+            np.testing.assert_array_equal(pred_r, pred_s)
+
+
+def test_trace_chunks_carry_h2d_and_stage_lanes():
+    from keystone_tpu.observability import PipelineTrace
+
+    X, _ = _integral_xy(n=128, d=8)
+    with PipelineTrace("wire") as tr:
+        list(StreamingDataset.from_numpy(
+            X, chunk_size=64, wire_dtype=np.uint8, tag="wire").chunks())
+    assert tr.chunks
+    for c in tr.chunks:
+        assert c["h2d_bytes"] > 0
+        assert c["stage_lanes"] >= 1
+        assert c["stage_s"] >= 0.0
+        # post-cast working footprint is 4x the uint8 wire bytes
+        assert c["nbytes"] == 4 * c["h2d_bytes"]
+    assert tr.chunk_stats["h2d_bytes"] == sum(
+        c["h2d_bytes"] for c in tr.chunks)
+    rt = PipelineTrace.from_json(tr.to_json())
+    assert rt.chunk_stats["h2d_bytes"] == tr.chunk_stats["h2d_bytes"]
+    assert "h2d" in tr.summary()
+
+
+def test_stream_spec_carries_wire_dtype_not_narrowing():
+    """DatasetSpec records the deliberate uint8 wire separately; the
+    element reports the post-cast dtype so the dtype-narrowing lint has
+    nothing to fire on."""
+    from keystone_tpu.analysis.diagnostics import check_graph
+    from keystone_tpu.analysis.spec import dataset_spec
+
+    X, Y = _integral_xy(n=80)
+    stream = StreamingDataset.from_numpy(X, chunk_size=40,
+                                         wire_dtype=np.uint8)
+    spec = dataset_spec(stream)
+    assert spec.wire_dtype == "uint8"
+    assert np.dtype(spec.element.dtype) == np.float32  # post-cast view
+    assert "wire=uint8" in repr(spec)
+    p = LinearMapEstimator(lam=0.1).with_data(
+        stream, ArrayDataset.from_numpy(Y))
+    rep = check_graph(p._graph, {}, name="wire-narrow")
+    assert not [d for d in rep.diagnostics if d.code == "dtype-narrowing"]
+
+
+def test_fingerprint_folds_wire_dtype(tmp_path):
+    """Fix-forward from PR 4: a checkpoint written under a uint8 wire
+    refuses to resume a run reconfigured to an f32 wire."""
+    from keystone_tpu.resilience.stream_checkpoint import (
+        CheckpointMismatchError,
+        StreamCheckpoint,
+        fit_fingerprint,
+    )
+
+    X, Y = _integral_xy(n=160)
+    est = LinearMapEstimator(lam=0.1)
+    narrow = StreamingDataset.from_numpy(X, chunk_size=80,
+                                         wire_dtype=np.uint8)
+    wide = StreamingDataset.from_numpy(X, chunk_size=80)
+    fp_narrow = fit_fingerprint(est, narrow, Y)
+    fp_wide = fit_fingerprint(est, wide, Y)
+    assert fp_narrow != fp_wide
+    ckpt = StreamCheckpoint(str(tmp_path))
+    ckpt.save(fp_narrow, 1, (np.zeros(2),))
+    with pytest.raises(CheckpointMismatchError):
+        ckpt.load(fp_wide)
+    # the LABELS stream's wire policy is numeric identity too
+    ldata = StreamingDataset.from_numpy(X, chunk_size=80)
+    fp_lab_narrow = fit_fingerprint(
+        est, ldata,
+        StreamingDataset.from_numpy(Y, chunk_size=80,
+                                    wire_dtype=np.uint8))
+    fp_lab_wide = fit_fingerprint(
+        est, ldata, StreamingDataset.from_numpy(Y, chunk_size=80))
+    assert fp_lab_narrow != fp_lab_wide
+
+
+def test_donation_disabled_on_cpu_and_by_env(monkeypatch):
+    """Donation resolves lazily per backend: the CPU test backend never
+    requests it (no per-dispatch warnings), KEYSTONE_DONATE_CARRY=0
+    disables it everywhere, and the donating wrapper is numerically the
+    plain function."""
+    from keystone_tpu.utils.donation import donating_jit, donation_enabled
+
+    assert donation_enabled() is False  # cpu backend
+    monkeypatch.setenv("KEYSTONE_DONATE_CARRY", "0")
+    assert donation_enabled() is False
+
+    fn = donating_jit(lambda a, b: a + b, donate_argnums=(0,))
+    a = np.arange(4.0, dtype=np.float32)
+    out = fn(a, a)
+    np.testing.assert_array_equal(np.asarray(out), a + a)
+    # on cpu the input buffer survives the call (no donation happened)
+    np.testing.assert_array_equal(a, np.arange(4.0, dtype=np.float32))
+
+
+def test_wire_cast_program_shared_across_streams():
+    """Regression (caught by the PR 5 drive): the wire->compute cast
+    program must be memoized GLOBALLY by (structure, dtypes) — a fresh
+    StreamingDataset per refit must not recompile the cast, or the
+    zero-recompile second epoch breaks for every wire-narrowed
+    stream."""
+    import io
+    import logging
+
+    X, Y = _integral_xy(n=256, d=8)
+
+    def refit():
+        fit_streaming(
+            LinearMapEstimator(lam=0.1),
+            StreamingDataset.from_numpy(X, chunk_size=128,
+                                        wire_dtype=np.uint8), Y)
+
+    refit()  # warm
+    jax.config.update("jax_log_compiles", True)
+    buf = io.StringIO()
+    handler = logging.StreamHandler(buf)
+    loggers = [logging.getLogger("jax._src.interpreters.pxla"),
+               logging.getLogger("jax._src.dispatch")]
+    for lg in loggers:
+        lg.addHandler(handler)
+        lg.setLevel(logging.WARNING)
+    try:
+        refit()  # brand-new stream instance, same shape family
+    finally:
+        jax.config.update("jax_log_compiles", False)
+        for lg in loggers:
+            lg.removeHandler(handler)
+    compiles = [ln for ln in buf.getvalue().splitlines()
+                if "Compiling" in ln]
+    assert not compiles, compiles
+
+
+# -- cast-before-transfer lint (satellite) -----------------------------------
+
+def test_cast_before_transfer_lint_fires_on_offender():
+    import ast
+
+    from keystone_tpu.analysis.diagnostics import (
+        float_casts_before_transfer,
+    )
+
+    src = (
+        "def stage(x, sh):\n"
+        "    arr = np.stack(x).astype(np.float32)\n"
+        "    return jax.device_put(arr, sh)\n"
+    )
+    hits = float_casts_before_transfer(ast.parse(src))
+    assert hits and hits[0][0] == 2
+    # dtype= keyword form fires too
+    src2 = (
+        "def stage(x, sh):\n"
+        "    arr = np.asarray(x, dtype=np.float32)\n"
+        "    return jax.device_put(arr, sh)\n"
+    )
+    assert float_casts_before_transfer(ast.parse(src2))
+    # no device_put in scope: the cast alone is fine
+    src3 = "def decode(x):\n    return np.asarray(x, dtype=np.float32)\n"
+    assert not float_casts_before_transfer(ast.parse(src3))
+    # narrowing casts are fine next to device_put
+    src4 = (
+        "def stage(x, sh):\n"
+        "    return jax.device_put(np.stack(x).astype(np.uint8), sh)\n"
+    )
+    assert not float_casts_before_transfer(ast.parse(src4))
+    # the astype(dtype=...) keyword spelling fires too
+    src5 = (
+        "def stage(x, sh):\n"
+        "    arr = np.stack(x).astype(dtype=np.float32)\n"
+        "    return jax.device_put(arr, sh)\n"
+    )
+    assert float_casts_before_transfer(ast.parse(src5))
+    # scopes are separate: a cast in the outer body and a device_put
+    # inside an unrelated nested closure must NOT conflate
+    src6 = (
+        "def outer(x, sh):\n"
+        "    table = np.asarray(x, dtype=np.float32)\n"
+        "    def helper(y):\n"
+        "        return jax.device_put(y, sh)\n"
+        "    return table, helper\n"
+    )
+    assert not float_casts_before_transfer(ast.parse(src6))
+
+
+def test_staging_tree_clean_of_cast_before_transfer():
+    """The scoped tree (loaders/, parallel/) holds no widening cast in
+    any device_put-ing function — the pattern this PR removed."""
+    import ast
+    from pathlib import Path
+
+    import keystone_tpu
+    from keystone_tpu.analysis.diagnostics import (
+        CAST_BEFORE_TRANSFER_SCOPES,
+        float_casts_before_transfer,
+    )
+
+    pkg = Path(keystone_tpu.__file__).parent
+    offenders = []
+    for scope in CAST_BEFORE_TRANSFER_SCOPES:
+        for path in sorted((pkg / scope).rglob("*.py")):
+            tree = ast.parse(path.read_text())
+            offenders += [f"{path.name}:{lineno} {what}"
+                          for lineno, what in
+                          float_casts_before_transfer(tree)]
+    assert not offenders, offenders
+
+
+def test_stream_tar_images_uint8_wire(tmp_path):
+    """The default tar streaming path decodes uint8, ships uint8, and
+    hands consumers float32 [0, 255] chunks — the in-tree offender this
+    PR narrows (4x fewer wire bytes than the old f32 staging)."""
+    import io as _io
+    import tarfile
+
+    from PIL import Image as PILImage
+
+    from keystone_tpu.loaders.image_loader_utils import stream_tar_images
+    from keystone_tpu.observability import MetricsRegistry
+
+    side, n_imgs = 8, 6
+    rng = np.random.RandomState(0)
+    arrays = []
+    tar_path = tmp_path / "imgs.tar"
+    with tarfile.open(tar_path, "w") as tf:
+        for i in range(n_imgs):
+            arr = (rng.rand(side, side, 3) * 255).astype(np.uint8)
+            arrays.append(arr)
+            buf = _io.BytesIO()
+            PILImage.fromarray(arr).save(buf, format="PNG")
+            data = buf.getvalue()
+            info = tarfile.TarInfo(f"img{i:03d}.png")
+            info.size = len(data)
+            tf.addfile(info, _io.BytesIO(data))
+
+    h2d = MetricsRegistry.get_or_create().counter("streaming.h2d_bytes")
+    before = h2d.value
+    stream = stream_tar_images([str(tar_path)], chunk_size=2, n=n_imgs)
+    chunks = list(stream.chunks())
+    got = np.concatenate([c.numpy() for c in chunks])
+    assert got.dtype == np.float32  # consumers keep the f32 contract
+    np.testing.assert_array_equal(
+        got, np.stack(arrays).astype(np.float32))  # PNG+u8 is lossless
+    shipped = h2d.value - before
+    expected = sum(c.padded_n for c in chunks) * side * side * 3  # u8
+    assert shipped == expected, (shipped, expected)
 
 
 # -- loader glue ------------------------------------------------------------

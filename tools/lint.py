@@ -20,6 +20,12 @@ Three layers, all hermetic (no data, no device buffers):
      the error and keep going" becomes silent data loss. Tolerating a
      failure there goes through the resilience layer (RetryPolicy /
      Quarantine), which accounts for it.
+   - ``cast-before-transfer`` (loader + staging code — ``loaders/``,
+     ``parallel/``): no host-side float widening in a function that
+     also ``device_put``\\ s — widening uint8 records to float before
+     the transfer ships 4x the bytes; ship the source dtype and let
+     the device cast (``StreamingDataset`` ``wire_dtype`` /
+     ``compute_dtype``).
 3. **ruff** (when installed): style/correctness pass over the package.
    Skipped with a notice when the container lacks ruff — layers 1–2
    are the required gate.
@@ -97,7 +103,9 @@ def _unstable_jit_tags(tree: ast.Module):
 
 def run_ast_rules() -> int:
     from keystone_tpu.analysis.diagnostics import (
+        CAST_BEFORE_TRANSFER_SCOPES,
         SWALLOW_ALL_SCOPES,
+        float_casts_before_transfer,
         swallow_all_handlers,
     )
 
@@ -128,6 +136,16 @@ def run_ast_rules() -> int:
                       "ingest/workflow code silently loses failures; "
                       "narrow the exception type, or route it through "
                       "the resilience layer (RetryPolicy/Quarantine)")
+                failures += 1
+        if rel.parts[:1] == ("keystone_tpu",) and \
+                rel.parts[1] in CAST_BEFORE_TRANSFER_SCOPES:
+            for lineno, what in float_casts_before_transfer(tree):
+                print(f"{rel}:{lineno}: cast-before-transfer: {what} in "
+                      "a function that device_puts — widening on the "
+                      "host ships 4x the bytes the source held; ship "
+                      "the source dtype and cast on device "
+                      "(StreamingDataset wire_dtype/compute_dtype, "
+                      "README 'Streaming ingest')")
                 failures += 1
     return failures
 
