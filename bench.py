@@ -1392,6 +1392,10 @@ def streamed_e2e_bench():
         model = fit_streaming(est, feat_chunks(train), L,
                               hbm_budget=budget)
         result["peak_stream"] = train.peak_device_nbytes
+        # device-free planner prediction for the SAME stream geometry:
+        # plan >= measured always (the ledger can never exceed it), and
+        # plan/measured near 1 means the buffer saturated as modeled
+        result["static_plan"] = train.static_plan_nbytes()
         test = StreamingDataset.from_numpy(
             imgs_test, chunk_size=chunk, prefetch_depth=depth,
             tag="cifar-stream-test")
@@ -1408,12 +1412,22 @@ def streamed_e2e_bench():
     dt, ev = _timed_median(fit_and_predict)
 
     per_chip = (n_train + n_test) / dt / n_dev
+    plan = result.get("static_plan")
+    peak = result["peak_stream"]
     _emit("cifar_streamed_e2e_images_per_sec_per_chip", round(per_chip, 1),
           "images/sec/chip", round(per_chip / 10000.0, 4),
           chunk_size=chunk, prefetch_depth=depth, n_train=n_train,
           num_filters=num_filters,
           hbm_budget_mib=round(budget / (1 << 20), 2),
-          peak_stream_mib=round(result["peak_stream"] / (1 << 20), 2),
+          peak_stream_mib=round(peak / (1 << 20), 2),
+          # planner validation (BENCH_r06+): static_plan_hbm_mib is the
+          # device-free prediction, plan_vs_measured its ratio to the
+          # ledger peak (>= 1.0 by construction; ~1.0 = saturated
+          # double buffer, large = the producer never filled the slots)
+          static_plan_hbm_mib=(None if plan is None
+                               else round(plan / (1 << 20), 2)),
+          plan_vs_measured=(None if plan is None or not peak
+                            else round(plan / peak, 3)),
           gram_carry_mib=round((F * F + F * 10) * 4 / (1 << 20), 2),
           ingest_stall_share=share(dt),
           h2d_bytes_per_image=share.h2d_bytes_per_image(), **ev)

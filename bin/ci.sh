@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# One-shot CI gate for this repo — chains the three hermetic checks a PR
+# must pass, in fail-fast order of cost:
+#
+#   1. tools/lint.py --skip-apps   AST rules (host coercions, recompile
+#                                  hazards, donation safety, swallow-all,
+#                                  cast-before-transfer) + the eval_shape
+#                                  donation shape gate (+ ruff if present)
+#   2. python -m keystone_tpu check --all --budget $KEYSTONE_CI_HBM_BUDGET
+#                                  abstract interpretation + graph lints +
+#                                  static HBM plans over every CHECK_APPS
+#                                  app, device-free; exit 1 on diagnostics,
+#                                  exit 2 on a predicted budget violation
+#   3. tier-1 pytest               tests/ -m 'not slow' on the CPU-simulated
+#                                  8-device mesh
+#
+#   bin/ci.sh                      # the full gate (PR bar)
+#   bin/ci.sh --no-tests           # static layers only (what
+#                                  # bin/run-pipeline.sh --check runs)
+#
+# KEYSTONE_CI_HBM_BUDGET (default 16GiB — one v5e chip's HBM) bounds
+# every app's statically planned fit-path peak; see README "Static
+# checking" for the accounting model.
+set -euo pipefail
+
+KEYSTONE_HOME="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="$KEYSTONE_HOME${PYTHONPATH:+:$PYTHONPATH}"
+PY=python3
+command -v python3 >/dev/null 2>&1 || PY=python
+
+run_tests=1
+if [[ "${1:-}" == "--no-tests" ]]; then
+  run_tests=0
+  shift
+fi
+
+BUDGET="${KEYSTONE_CI_HBM_BUDGET:-16GiB}"
+
+echo "== ci: lint (AST rules + donation shape gate) =="
+"$PY" "$KEYSTONE_HOME/tools/lint.py" --skip-apps
+
+echo "== ci: static pipeline checks + HBM plans (budget $BUDGET) =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+  "$PY" -m keystone_tpu check --all --budget "$BUDGET"
+
+if (( run_tests )); then
+  echo "== ci: tier-1 tests =="
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    "$PY" -m pytest "$KEYSTONE_HOME/tests" -q -m 'not slow' \
+    -p no:cacheprovider
+fi
+
+echo "== ci: clean =="

@@ -4,8 +4,10 @@
 #
 #   bin/run-pipeline.sh <app> [--flags]
 #   bin/run-pipeline.sh                 # list apps
-#   bin/run-pipeline.sh --check         # repo static gate (tools/lint.py):
-#                                       # per-app pipeline checks + AST rules
+#   bin/run-pipeline.sh --check         # repo static gate (bin/ci.sh
+#                                       # --no-tests): AST rules + donation
+#                                       # shape gate + per-app pipeline
+#                                       # checks with budgeted HBM plans
 #   bin/run-pipeline.sh check <app>     # static-check one app's DAG
 #
 # The reference capped OMP_NUM_THREADS to protect OpenBLAS inside Spark
@@ -33,10 +35,12 @@ PY=python3
 command -v python3 >/dev/null 2>&1 || PY=python
 
 # --check: the pre-PR static gate — no data, no device, exit != 0 on
-# any diagnostic (see tools/lint.py)
+# any diagnostic or predicted HBM-budget violation (bin/ci.sh chains
+# tools/lint.py and the budgeted `check --all`; the full gate with
+# tier-1 tests is `bin/ci.sh` without flags)
 if [[ "${1:-}" == "--check" ]]; then
   shift
-  exec "$PY" "$KEYSTONE_HOME/tools/lint.py" "$@"
+  exec "$KEYSTONE_HOME/bin/ci.sh" --no-tests "$@"
 fi
 
 exec "$PY" -m keystone_tpu "$@"

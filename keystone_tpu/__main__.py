@@ -2,16 +2,19 @@
 ``bin/run-pipeline.sh <class> --flags``, SURVEY.md section 2.13):
 
     python -m keystone_tpu <app> [--flags]
-    python -m keystone_tpu check <app> [--json PATH]
-    python -m keystone_tpu check --all
+    python -m keystone_tpu check <app> [--json PATH] [--budget BYTES]
+    python -m keystone_tpu check --all [--budget BYTES]
 
 Run with no arguments to list the available applications.
 
 ``check`` statically analyzes an app's pipeline DAG — shape/dtype
-propagation plus the graph lints (see ``keystone_tpu/analysis``) —
-without loading data or allocating a device buffer, and exits non-zero
-if any diagnostic fires. ``--json PATH`` additionally writes the full
-report (per-node specs + diagnostics).
+propagation, the graph lints, and the static HBM plan (see
+``keystone_tpu/analysis``) — without loading data or allocating a
+device buffer, and exits non-zero if any diagnostic fires.
+``--budget BYTES`` (``MiB``/``GiB`` suffixes accepted) gates each app
+on its planned fit-path peak and exits 2 on a predicted violation.
+``--json PATH`` additionally writes the full report (per-node specs +
+diagnostics + plan).
 
 ``--trace-out PATH`` runs the app under a
 :class:`~keystone_tpu.observability.PipelineTrace` and writes the full
@@ -39,8 +42,31 @@ APPS = {
 }
 
 
+def _parse_bytes(text: str) -> float:
+    """Byte counts with optional binary suffixes: ``1073741824``,
+    ``512MiB``, ``16GiB``, ``4g``."""
+    units = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40}
+    s = text.strip().lower()
+    for suffix in ("ib", "b"):
+        if s.endswith(suffix) and len(s) > len(suffix) \
+                and s[-len(suffix) - 1] in units:
+            s = s[: -len(suffix)]
+            break
+    mult = 1
+    if s and s[-1] in units:
+        mult = units[s[-1]]
+        s = s[:-1]
+    return float(s) * mult
+
+
 def check_main(rest) -> int:
-    """``python -m keystone_tpu check <app>|--all [--json PATH]``."""
+    """``python -m keystone_tpu check <app>|--all [--json PATH]
+    [--budget BYTES]``.
+
+    ``--budget`` (bytes; ``MiB``/``GiB`` suffixes accepted) gates every
+    checked app on its static HBM plan — the device-free prediction of
+    the fit path's peak residency. Exit codes: 0 clean, 1 lint
+    diagnostics, 2 predicted budget violation (or usage error)."""
     import os
 
     plat = os.environ.get("JAX_PLATFORMS")
@@ -56,12 +82,26 @@ def check_main(rest) -> int:
             return 2
         json_out = rest[i + 1]
         del rest[i:i + 2]
+    budget = None
+    if "--budget" in rest:
+        i = rest.index("--budget")
+        if i + 1 >= len(rest):
+            print("--budget requires a byte count (e.g. 16GiB)",
+                  file=sys.stderr)
+            return 2
+        try:
+            budget = _parse_bytes(rest[i + 1])
+        except ValueError:
+            print(f"--budget expects bytes (e.g. 1073741824, 512MiB, "
+                  f"16GiB), got {rest[i + 1]!r}", file=sys.stderr)
+            return 2
+        del rest[i:i + 2]
 
     from keystone_tpu.pipelines import CHECK_APPS, resolve_check_app
 
     if not rest or rest[0] in ("-h", "--help"):
         print("usage: python -m keystone_tpu check <app>|--all "
-              "[--json PATH]\n\napps:")
+              "[--json PATH] [--budget BYTES]\n\napps:")
         for name in sorted(CHECK_APPS):
             print(f"  {name}")
         return 0
@@ -76,16 +116,26 @@ def check_main(rest) -> int:
             return 2
 
     failed = 0
+    over_budget = 0
     reports = []
     for build in builders:
         target = build()
-        report = target.pipeline.check(target.input_spec, name=target.name)
+        report = target.pipeline.check(target.input_spec, name=target.name,
+                                       hbm_budget=budget)
         reports.append(report)
         print(report.summary(), file=sys.stderr)
+        violated = any(d.code == "hbm-budget" for d in report.diagnostics)
+        over_budget += violated
         if not report.ok:
             failed += 1
-        status = "OK" if report.ok else (
-            f"FAIL ({len(report.diagnostics)} diagnostic(s))")
+        if report.ok:
+            status = "OK"
+        elif violated:
+            status = (f"OVER BUDGET (plan "
+                      f"{report.plan.fit_peak_nbytes / (1 << 20):.2f} MiB "
+                      f"> {budget / (1 << 20):.2f} MiB)")
+        else:
+            status = f"FAIL ({len(report.diagnostics)} diagnostic(s))"
         print(f"{target.name}: {status}")
     if json_out is not None:
         import json as _json
@@ -95,6 +145,8 @@ def check_main(rest) -> int:
         with open(json_out, "w") as f:
             f.write(_json.dumps(blob, indent=2))
         print(f"report written to {json_out}", file=sys.stderr)
+    if over_budget:
+        return 2  # predicted HBM-budget violation, before any device work
     return 1 if failed else 0
 
 

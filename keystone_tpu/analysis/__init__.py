@@ -23,6 +23,12 @@ from .diagnostics import (
     check_pipeline,
 )
 from .interpreter import Analysis, analyze
+from .resources import (
+    HbmPlan,
+    ResourceEffect,
+    StreamGeometry,
+    plan_graph,
+)
 from .spec import (
     DatasetSpec,
     DatumSpec,
@@ -40,8 +46,11 @@ __all__ = [
     "DatasetSpec",
     "DatumSpec",
     "Diagnostic",
+    "HbmPlan",
+    "ResourceEffect",
     "SparseSpec",
     "SpecDataset",
+    "StreamGeometry",
     "TransformerSpec",
     "Unknown",
     "analyze",
@@ -49,5 +58,6 @@ __all__ = [
     "as_input_spec",
     "check_graph",
     "check_pipeline",
+    "plan_graph",
     "spec_dataset",
 ]

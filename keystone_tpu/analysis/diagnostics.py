@@ -444,6 +444,311 @@ def non_streamable_fit_lint(analysis: Analysis) -> List[Diagnostic]:
     return out
 
 
+# -- donation-safety AST pass ------------------------------------------------
+#
+# ``utils.donation.donating_jit`` marks its donated arguments' buffers
+# DEAD after the call — reading one afterwards raises on TPU/GPU and
+# silently works on CPU, which is exactly the kind of backend-dependent
+# bug that survives a CPU test suite. This pass finds the two dataflow
+# shapes that bit us (or nearly did):
+#
+# * ``use-after-donate``       — a name passed at a donate position is
+#                                read later in the same scope without
+#                                being rebound first
+# * ``checkpoint-after-donate`` — the later read sits inside a
+#                                ``*.save(...)`` call: the checkpoint
+#                                would snapshot a dead buffer (saves
+#                                must copy the carry to host BEFORE the
+#                                next accumulate donates it)
+#
+# The analysis is textual-order within one function scope (nested defs
+# are separate scopes, like the other AST rules here): the canonical
+# safe pattern ``carry = update(carry, ...)`` rebinds at the call
+# statement and is never flagged; loops that donate then read without a
+# rebind are flagged by their source order. The companion
+# shape-compatibility rule is spec-level, not AST-level — see
+# ``utils.donation.donation_shape_mismatches`` (eval_shape over each
+# registered site's probe), enforced by tools/lint.py.
+
+def donating_names(tree) -> Dict[str, frozenset]:
+    """``{assigned name: donate_argnums}`` for every
+    ``NAME = donating_jit(fn, donate_argnums=...)`` in ``tree``."""
+    out: Dict[str, frozenset] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            continue
+        call = node.value
+        fname = (call.func.attr if isinstance(call.func, ast.Attribute)
+                 else getattr(call.func, "id", ""))
+        if fname != "donating_jit":
+            continue
+        argnums_node = None
+        if len(call.args) >= 2:
+            argnums_node = call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                argnums_node = kw.value
+        if argnums_node is None:
+            continue
+        try:
+            argnums = tuple(ast.literal_eval(argnums_node))
+        except (ValueError, SyntaxError):
+            continue  # computed argnums: nothing static to track
+        out[node.targets[0].id] = frozenset(int(a) for a in argnums)
+    return out
+
+
+def donation_hazards(tree) -> List[tuple]:
+    """``(lineno, code, description)`` for use-after-donate /
+    checkpoint-after-donate patterns (see the block comment above)."""
+    donors = donating_names(tree)
+    hits: List[tuple] = []
+    if not donors:
+        return hits
+    for fdef in ast.walk(tree):
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        own = list(_own_scope_nodes(fdef))
+        # reads that happen inside a *.save(...) call (checkpoint form)
+        save_reads = set()
+        for node in own:
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "save"):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name) and isinstance(
+                            sub.ctx, ast.Load):
+                        save_reads.add(id(sub))
+        stores = [(n.id, n.lineno) for n in own
+                  if isinstance(n, ast.Name) and isinstance(
+                      n.ctx, ast.Store)]
+        loads = [(n.id, n.lineno, id(n) in save_reads) for n in own
+                 if isinstance(n, ast.Name) and isinstance(
+                     n.ctx, ast.Load)]
+        for node in own:
+            if not isinstance(node, ast.Call):
+                continue
+            fname = (node.func.id if isinstance(node.func, ast.Name)
+                     else getattr(node.func, "attr", ""))
+            if fname not in donors:
+                continue
+            call_end = getattr(node, "end_lineno", node.lineno)
+            for i in sorted(donors[fname]):
+                if i >= len(node.args) or not isinstance(
+                        node.args[i], ast.Name):
+                    continue
+                name = node.args[i].id
+                for lname, lline, in_save in loads:
+                    if lname != name or lline <= call_end:
+                        continue
+                    # a rebind between the donating call and the read
+                    # (the call's own assignment targets included)
+                    # kills the old binding — safe
+                    if any(sn == name and node.lineno <= sl <= lline
+                           for sn, sl in stores):
+                        continue
+                    code = ("checkpoint-after-donate" if in_save
+                            else "use-after-donate")
+                    hits.append((
+                        lline, code,
+                        f"`{name}` was donated to {fname}() at line "
+                        f"{node.lineno} and is "
+                        + ("snapshotted by a checkpoint save"
+                           if in_save else "read")
+                        + " afterwards — the buffer is dead on "
+                        "TPU/GPU (copy to host before the donating "
+                        "call, or rebind the name from the call's "
+                        "result)"))
+                    break  # one report per donated name per call
+    return sorted(set(hits))
+
+
+# -- recompile-hazard AST pass -----------------------------------------------
+#
+# jax's trace cache is keyed on the FUNCTION OBJECT plus avals — not on
+# ambient state the trace bakes in. Two bug classes from this repo's
+# history:
+#
+# * ``mesh-closure-jit``      — a module-level ``jax.jit`` of a function
+#                               that reads the ambient mesh
+#                               (``get_mesh`` directly or one call away):
+#                               the first mesh's sharding constraints
+#                               bake into the cached trace and a second
+#                               mesh silently reuses them (the
+#                               ``_bcd_jit_for`` bug, fixed in PR 2 by a
+#                               per-mesh lru_cache factory — jit sites
+#                               inside a function taking a ``mesh``
+#                               parameter are therefore exempt)
+# * ``per-instance-jit-memo`` — a compiled program memoized on ``self``
+#                               with no global cache behind it: every
+#                               refit builds a fresh instance and
+#                               recompiles (the ``_CAST_JIT_CACHE``
+#                               lesson). Storing a jit on ``self`` is
+#                               fine only as a fast path over a
+#                               module-level memo (the ``_cached_jit``
+#                               pattern: the same scope also ``put``\\ s
+#                               the program into a global cache)
+# * ``unstable-jit-cache-tag`` — ``self._cached_jit(<computed tag>,...)``
+#                               destabilizes the global jit cache key
+#                               across sessions (moved here from
+#                               tools/lint.py so all recompile rules
+#                               share one home)
+
+_AMBIENT_MESH_READS = {"get_mesh"}
+
+
+def _function_call_names(fdef) -> set:
+    out = set()
+    for node in ast.walk(fdef):
+        if isinstance(node, ast.Call):
+            f = node.func
+            out.add(f.id if isinstance(f, ast.Name)
+                    else getattr(f, "attr", ""))
+    return out
+
+
+def _ambient_mesh_functions(tree) -> set:
+    """Names of module-level defs that read the ambient global mesh —
+    directly (``get_mesh``) or one call away through another module
+    function that does. One transitive hop covers the historical bug
+    shape (``bcd_core`` -> ``_class_spec`` -> ``get_mesh``) without
+    whole-program analysis."""
+    defs = {n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)}
+    direct = {
+        name for name, d in defs.items()
+        if _function_call_names(d) & _AMBIENT_MESH_READS
+    }
+    onehop = set(direct)
+    for name, d in defs.items():
+        if name not in onehop and _function_call_names(d) & direct:
+            onehop.add(name)
+    return onehop
+
+
+def _is_jit_func(f) -> bool:
+    return (isinstance(f, ast.Attribute) and f.attr == "jit") or (
+        isinstance(f, ast.Name) and f.id == "jit")
+
+
+def recompile_hazards(tree) -> List[tuple]:
+    """``(lineno, code, description)`` for the recompile-hazard rules
+    (see the block comment above)."""
+    hits: List[tuple] = []
+    mesh_fns = _ambient_mesh_functions(tree)
+
+    # mesh-closure-jit: jax.jit(<ambient-mesh-reading fn>) outside a
+    # mesh-parameterized factory; covers the decorator spelling too
+    def scan(node, mesh_param_scope):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            params = {a.arg for a in node.args.args
+                      + node.args.posonlyargs + node.args.kwonlyargs}
+            mesh_param_scope = mesh_param_scope or any(
+                "mesh" in p for p in params)
+        if (isinstance(node, ast.Call) and _is_jit_func(node.func)
+                and node.args and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in mesh_fns
+                and not mesh_param_scope):
+            hits.append((
+                node.lineno, "mesh-closure-jit",
+                f"jax.jit({node.args[0].id}) caches a trace of an "
+                "ambient-mesh-reading function: the first mesh's "
+                "sharding bakes into the cached jaxpr and a second "
+                "mesh silently reuses it. Key the jit per mesh "
+                "(lru_cache factory taking the mesh — see "
+                "ops/linalg.py::_bcd_jit_for)"))
+        for child in ast.iter_child_nodes(node):
+            scan(child, mesh_param_scope)
+
+    scan(tree, False)
+    for fdef in ast.walk(tree):
+        if not isinstance(fdef, ast.FunctionDef):
+            continue
+        if fdef.name not in mesh_fns:
+            continue
+        for dec in fdef.decorator_list:
+            target = dec
+            if isinstance(dec, ast.Call):  # functools.partial(jax.jit,..)
+                target = (dec.args[0] if dec.args
+                          and dec.func and getattr(
+                              dec.func, "attr", "") == "partial"
+                          else dec.func)
+            if _is_jit_func(target):
+                hits.append((
+                    fdef.lineno, "mesh-closure-jit",
+                    f"@jax.jit on {fdef.name}() bakes the ambient mesh "
+                    "into one module-lifetime trace; key the jit per "
+                    "mesh (see ops/linalg.py::_bcd_jit_for)"))
+
+    # per-instance-jit-memo
+    for fdef in ast.walk(tree):
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        own = list(_own_scope_nodes(fdef))
+        jit_locals = set()
+        blessed = set()
+        for node in own:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call) \
+                    and _is_jit_func(node.value.func):
+                jit_locals.add(node.targets[0].id)
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) and node.func.attr == "put":
+                # stored into a module-level memo as well: the instance
+                # attr is a fast path, not the program's only home
+                for a in node.args:
+                    if isinstance(a, ast.Name):
+                        blessed.add(a.id)
+
+        def self_target(t) -> bool:
+            if isinstance(t, ast.Attribute):
+                return isinstance(t.value, ast.Name) and t.value.id == "self"
+            if isinstance(t, ast.Subscript):
+                v = t.value
+                return (isinstance(v, ast.Attribute)
+                        and isinstance(v.value, ast.Name)
+                        and v.value.id == "self")
+            return False
+
+        for node in own:
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(self_target(t) for t in node.targets):
+                continue
+            direct = isinstance(node.value, ast.Call) and _is_jit_func(
+                node.value.func)
+            via_local = (isinstance(node.value, ast.Name)
+                         and node.value.id in jit_locals
+                         and node.value.id not in blessed)
+            if direct or via_local:
+                hits.append((
+                    node.lineno, "per-instance-jit-memo",
+                    "compiled program memoized on self with no global "
+                    "cache behind it: every refit builds a fresh "
+                    "instance and recompiles. Memoize in a module-level "
+                    "LruMemo keyed on structure (the _CAST_JIT_CACHE / "
+                    "_cached_jit pattern)"))
+
+    # unstable-jit-cache-tag (from tools/lint.py; one home for all
+    # recompile rules)
+    for call in ast.walk(tree):
+        if not (isinstance(call, ast.Call) and call.args):
+            continue
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "_cached_jit"):
+            continue
+        tag = call.args[0]
+        if not (isinstance(tag, ast.Constant)
+                and isinstance(tag.value, str)):
+            hits.append((
+                call.lineno, "unstable-jit-cache-tag",
+                "_cached_jit tag must be a string literal (computed "
+                "tags break warm-executable reuse across sessions)"))
+    return sorted(set(hits))
+
+
 # -- fusion/prefix hazard ---------------------------------------------------
 
 def _fusion_fixpoint(graph: Graph) -> Graph:
@@ -506,13 +811,17 @@ def fusion_prefix_lint(
 
 class AnalysisReport:
     """One static check's outcome: the abstract values per node plus all
-    diagnostics, exportable in the observability layer's report style."""
+    diagnostics, exportable in the observability layer's report style.
+    ``plan`` carries the static HBM plan
+    (:class:`~keystone_tpu.analysis.resources.HbmPlan`) when the
+    resource planner ran."""
 
     def __init__(self, name: str, analysis: Analysis,
-                 diagnostics: List[Diagnostic]):
+                 diagnostics: List[Diagnostic], plan: Any = None):
         self.name = name
         self.analysis = analysis
         self.diagnostics = diagnostics
+        self.plan = plan
 
     @property
     def ok(self) -> bool:
@@ -540,6 +849,7 @@ class AnalysisReport:
             "name": self.name,
             "nodes": nodes,
             "diagnostics": [asdict(d) for d in self.diagnostics],
+            "plan": None if self.plan is None else self.plan.to_dict(),
         }
 
     def to_json(self, indent: Optional[int] = 2) -> str:
@@ -562,6 +872,8 @@ class AnalysisReport:
             else:
                 shown = repr(spec)
             lines.append(f"{n.id:>6} {op[:34]:<34} {shown}")
+        if self.plan is not None:
+            lines.append(self.plan.summary())
         if self.diagnostics:
             lines.append("diagnostics:")
             for d in self.diagnostics:
@@ -575,8 +887,13 @@ def check_graph(
     graph: Graph,
     source_specs: Optional[Mapping[SourceId, AbstractValue]] = None,
     name: str = "graph",
+    hbm_budget: Optional[float] = None,
 ) -> AnalysisReport:
-    """Run the abstract interpreter plus every lint over ``graph``."""
+    """Run the abstract interpreter, every lint, and the static HBM
+    planner over ``graph``. ``hbm_budget`` (bytes) adds an
+    ``hbm-budget`` ERROR diagnostic when the plan's fit-path peak
+    exceeds it — the device-free form of the runtime budget assert
+    (budgets are checked twice, PERFORMANCE.md)."""
     source_specs = dict(source_specs or {})
     analysis = analyze(graph, source_specs)
     diagnostics = list(analysis.diagnostics)
@@ -587,16 +904,34 @@ def check_graph(
     diagnostics += fusion_prefix_lint(graph)
     diagnostics += non_streamable_fit_lint(analysis)
     diagnostics += host_stage_on_stream_lint(analysis)
-    return AnalysisReport(name, analysis, diagnostics)
+    from .resources import plan_graph
+
+    plan = plan_graph(analysis, name=name)
+    if plan.over_budget(hbm_budget):
+        mib = 1 << 20
+        diagnostics.append(Diagnostic(
+            code="hbm-budget", severity=SEVERITY_ERROR,
+            node_id=plan.peak_node, operator="",
+            message=(
+                f"static HBM plan peaks at "
+                f"{plan.fit_peak_nbytes / mib:.2f} MiB "
+                f"(node {plan.peak_node}) > budget "
+                f"{float(hbm_budget) / mib:.2f} MiB — the fit would "
+                "violate its budget at runtime; shrink the resident "
+                "working set (stream the fit, reduce chunk/prefetch "
+                "geometry, cache fewer intermediates)")))
+    return AnalysisReport(name, analysis, diagnostics, plan=plan)
 
 
 def check_pipeline(pipeline, sample: Any = None,
-                   name: str = "pipeline") -> AnalysisReport:
+                   name: str = "pipeline",
+                   hbm_budget: Optional[float] = None) -> AnalysisReport:
     """``Pipeline.check``'s engine: bind ``sample`` (an input spec — see
     ``spec.as_input_spec``) to the pipeline's dangling source and check
-    the full graph."""
+    the full graph (lints + static HBM plan, optionally against an
+    ``hbm_budget`` in bytes)."""
     p = pipeline.to_pipeline()
     specs = {}
     if sample is not None:
         specs[p._source] = as_input_spec(sample)
-    return check_graph(p._graph, specs, name=name)
+    return check_graph(p._graph, specs, name=name, hbm_budget=hbm_budget)

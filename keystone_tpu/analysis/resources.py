@@ -1,0 +1,384 @@
+"""Static HBM resource planning over the abstract interpretation.
+
+KeystoneML's optimizer works from *static* information — per-node cost
+models and a budgeted cache planner over the DAG — and this module
+extends the TPU port's abstract interpreter the same way: from the
+shape/dtype specs ``analysis.interpreter`` already infers, plus mesh
+shard geometry and (for streams) chunk geometry, every node gets a
+:class:`ResourceEffect` (output bytes, transient peak, accumulator
+carry) and a topo-order liveness planner folds the effects into a
+per-pipeline :class:`HbmPlan` — the pipeline's peak device footprint,
+known before a single buffer is allocated.
+
+The streaming model mirrors the runtime ``_Residency`` ledger
+(``parallel/streaming.py``) charge for charge, so the static plan is an
+*upper bound* the measured ``peak_device_nbytes`` can be validated
+against (bench emits ``plan_vs_measured``):
+
+* ``prefetch_depth`` staged chunks at their WIRE dtype (the slot-gated
+  buffer),
+* one working chunk at its POST-cast compute dtype,
+* one transient wire-width chunk while the fused on-device cast runs
+  (the wire and compute copies briefly co-exist).
+
+Resident datasets charge ``padded_rows(n) * element_nbytes`` (the shard
+pad is real HBM); host datasets charge zero device bytes; estimator
+nodes charge their accumulator carry (Gram/cross/moments — resident
+solves materialize the same Gram workspace) as a transient and their
+fitted model as the output that stays live.
+
+Entry points: ``plan_graph`` (used by ``check_graph`` /
+``Pipeline.check(sample, hbm_budget=...)``), the ``check --budget``
+CLI (exit 2 on a predicted violation), and
+``StreamingDataset.static_plan_nbytes()`` (the double-checked budget in
+``fit_streaming`` — see PERFORMANCE.md "plan HBM statically").
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..workflow.graph_ids import GraphId, NodeId, SinkId, SourceId
+from .spec import (
+    DatasetSpec,
+    DatumSpec,
+    SparseSpec,
+    TransformerSpec,
+    Unknown,
+    element_feature_dim,
+)
+
+
+# -- stream geometry ---------------------------------------------------------
+
+@dataclass(frozen=True)
+class StreamGeometry:
+    """Static chunk geometry of one ``StreamingDataset`` — everything
+    the planner needs to reproduce the runtime residency ledger's
+    charges without consuming the stream."""
+
+    chunk_rows: int          # padded rows per staged chunk (shard-rounded)
+    prefetch_depth: int
+    wire_row_nbytes: float   # bytes/row at the wire dtype (as staged)
+    work_row_nbytes: float   # bytes/row at the compute dtype (post-cast)
+    cast: bool = False       # True when wire dtype != compute dtype
+    #: True on specs propagated THROUGH a stream-consuming node: the
+    #: residency ledger is shared with the root stream, so a derived
+    #: view must not re-charge the same buffer to the plan
+    shared: bool = False
+
+    def as_shared(self) -> "StreamGeometry":
+        import dataclasses
+
+        return dataclasses.replace(self, shared=True)
+
+    def staged_chunk_nbytes(self) -> float:
+        return float(self.chunk_rows) * self.wire_row_nbytes
+
+    def working_chunk_nbytes(self) -> float:
+        return float(self.chunk_rows) * self.work_row_nbytes
+
+    def plan_nbytes(self) -> float:
+        """Static residency bound for one live iteration of the stream,
+        mirroring ``_Residency``: ``depth`` staged wire-width chunks +
+        one post-cast working chunk + one transient wire chunk during
+        the cast. With no wire narrowing this is the documented
+        ``(prefetch_depth + 1) * chunk_nbytes`` budget unit."""
+        staged = self.staged_chunk_nbytes()
+        transient = staged if self.cast else 0.0
+        return (self.prefetch_depth * staged
+                + self.working_chunk_nbytes() + transient)
+
+
+# -- per-node effects --------------------------------------------------------
+
+@dataclass(frozen=True)
+class ResourceEffect:
+    """One node's static device-memory contribution.
+
+    ``out_nbytes`` stays live until the node's last consumer runs (or
+    forever, for sink-held values); ``transient_nbytes`` is charged only
+    while the node itself executes (solver workspace, cast co-existence);
+    ``carry_nbytes`` is the accumulator a streamed fit keeps resident
+    across the whole chunk loop (charged like a transient of the fit
+    node, reported separately); ``item_nbytes`` is the per-item
+    activation size when the collection size ``n`` is unknown (the apply
+    path's unit of residency). ``resolved`` is False when the spec did
+    not determine the bytes (Unknown elements, unannotated estimators) —
+    the planner charges zero and lists the node as unresolved rather
+    than inventing a number."""
+
+    out_nbytes: float = 0.0
+    transient_nbytes: float = 0.0
+    carry_nbytes: float = 0.0
+    item_nbytes: Optional[float] = None
+    resolved: bool = True
+    note: str = ""
+
+
+def element_nbytes(element: Any) -> Optional[float]:
+    """Bytes of one item described by an element spec, or None when any
+    leaf is opaque (Unknown) or sparse (density not static)."""
+    import jax
+    import numpy as np
+
+    total = 0.0
+    for leaf in jax.tree_util.tree_leaves(
+            element,
+            is_leaf=lambda x: isinstance(
+                x, (Unknown, SparseSpec, jax.ShapeDtypeStruct))):
+        if not isinstance(leaf, jax.ShapeDtypeStruct):
+            return None
+        total += float(math.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+    return total
+
+
+def padded_rows(n: int, shards: int) -> int:
+    """Rows a resident batch of ``n`` items occupies after shard
+    padding (re-exported from ``parallel.dataset`` — one source of the
+    arithmetic, so the plan charges exactly what the sharder pads)."""
+    from ..parallel.dataset import padded_rows as _rows
+
+    return _rows(n, shards)
+
+
+def spec_effect(spec: Any, data_shards: int) -> ResourceEffect:
+    """Default resource derivation from a node's output spec."""
+    if isinstance(spec, DatasetSpec):
+        if spec.streaming:
+            geom = spec.geometry
+            if geom is None:
+                return ResourceEffect(
+                    resolved=False,
+                    note="streaming dataset with opaque chunk geometry")
+            if geom.shared:
+                # a derived view: the prefetch buffer + raw working
+                # chunk were already charged at the root stream's node;
+                # what is NEW here is one transformed chunk (the ledger
+                # does not track it, real HBM does)
+                per_item = element_nbytes(spec.element)
+                if per_item is None:
+                    return ResourceEffect(
+                        resolved=False,
+                        note="stream view with unsized transformed "
+                             "element (buffer charged at the root)")
+                return ResourceEffect(
+                    out_nbytes=float(geom.chunk_rows) * per_item,
+                    note="stream view (buffer charged at the root; "
+                         "one transformed chunk here)")
+            return ResourceEffect(out_nbytes=geom.plan_nbytes(),
+                                  note="stream residency bound")
+        per_item = element_nbytes(spec.element)
+        if spec.host:
+            return ResourceEffect(
+                out_nbytes=0.0, item_nbytes=per_item,
+                note="host-resident (zero device bytes)")
+        if per_item is None:
+            return ResourceEffect(resolved=False,
+                                  note="element not fully specified")
+        if spec.n is None:
+            # apply-path collection of unknown size: charge nothing to
+            # the fit peak, report the per-item activation instead
+            return ResourceEffect(out_nbytes=0.0, item_nbytes=per_item,
+                                  note="n unknown (per-item only)")
+        return ResourceEffect(
+            out_nbytes=float(padded_rows(spec.n, data_shards)) * per_item)
+    if isinstance(spec, DatumSpec):
+        per = element_nbytes(spec.element)
+        if per is None:
+            return ResourceEffect(resolved=False,
+                                  note="datum element not specified")
+        return ResourceEffect(out_nbytes=per, item_nbytes=per)
+    if isinstance(spec, TransformerSpec):
+        # fitted-model bytes come from the estimator node's own effect;
+        # a bare TransformerSpec (saved state) charges nothing
+        return ResourceEffect(out_nbytes=0.0, note="transformer")
+    return ResourceEffect(resolved=False, note="unknown spec")
+
+
+# -- estimator annotations (shared size helpers) -----------------------------
+
+def _data_label_dims(dep_specs: Sequence[Any]):
+    d = element_feature_dim(dep_specs[0]) if dep_specs else None
+    k = (element_feature_dim(dep_specs[1])
+         if len(dep_specs) > 1 else None)
+    return d, k
+
+
+def gram_carry_nbytes(dep_specs: Sequence[Any]) -> Optional[float]:
+    """f32 Gram/cross/sums carry of the least-squares family:
+    ``G (d, d) + C (d, k) + sx (d) + sy (k)`` — also the Gram workspace
+    a resident normal-equations solve materializes."""
+    d, k = _data_label_dims(dep_specs)
+    if d is None:
+        return None
+    k = k or 0
+    return 4.0 * (d * d + d * k + d + k)
+
+
+def linear_model_nbytes(dep_specs: Sequence[Any]) -> Optional[float]:
+    """f32 fitted linear model: weights ``(d, k)`` + intercept ``(k,)``
+    + feature means ``(d,)``."""
+    d, k = _data_label_dims(dep_specs)
+    if d is None or k is None:
+        return None
+    return 4.0 * (d * k + d + k)
+
+
+def moments_carry_nbytes(dep_specs: Sequence[Any]) -> Optional[float]:
+    """Column-moment carry (sums + sums-of-squares) of the scaler."""
+    d, _ = _data_label_dims(dep_specs)
+    return None if d is None else 2.0 * 4.0 * d
+
+
+def estimator_resource_effect(estimator: Any,
+                              dep_specs: Sequence[Any]) -> ResourceEffect:
+    """Effect of an estimator node: the fitted model is the output that
+    stays live; the accumulator carry (equivalently, the resident
+    solver's Gram workspace) is transient across the fit. Estimators
+    declare sizes via optional ``carry_nbytes(dep_specs)`` /
+    ``fitted_nbytes(dep_specs)`` hooks; undeclared estimators resolve to
+    zero bytes but are listed as unresolved."""
+    carry_fn = getattr(estimator, "carry_nbytes", None)
+    fitted_fn = getattr(estimator, "fitted_nbytes", None)
+    carry = carry_fn(dep_specs) if callable(carry_fn) else None
+    fitted = fitted_fn(dep_specs) if callable(fitted_fn) else None
+    declared = callable(carry_fn) or callable(fitted_fn)
+    resolved = declared and not (
+        (callable(carry_fn) and carry is None)
+        or (callable(fitted_fn) and fitted is None))
+    return ResourceEffect(
+        out_nbytes=float(fitted or 0.0),
+        carry_nbytes=float(carry or 0.0),
+        resolved=resolved,
+        note=("" if declared
+              else "estimator declares no carry/fitted size"))
+
+
+# -- the plan ----------------------------------------------------------------
+
+@dataclass
+class HbmPlan:
+    """One pipeline's static HBM plan.
+
+    ``fit_peak_nbytes`` is the liveness peak over the full (fit-path)
+    graph: at every topo step, the sum of all still-live outputs plus
+    the executing node's transient and carry. ``model_nbytes`` is the
+    persistent fitted-state footprint (the apply path's resident cost);
+    ``apply_item_nbytes`` the widest per-item activation along the
+    unknown-``n`` apply path (serving residency ≈ ``model_nbytes`` +
+    batch × ``apply_item_nbytes``). Nodes whose bytes could not be
+    derived are charged zero and listed in ``unresolved`` — the plan is
+    a bound over what the analyzer can see, never an invention."""
+
+    name: str
+    entries: List[Dict[str, Any]] = field(default_factory=list)
+    fit_peak_nbytes: float = 0.0
+    peak_node: Optional[int] = None
+    model_nbytes: float = 0.0
+    apply_item_nbytes: float = 0.0
+    unresolved: List[str] = field(default_factory=list)
+
+    def over_budget(self, budget: Optional[float]) -> bool:
+        return budget is not None and self.fit_peak_nbytes > float(budget)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "fit_peak_nbytes": self.fit_peak_nbytes,
+            "peak_node": self.peak_node,
+            "model_nbytes": self.model_nbytes,
+            "apply_item_nbytes": self.apply_item_nbytes,
+            "unresolved": list(self.unresolved),
+            "entries": list(self.entries),
+        }
+
+    def summary(self) -> str:
+        mib = 1 << 20
+        lines = [
+            f"static HBM plan {self.name!r}: fit peak "
+            f"{self.fit_peak_nbytes / mib:.2f} MiB"
+            + (f" @ node {self.peak_node}"
+               if self.peak_node is not None else "")
+            + f", fitted models {self.model_nbytes / mib:.2f} MiB, "
+            f"apply {self.apply_item_nbytes / 1024.0:.1f} KiB/item"]
+        if self.unresolved:
+            lines.append(
+                f"  unresolved ({len(self.unresolved)}): "
+                + ", ".join(self.unresolved[:6])
+                + (" ..." if len(self.unresolved) > 6 else ""))
+        return "\n".join(lines)
+
+
+def plan_graph(analysis: Any, name: str = "graph",
+               data_shards: Optional[int] = None) -> HbmPlan:
+    """Fold per-node :class:`ResourceEffect`\\ s into an :class:`HbmPlan`
+    by liveness over the deterministic topo order (``Graph.linearize``):
+    a node's output is charged from its step until its last consumer's
+    step (sink-held values stay live to the end), its transient and
+    carry only at its own step. Device-free by construction — only
+    specs and integer geometry are read."""
+    if data_shards is None:
+        try:
+            from ..parallel.mesh import get_mesh, num_data_shards
+
+            data_shards = num_data_shards(get_mesh())
+        except Exception:
+            data_shards = 1
+    graph = analysis.graph
+    order = [g for g in graph.linearize() if not isinstance(g, SinkId)]
+    pos = {gid: i for i, gid in enumerate(order)}
+    last_use: Dict[GraphId, int] = {}
+    for n in graph.nodes:
+        for d in graph.get_dependencies(n):
+            if d in pos:
+                last_use[d] = max(last_use.get(d, -1), pos[n])
+    sink_held = {graph.get_sink_dependency(k) for k in graph.sinks}
+
+    plan = HbmPlan(name)
+    live: Dict[GraphId, float] = {}
+    for i, gid in enumerate(order):
+        spec = analysis.value(gid)
+        derived = spec_effect(spec, data_shards)
+        eff = derived
+        label = "Source"
+        if isinstance(gid, NodeId):
+            op = graph.get_operator(gid)
+            label = op.label()
+            dep_specs = [analysis.value(d)
+                         for d in graph.get_dependencies(gid)]
+            override = op.resource_effect(dep_specs, spec)
+            if override is not None:
+                eff = override
+        live[gid] = eff.out_nbytes
+        step = sum(live.values()) + eff.transient_nbytes + eff.carry_nbytes
+        if step > plan.fit_peak_nbytes:
+            plan.fit_peak_nbytes = step
+            plan.peak_node = gid.id
+        if eff.carry_nbytes or (isinstance(gid, NodeId) and isinstance(
+                spec, TransformerSpec)):
+            plan.model_nbytes += eff.out_nbytes
+        if eff.item_nbytes:
+            plan.apply_item_nbytes = max(plan.apply_item_nbytes,
+                                         eff.item_nbytes)
+        if not eff.resolved:
+            plan.unresolved.append(f"node {gid.id} [{label}]"
+                                   + (f": {eff.note}" if eff.note else ""))
+        plan.entries.append({
+            "node_id": gid.id,
+            "operator": label,
+            "out_nbytes": eff.out_nbytes,
+            "transient_nbytes": eff.transient_nbytes,
+            "carry_nbytes": eff.carry_nbytes,
+            "item_nbytes": eff.item_nbytes,
+            "live_nbytes": step,
+            "resolved": eff.resolved,
+            "note": eff.note,
+        })
+        # release every value whose last consumer just ran
+        for d in [d for d in live
+                  if d not in sink_held and last_use.get(d, -1) <= i
+                  and d is not gid]:
+            del live[d]
+    return plan

@@ -89,6 +89,11 @@ class DatasetSpec(AbstractValue):
     back to f32 on device). The element always reports what CONSUMERS
     see post-cast, so narrowness-on-the-wire is visible to tooling
     without ever tripping the ``dtype-narrowing`` lint.
+
+    ``geometry`` (streams only) carries the static chunk geometry
+    (:class:`~keystone_tpu.analysis.resources.StreamGeometry`) the HBM
+    planner folds into the pipeline plan; None for opaque sources whose
+    chunk shape cannot be described without consuming the stream.
     """
 
     element: Any
@@ -97,6 +102,7 @@ class DatasetSpec(AbstractValue):
     sparsity: Optional[float] = None
     streaming: bool = False
     wire_dtype: Optional[str] = None
+    geometry: Optional[Any] = None
 
     def __repr__(self) -> str:
         flag = ", streaming" if self.streaming else ""
@@ -198,7 +204,8 @@ def dataset_spec(ds: Dataset) -> AbstractValue:
         return DatasetSpec(
             element, n=ds.n, host=False,
             sparsity=None if element_has_unknown(element) else 1.0,
-            streaming=True, wire_dtype=ds.wire_dtype_name())
+            streaming=True, wire_dtype=ds.wire_dtype_name(),
+            geometry=ds.plan_geometry())
     if isinstance(ds, HostDataset):
         items = ds.items
         if not items:

@@ -122,6 +122,19 @@ def _fisher_abstract_fit(k: int):
     return apply_element
 
 
+def _fisher_fitted_nbytes(k: int, dep_specs):
+    """Fitted GMM: means + covariances (D, K) f32 each + weights (K,),
+    D from the input element's descriptor axis."""
+    import jax
+
+    element = getattr(dep_specs[0], "element", None) if dep_specs else None
+    if not (isinstance(element, jax.ShapeDtypeStruct)
+            and len(element.shape) == 2):
+        return None
+    d = float(element.shape[0])
+    return 4.0 * (2.0 * d * k + k)
+
+
 class ScalaGMMFisherVectorEstimator(Estimator):
     """Per-item-jit FV estimator (reference ``FisherVector.scala:67-73``;
     the name mirrors the reference's scala implementation)."""
@@ -131,6 +144,10 @@ class ScalaGMMFisherVectorEstimator(Estimator):
 
     def abstract_fit(self, dep_specs):
         return _fisher_abstract_fit(self.k)
+
+    # -- static HBM planning (analysis.resources) --------------------------
+    def fitted_nbytes(self, dep_specs):
+        return _fisher_fitted_nbytes(self.k, dep_specs)
 
     def _fit(self, ds: Dataset) -> FisherVector:
         return FisherVector(_gmm_from_columns(ds, self.k))
@@ -152,6 +169,10 @@ class GMMFisherVectorEstimator(OptimizableEstimator):
 
     def abstract_fit(self, dep_specs):
         return _fisher_abstract_fit(self.k)
+
+    # -- static HBM planning (analysis.resources) --------------------------
+    def fitted_nbytes(self, dep_specs):
+        return _fisher_fitted_nbytes(self.k, dep_specs)
 
     @property
     def default(self) -> Estimator:

@@ -85,6 +85,12 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
 
         return labels_width_fit(dep_specs)
 
+    # -- static HBM planning (analysis.resources) --------------------------
+    def fitted_nbytes(self, dep_specs):
+        from ...analysis.resources import linear_model_nbytes
+
+        return linear_model_nbytes(dep_specs)
+
     def _fit(self, ds: Dataset, labels: Dataset) -> BlockLinearMapper:
         ds, labels = ensure_array(ds), ensure_array(labels)
         return self._fit_sharded(ds, labels)

@@ -265,6 +265,19 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
 
         return labels_width_fit(dep_specs)
 
+    # -- static HBM planning (analysis.resources) --------------------------
+    def carry_nbytes(self, dep_specs):
+        # every gram-capable candidate finalizes from the one shared
+        # Gram/cross carry, so the carry bound is solver-independent
+        from ...analysis.resources import gram_carry_nbytes
+
+        return gram_carry_nbytes(dep_specs)
+
+    def fitted_nbytes(self, dep_specs):
+        from ...analysis.resources import linear_model_nbytes
+
+        return linear_model_nbytes(dep_specs)
+
     def _fit(self, ds: Dataset, labels: Dataset):
         # fallback path when the node-level optimizer has not sampled:
         # densify host sparse data for the dense default

@@ -160,6 +160,17 @@ class LinearMapEstimator(LabelEstimator):
 
         return labels_width_fit(dep_specs)
 
+    # -- static HBM planning (analysis.resources) --------------------------
+    def carry_nbytes(self, dep_specs):
+        from ...analysis.resources import gram_carry_nbytes
+
+        return gram_carry_nbytes(dep_specs)
+
+    def fitted_nbytes(self, dep_specs):
+        from ...analysis.resources import linear_model_nbytes
+
+        return linear_model_nbytes(dep_specs)
+
     # -- streaming fit (accumulate/finalize protocol) ----------------------
     def accumulate(self, carry, chunk, labels):
         """One chunk's contribution to the raw Gram/cross/sum carry (the
@@ -293,6 +304,15 @@ def _gram_carry_update_impl(G, C, sx, sy, X, Y):
             sx + jnp.sum(X, axis=0), sy + jnp.sum(Y, axis=0))
 
 
+def _carry_probe(d: int = 8, k: int = 3, n: int = 16):
+    """Tiny shape witness for the donation gate: every donated carry
+    piece must have a shape-compatible output (checked abstractly by
+    ``utils.donation.donation_shape_mismatches`` — see tools/lint.py)."""
+    S, f32 = jax.ShapeDtypeStruct, np.float32
+    return ((S((d, d), f32), S((d, k), f32), S((d,), f32), S((k,), f32),
+             S((n, d), f32), S((n, k), f32)), {})
+
+
 #: The per-chunk carry update DONATES the carry buffers (G, C, sx, sy):
 #: XLA writes the updated carry into the old carry's HBM instead of
 #: allocating a fresh (d, d) + (d, k) pair per chunk — a streamed fit
@@ -302,7 +322,8 @@ def _gram_carry_update_impl(G, C, sx, sy, X, Y):
 #: (``fit_streaming``'s loop reassigns immediately, and checkpointing
 #: copies the carry to host BEFORE the next accumulate donates it).
 _gram_carry_update = donating_jit(
-    _gram_carry_update_impl, donate_argnums=(0, 1, 2, 3))
+    _gram_carry_update_impl, donate_argnums=(0, 1, 2, 3),
+    probe=_carry_probe)
 
 
 def accumulate_gram_carry(carry, chunk, labels):
@@ -341,13 +362,21 @@ def _finalize_normal_equations_impl(G, C, sx, sy, n, lam):
         return x_mean, y_mean, linalg.ridge_cho_solve(Gc, Cc, lam)
 
 
+def _finalize_probe(d: int = 8, k: int = 3):
+    S, f32 = jax.ShapeDtypeStruct, np.float32
+    return ((S((d, d), f32), S((d, k), f32), S((d,), f32), S((k,), f32),
+             S((), f32), S((), f32)), {})
+
+
 #: finalize consumes the carry: donate the pieces with a
 #: SHAPE-COMPATIBLE output — C (d,k) -> W, sx -> x_mean, sy -> y_mean.
 #: G (d,d) matches no output, so donating it cannot be honored and
 #: would only emit jax's donated-buffer-not-usable warning per compile
-#: on the backends where donation is real.
+#: on the backends where donation is real (pinned: the probe makes this
+#: a static gate, tests/test_analysis_passes.py a no-warnings test).
 _finalize_normal_equations = donating_jit(
-    _finalize_normal_equations_impl, donate_argnums=(1, 2, 3))
+    _finalize_normal_equations_impl, donate_argnums=(1, 2, 3),
+    probe=_finalize_probe)
 
 
 def _gram_bcd_impl(G, C, sx, sy, n, lam, bounds, num_iter):
@@ -389,6 +418,13 @@ def _gram_bcd_impl(G, C, sx, sy, n, lam, bounds, num_iter):
         return tuple(W[lo:hi] for lo, hi in bounds), x_mean, y_mean
 
 
+def _gram_bcd_probe(d: int = 8, k: int = 3):
+    S, f32 = jax.ShapeDtypeStruct, np.float32
+    return ((S((d, d), f32), S((d, k), f32), S((d,), f32), S((k,), f32),
+             S((), f32), S((), f32)),
+            {"bounds": ((0, 4), (4, 8)), "num_iter": 1})
+
+
 #: the Gram-form BCD finalize donates the carry pieces XLA can actually
 #: reuse: sx -> x_mean, sy -> y_mean. G (d,d) and C (d,k) match no
 #: output (the weights come back as per-block slices), so donating them
@@ -396,7 +432,7 @@ def _gram_bcd_impl(G, C, sx, sy, n, lam, bounds, num_iter):
 #: ``_finalize_normal_equations``.
 _gram_bcd = donating_jit(
     _gram_bcd_impl, donate_argnums=(2, 3),
-    static_argnames=("bounds", "num_iter"))
+    static_argnames=("bounds", "num_iter"), probe=_gram_bcd_probe)
 
 
 @jax.jit
@@ -582,6 +618,17 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         from ...analysis.spec import labels_width_fit
 
         return labels_width_fit(dep_specs)
+
+    # -- static HBM planning (analysis.resources) --------------------------
+    def carry_nbytes(self, dep_specs):
+        from ...analysis.resources import gram_carry_nbytes
+
+        return gram_carry_nbytes(dep_specs)
+
+    def fitted_nbytes(self, dep_specs):
+        from ...analysis.resources import linear_model_nbytes
+
+        return linear_model_nbytes(dep_specs)
 
     # -- streaming fit (accumulate/finalize protocol) ----------------------
     def accumulate(self, carry, chunk, labels):

@@ -106,6 +106,19 @@ class _PcaAbstractFitMixin:
 
         return apply_element
 
+    # -- static HBM planning (analysis.resources) --------------------------
+    def fitted_nbytes(self, dep_specs):
+        """Fitted projection matrix: (d, dims) f32, d = the input
+        element's leading (descriptor) axis."""
+        import jax
+
+        element = getattr(dep_specs[0], "element", None) if dep_specs \
+            else None
+        if not (isinstance(element, jax.ShapeDtypeStruct)
+                and element.shape):
+            return None
+        return 4.0 * float(element.shape[0]) * self.dims
+
 
 class PCAEstimator(_PcaAbstractFitMixin, Estimator):
     """Local PCA: collect the (sampled) data, center, SVD

@@ -190,6 +190,18 @@ class StandardScaler(Estimator):
 
         return identity_fit(dep_specs)
 
+    # -- static HBM planning (analysis.resources) --------------------------
+    def carry_nbytes(self, dep_specs):
+        from ...analysis.resources import moments_carry_nbytes
+
+        return moments_carry_nbytes(dep_specs)
+
+    def fitted_nbytes(self, dep_specs):
+        from ...analysis.resources import moments_carry_nbytes
+
+        # fitted model = mean + std, same footprint as the moment carry
+        return moments_carry_nbytes(dep_specs)
+
     def _fit(self, ds: Dataset) -> StandardScalerModel:
         assert isinstance(ds, ArrayDataset), "StandardScaler needs array data"
         s, sq = _moments(ds.data)
@@ -242,11 +254,19 @@ def _accum_moments_impl(S, SQ, X):
 
 from ...utils.donation import donating_jit  # noqa: E402
 
+
+def _moments_probe(d: int = 8, n: int = 16):
+    S, f32 = jax.ShapeDtypeStruct, np.float32
+    return ((S((d,), f32), S((d,), f32), S((n, d), f32)), {})
+
+
 #: the streamed moment carry donates (S, SQ): the per-chunk update
 #: writes into the old moment buffers instead of reallocating them —
 #: same in-place discipline as the least-squares Gram carry
-#: (``nodes.learning.linear._gram_carry_update``)
-_accum_moments = donating_jit(_accum_moments_impl, donate_argnums=(0, 1))
+#: (``nodes.learning.linear._gram_carry_update``). The probe keeps the
+#: donation shape-compatible under the static gate (tools/lint.py).
+_accum_moments = donating_jit(_accum_moments_impl, donate_argnums=(0, 1),
+                              probe=_moments_probe)
 
 
 from ...workflow.transformer import HostTransformer  # noqa: E402

@@ -124,6 +124,10 @@ class PipelineTrace:
         self.chunk_stats: Dict[str, float] = {
             "count": 0, "ingest_stall_s": 0.0, "nbytes": 0.0,
             "occupancy_sum": 0.0, "h2d_bytes": 0.0}
+        #: one entry per streamed fit: the static HBM plan next to the
+        #: measured residency peak, so the planner model is continuously
+        #: validated by every traced out-of-core fit
+        self.streamed_fits: List[Dict[str, Any]] = []
         #: resilience events (retries, quarantines, checkpoint
         #: saves/restores, watchdog trips, injected faults) — same
         #: bounded-tail-plus-exact-counts shape as ``chunks``
@@ -233,6 +237,24 @@ class PipelineTrace:
         if len(self.chunks) > self.CHUNK_TAIL:
             del self.chunks[: len(self.chunks) - self.CHUNK_TAIL]
 
+    #: raw streamed-fit entries retained (same bounded-tail discipline
+    #: as ``chunks``/``resilience`` — a long-lived retrain loop under
+    #: one trace must not grow it without bound)
+    STREAMED_FIT_TAIL = 512
+
+    def record_streamed_fit(self, entry: Dict[str, Any]) -> None:
+        """One completed streamed fit (``parallel.streaming``): source
+        tag, chunk count, ``static_plan_nbytes`` (the device-free
+        residency bound the planner computed — None for opaque
+        sources), the ledger's measured ``peak_device_nbytes``, and the
+        asserted ``hbm_budget`` if any. ``static_plan_nbytes >=
+        peak_device_nbytes`` is the planner's correctness contract;
+        bench reports the ratio as ``plan_vs_measured``."""
+        self.streamed_fits.append(entry)
+        if len(self.streamed_fits) > self.STREAMED_FIT_TAIL:
+            del self.streamed_fits[: len(self.streamed_fits)
+                                   - self.STREAMED_FIT_TAIL]
+
     #: raw resilience entries retained (per-event counts in
     #: ``resilience_stats`` stay exact)
     RESILIENCE_TAIL = 512
@@ -282,6 +304,7 @@ class PipelineTrace:
             "solver_decisions": list(self.solver_decisions),
             "chunks": list(self.chunks),
             "chunk_stats": dict(self.chunk_stats),
+            "streamed_fits": list(self.streamed_fits),
             "resilience": list(self.resilience),
             "resilience_stats": dict(self.resilience_stats),
         }
@@ -317,6 +340,7 @@ class PipelineTrace:
             }
         if stats is not None:
             tr.chunk_stats = dict(stats)
+        tr.streamed_fits = list(data.get("streamed_fits", []))
         tr.resilience = list(data.get("resilience", []))
         tr.resilience_stats = dict(data.get("resilience_stats", {}))
         if not tr.resilience_stats and tr.resilience:  # older artifact
@@ -375,6 +399,20 @@ class PipelineTrace:
                 f"h2d {h2d / (1 << 20):.1f} MiB, "
                 f"mean prefetch occupancy "
                 f"{self.chunk_stats['occupancy_sum'] / count:.2f}")
+        for sf in self.streamed_fits:
+            plan = sf.get("static_plan_nbytes")
+            peak = float(sf.get("peak_device_nbytes", 0.0))
+            mib = 1 << 20
+            if plan is None:
+                shown = "plan n/a (opaque source)"
+            else:
+                ratio = (plan / peak) if peak else float("inf")
+                shown = (f"plan {plan / mib:.2f} MiB, "
+                         f"plan/measured {ratio:.2f}")
+            lines.append(
+                f"streamed fit [{sf.get('source')}]: "
+                f"{sf.get('chunks', 0)} chunk(s), measured peak "
+                f"{peak / mib:.2f} MiB, {shown}")
         if self.resilience_stats:
             counts = " ".join(
                 f"{k}={int(v)}" for k, v in sorted(

@@ -188,9 +188,18 @@ def as_dataset(data: Any, mesh: Optional[Mesh] = None) -> Dataset:
 
 # -- internals ------------------------------------------------------------
 
+def padded_rows(n: int, shards: int) -> int:
+    """Rows a resident batch of ``n`` items occupies after padding to a
+    shard multiple — the single source of the padding arithmetic, shared
+    by the runtime sharder below and the static HBM planner
+    (``analysis.resources``), so plans charge exactly the rows the
+    device will hold."""
+    shards = max(int(shards), 1)
+    return max(((int(n) + shards - 1) // shards) * shards, shards)
+
+
 def _padded_rows(n: int, mesh: Mesh) -> int:
-    k = num_data_shards(mesh)
-    return max(((n + k - 1) // k) * k, k)
+    return padded_rows(n, num_data_shards(mesh))
 
 
 def _shard_pytree(data: Any, n: int, mesh: Mesh) -> Any:

@@ -92,6 +92,20 @@ def num_data_shards(mesh: Optional[Mesh] = None) -> int:
     return mesh.shape[DATA_AXIS]
 
 
+def replication_factor(mesh: Optional[Mesh] = None) -> int:
+    """How many replicas of a ``P('data')``-sharded batch the mesh
+    holds: the product of the non-data axis sizes. Each replica is its
+    own host->device transfer, so wire-byte accounting (the streaming
+    ``h2d_bytes`` counter and the static planner's wire model) scales by
+    this factor while the LOGICAL array footprint does not."""
+    mesh = mesh or get_mesh()
+    rep = 1
+    for name, size in dict(mesh.shape).items():
+        if name != DATA_AXIS:
+            rep *= int(size)
+    return rep
+
+
 def batch_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
     """Sharding for a batch-major array: rows split over the data axis."""
     mesh = mesh or get_mesh()

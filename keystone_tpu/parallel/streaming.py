@@ -88,6 +88,7 @@ from .mesh import (
     h2d_pool as _h2d_pool,
     h2d_workers,
     num_data_shards,
+    replication_factor,
     shard_put,
 )
 
@@ -339,6 +340,9 @@ class StreamingDataset(Dataset):
             compute_dtype=self.compute_dtype,
             _transforms=self._transforms + (transform,))
         out._residency = self._residency  # shared budget accounting
+        # the static plan follows the shared ledger: a derived view's
+        # residency IS the root's prefetch pipeline
+        out.__dict__["_plan_geometry"] = self.plan_geometry
         return out
 
     def map(self, fn: Callable[[Any], Any]) -> "StreamingDataset":
@@ -400,10 +404,7 @@ class StreamingDataset(Dataset):
             # bytes that actually cross the host->device link: a
             # P('data') batch replicates each row shard across the
             # non-data mesh axes, so every replica is its own transfer
-            replication = 1
-            for name, size in dict(self.mesh.shape).items():
-                if name != DATA_AXIS:
-                    replication *= int(size)
+            replication = replication_factor(self.mesh)
             h2d_bytes = 0.0
             work_nbytes = 0.0
             needs_cast = False
@@ -652,6 +653,61 @@ class StreamingDataset(Dataset):
         """High-water mark of the stream's device residency (shared
         across a root stream and its derived views)."""
         return self._residency.peak
+
+    # -- static HBM planning (analysis.resources) --------------------------
+    def plan_geometry(self):
+        """Static chunk geometry
+        (:class:`~keystone_tpu.analysis.resources.StreamGeometry`) when
+        the source's element can be described without consuming the
+        stream, else None. Derived (mapped) views delegate to their
+        ROOT: the residency ledger is shared, so the plan must describe
+        the one real prefetch pipeline regardless of which handle the
+        caller kept."""
+        root_fn = self.__dict__.get("_plan_geometry")
+        if root_fn is not None:
+            return root_fn()
+        probe = getattr(self, "_element_probe", None)
+        if probe is None:
+            return None
+        el = probe()
+        from ..analysis.spec import element_has_unknown
+
+        if el is None or element_has_unknown(el):
+            return None
+        leaves, treedef = jax.tree_util.tree_flatten(el)
+        try:
+            wire_t = _policy_leaves(self.wire_dtype, treedef, len(leaves))
+            comp_t = _policy_leaves(self.compute_dtype, treedef,
+                                    len(leaves))
+        except ValueError:
+            return None  # structure mismatch raises at stage time
+        wire_row = work_row = 0.0
+        cast = False
+        for s, wire, comp in zip(leaves, wire_t, comp_t):
+            size = float(np.prod(s.shape)) if s.shape else 1.0
+            source = np.dtype(s.dtype)
+            wd = wire if wire is not None else source
+            cd = comp if comp is not None else source
+            wire_row += size * np.dtype(wd).itemsize
+            work_row += size * np.dtype(cd).itemsize
+            cast = cast or np.dtype(cd) != np.dtype(wd)
+        from ..analysis.resources import StreamGeometry
+
+        return StreamGeometry(
+            chunk_rows=self.chunk_size, prefetch_depth=self.prefetch_depth,
+            wire_row_nbytes=wire_row, work_row_nbytes=work_row, cast=cast)
+
+    def static_plan_nbytes(self) -> Optional[float]:
+        """Device-free residency bound for one live iteration of this
+        stream — ``prefetch_depth`` staged wire-width chunks + one
+        post-cast working chunk + one transient wire chunk during the
+        cast — charging exactly what the runtime ``_Residency`` ledger
+        charges, so ``peak_device_nbytes`` can never exceed it.
+        ``fit_streaming`` checks ``hbm_budget`` against this BEFORE the
+        first chunk is staged (budgets are checked twice), and the
+        active trace records it next to the measured peak."""
+        geom = self.plan_geometry()
+        return None if geom is None else geom.plan_nbytes()
 
     # -- element spec (static analysis) ------------------------------------
     def element(self) -> Optional[Any]:
@@ -909,6 +965,23 @@ def fit_streaming(estimator: Any, data: StreamingDataset,
         raise _non_streamable_error(estimator)
     if checkpoint_every is not None and checkpoint_dir is None:
         raise ValueError("checkpoint_every requires checkpoint_dir")
+    # budgets are checked twice (PERFORMANCE.md): the static plan —
+    # depth staged wire chunks + one post-cast working chunk + the cast
+    # transient, exactly what the ledger will charge — rejects a
+    # config that cannot fit BEFORE any chunk is decoded or staged;
+    # the per-chunk runtime assert below stays as the ground truth for
+    # opaque sources the plan cannot describe
+    plan_fn = getattr(data, "static_plan_nbytes", None)
+    static_plan = plan_fn() if callable(plan_fn) else None
+    if (static_plan is not None and hbm_budget is not None
+            and static_plan > hbm_budget):
+        raise MemoryError(
+            f"streamed fit would exceed its HBM budget before any chunk "
+            f"is staged: static plan {static_plan:.0f} B (prefetch_depth "
+            f"x staged chunk + working chunk + cast transient) > "
+            f"{hbm_budget:.0f} B — shrink chunk_size or prefetch_depth "
+            "(PERFORMANCE.md 'plan HBM statically'; `python -m "
+            "keystone_tpu check --budget` predicts this device-free)")
     if quarantine is None:
         # a stream built by a quarantining loader carries its own
         # (stream_tar_images); use it so checkpoints keep the accounting
@@ -963,4 +1036,16 @@ def fit_streaming(estimator: Any, data: StreamingDataset,
     model = estimator.finalize(carry)
     if ckpt is not None:
         ckpt.clear()
+    trace = current_trace()
+    if trace is not None:
+        # close the plan-vs-measured loop: the static plan rides the
+        # trace next to the ledger's measured high-water mark, so every
+        # traced streamed fit continuously validates the planner model
+        trace.record_streamed_fit({
+            "source": data.tag or "stream",
+            "chunks": chunks_seen,
+            "static_plan_nbytes": static_plan,
+            "peak_device_nbytes": float(data.peak_device_nbytes),
+            "hbm_budget": hbm_budget,
+        })
     return model

@@ -28,7 +28,72 @@ save does.
 from __future__ import annotations
 
 import os
-from typing import Any, Callable, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class DonationSite:
+    """One registered ``donating_jit`` call site: the raw impl function,
+    its donated argnums, and an optional shape ``probe`` — a zero-arg
+    callable returning ``(example_args, static_kwargs)`` where
+    ``example_args`` are ``jax.ShapeDtypeStruct``\\ s. Probes let the
+    static gate (``tools/lint.py`` / ``analysis.diagnostics``) verify
+    every donated argument has a shape-compatible output via
+    ``jax.eval_shape`` — device-free, on every backend, instead of a
+    per-compile runtime warning only the TPU path ever printed."""
+
+    fn: Callable
+    donate_argnums: Tuple[int, ...]
+    static_argnames: Tuple[str, ...]
+    probe: Optional[Callable[[], Tuple]]
+    name: str
+    module: str
+
+
+#: every donating_jit wrapper built in this process (import-time append
+#: only — registration never touches jax)
+_DONATION_REGISTRY: List[DonationSite] = []
+
+
+def registered_donations() -> Tuple[DonationSite, ...]:
+    """All donating_jit sites registered so far (the modules defining
+    them must have been imported)."""
+    return tuple(_DONATION_REGISTRY)
+
+
+def donation_shape_mismatches(site: DonationSite) -> List[str]:
+    """Donated argnums of ``site`` with NO shape/dtype-compatible output
+    to be written into, resolved abstractly through ``jax.eval_shape``
+    over the probe's example specs (no device buffer is ever allocated).
+    An incompatible donation is never honored by XLA — it only buys a
+    per-compile "donated buffer not usable" warning — so the static
+    gate treats any mismatch as an error. Sites without a probe return
+    ``[]`` (nothing checkable)."""
+    if site.probe is None:
+        return []
+    import jax
+    import numpy as np
+
+    probed = site.probe()
+    args, static_kwargs = (probed if isinstance(probed, tuple)
+                           and len(probed) == 2
+                           and isinstance(probed[1], dict)
+                           else (probed, {}))
+    out = jax.eval_shape(lambda *a: site.fn(*a, **static_kwargs), *args)
+    available = [(tuple(l.shape), np.dtype(l.dtype))
+                 for l in jax.tree_util.tree_leaves(out)]
+    mismatches = []
+    for i in sorted(site.donate_argnums):
+        aval = args[i]
+        key = (tuple(aval.shape), np.dtype(aval.dtype))
+        if key in available:
+            available.remove(key)  # one output buffer per donation
+        else:
+            mismatches.append(
+                f"{site.name} arg {i} {key[1].name}{list(key[0])} has no "
+                "shape-compatible output")
+    return mismatches
 
 
 def donation_enabled() -> bool:
@@ -43,12 +108,24 @@ def donation_enabled() -> bool:
 
 
 def donating_jit(fn: Callable, donate_argnums: Sequence[int],
-                 static_argnames: Tuple[str, ...] = ()) -> Callable:
+                 static_argnames: Tuple[str, ...] = (),
+                 probe: Optional[Callable[[], Tuple]] = None) -> Callable:
     """``jax.jit(fn, donate_argnums=...)`` where the backend honors
     donation, plain ``jax.jit(fn)`` otherwise. The choice is made at the
     FIRST call (then memoized), so importing a module full of decorated
-    accumulators never initializes a jax backend."""
+    accumulators never initializes a jax backend.
+
+    ``probe`` (optional, strongly encouraged) registers a
+    shape-compatibility witness for the static donation gate: a zero-arg
+    callable returning ``(example ShapeDtypeStruct args, static
+    kwargs)`` small enough to eval_shape instantly — see
+    :func:`donation_shape_mismatches`."""
     box: dict = {}
+    _DONATION_REGISTRY.append(DonationSite(
+        fn=fn, donate_argnums=tuple(donate_argnums),
+        static_argnames=tuple(static_argnames), probe=probe,
+        name=getattr(fn, "__name__", "donating_jit"),
+        module=getattr(fn, "__module__", "?")))
 
     def wrapper(*args: Any, **kwargs: Any) -> Any:
         jitted = box.get("fn")

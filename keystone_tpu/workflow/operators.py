@@ -46,6 +46,17 @@ def _hashable(v: Any) -> Any:
         return id(v)
 
 
+def _shared_geometry(dataset_specs):
+    """Chunk geometry propagated through a stream-consuming node, marked
+    shared: the derived view rides the ROOT stream's residency ledger,
+    so the HBM planner must not re-charge the prefetch buffer at this
+    node (it charges one transformed chunk instead)."""
+    for d in dataset_specs:
+        if getattr(d, "streaming", False) and d.geometry is not None:
+            return d.geometry.as_shared()
+    return None
+
+
 class Operator:
     """A unit of computation stored at a graph node."""
 
@@ -60,6 +71,17 @@ class Operator:
         from ..analysis.spec import Unknown
 
         return Unknown(f"{type(self).__name__} has no abstract_eval")
+
+    def resource_effect(self, dep_specs: Sequence[Any],
+                        out_spec: Any) -> Any:
+        """Static resource annotation for the HBM planner
+        (``analysis.resources.plan_graph``): return a ``ResourceEffect``
+        describing this node's device-memory contribution, or None to
+        let the planner derive it from ``out_spec`` (output bytes from
+        the dataset/datum element, stream residency from chunk
+        geometry). Estimators override to add their accumulator carry
+        and fitted-model footprint."""
+        return None
 
     def label(self) -> str:
         return type(self).__name__
@@ -208,6 +230,7 @@ class TransformerOperator(Operator):
             sparsity=dense_sparsity(out),
             # mapping a stream yields a stream (chunk-wise application)
             streaming=any(d.streaming for d in datasets),
+            geometry=_shared_geometry(datasets),
         )
 
 
@@ -224,6 +247,19 @@ class EstimatorOperator(Operator):
         )
 
     # -- static analysis ---------------------------------------------------
+    def resource_effect(self, dep_specs: Sequence[Any],
+                        out_spec: Any) -> Any:
+        """Estimator nodes charge their accumulator carry (the Gram /
+        cross / moment buffers a streamed fit keeps resident — the same
+        workspace a resident normal-equations solve materializes) as a
+        transient of the fit step, and the fitted model as the output
+        that stays live. Sizes come from the optional
+        ``carry_nbytes(dep_specs)`` / ``fitted_nbytes(dep_specs)`` hooks
+        concrete estimators declare."""
+        from ..analysis.resources import estimator_resource_effect
+
+        return estimator_resource_effect(self, dep_specs)
+
     def abstract_fit(self, dep_specs: Sequence[Any]):
         """Describe the fitted transformer: return a callable mapping an
         input element spec to the fitted transformer's output element
@@ -279,7 +315,8 @@ class DelegatingOperator(Operator):
             return DatumSpec(out)
         return DatasetSpec(out, n=data[0].n, host=data[0].host,
                            sparsity=dense_sparsity(out),
-                           streaming=data[0].streaming)
+                           streaming=data[0].streaming,
+                           geometry=_shared_geometry([data[0]]))
 
     def label(self) -> str:
         return "Delegate"

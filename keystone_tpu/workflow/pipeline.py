@@ -143,17 +143,23 @@ class Chainable:
             return self.bind(data)
         return self.bind_datum(data)
 
-    def check(self, sample: Any = None, name: str = "pipeline"):
+    def check(self, sample: Any = None, name: str = "pipeline",
+              hbm_budget: Optional[float] = None):
         """Statically check this stage/pipeline: propagate shape/dtype
         specs from ``sample`` (a ``jax.ShapeDtypeStruct``,
         ``(shape, dtype)`` tuple, array, Dataset, or ``analysis`` spec
         describing ONE input item) through every node without touching
-        a device, and run the graph lints. Returns an
-        :class:`~keystone_tpu.analysis.AnalysisReport`; inspect
-        ``report.ok`` / ``report.diagnostics`` / ``report.summary()``."""
+        a device, run the graph lints, and fold per-node resource
+        effects into a static HBM plan (``report.plan``).
+        ``hbm_budget`` (bytes) turns a predicted over-budget fit into an
+        ``hbm-budget`` ERROR diagnostic before anything executes.
+        Returns an :class:`~keystone_tpu.analysis.AnalysisReport`;
+        inspect ``report.ok`` / ``report.diagnostics`` /
+        ``report.plan`` / ``report.summary()``."""
         from ..analysis import check_pipeline
 
-        return check_pipeline(self, sample, name=name)
+        return check_pipeline(self, sample, name=name,
+                              hbm_budget=hbm_budget)
 
 
 class Pipeline(Chainable):

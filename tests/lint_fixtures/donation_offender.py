@@ -1,0 +1,37 @@
+"""Lint fixture: donation-safety offenders, in the bug shapes the
+``use-after-donate`` / ``checkpoint-after-donate`` pass exists to catch.
+
+A ``donating_jit`` argument's buffer is DEAD after the call on TPU/GPU
+— and silently alive on CPU, which is why this class of bug survives a
+CPU test suite and must be caught statically. Parsed (never imported at
+runtime) by tests/test_analysis_passes.py.
+"""
+import jax.numpy as jnp
+
+from keystone_tpu.utils.donation import donating_jit
+
+
+def _update_impl(carry, chunk):
+    return carry + jnp.sum(chunk, axis=0)
+
+
+_update = donating_jit(_update_impl, donate_argnums=(0,))
+
+
+def good_loop(carry, chunks):
+    # the canonical SAFE pattern: the donated name is rebound from the
+    # call's result, so no stale buffer is ever read
+    for chunk in chunks:
+        carry = _update(carry, chunk)
+    return carry
+
+
+def bad_use_after_donate(carry, chunk):
+    out = _update(carry, chunk)
+    return out, carry.sum()  # BUG: `carry`'s buffer is dead here
+
+
+def bad_checkpoint_after_donate(ckpt, carry, chunk):
+    out = _update(carry, chunk)
+    ckpt.save("cursor", carry)  # BUG: snapshots a donated (dead) buffer
+    return out

@@ -1,0 +1,37 @@
+"""Lint fixture: the pre-PR-2 ``_bcd_jit_for`` recompile bug, in its
+original shape — a module-level ``jax.jit`` of a solver that reads the
+ambient global mesh (here one call away, through ``_class_spec``).
+
+jax's trace cache is keyed on the function object plus avals, NOT on
+the ambient mesh the trace bakes in: the first mesh's sharding
+constraints stick to the cached jaxpr, and a fit on a second mesh at
+the same shapes silently reuses them. The fix (today's
+``ops/linalg.py::_bcd_jit_for``) keys the jit per mesh through an
+``lru_cache`` factory taking the mesh as a parameter.
+
+This module exists to be PARSED by tests/test_analysis_passes.py (the
+recompile-hazard pass must fire on it); it is never imported at
+runtime.
+"""
+import jax
+
+from keystone_tpu.parallel.mesh import get_mesh
+
+
+def _class_spec(k):
+    # reads the AMBIENT mesh: whatever mesh is global at trace time
+    # bakes into any jit trace that calls through here
+    mesh = get_mesh()
+    return None if k % 2 else mesh
+
+
+def bcd_core(blocks, Y, lam):
+    spec = _class_spec(Y.shape[1])
+    if spec is not None:
+        Y = jax.lax.with_sharding_constraint(Y, spec)
+    return [b @ Y * lam for b in blocks]
+
+
+# BUG (pre-PR-2 form): one module-lifetime jit whose cached trace bakes
+# the first mesh's constraints — the recompile-hazard lint flags this
+_BCD_JIT = jax.jit(bcd_core)

@@ -1,0 +1,23 @@
+"""Lint fixture: the ``_CAST_JIT_CACHE`` lesson — a compiled program
+memoized on ``self`` with no global cache behind it. Every refit builds
+a fresh instance, so the memo never hits and the program recompiles per
+fit (caught by the verify drive in PR 5, fixed by a module-level
+structure-keyed LruMemo). Parsed only, never imported at runtime.
+"""
+import jax
+
+
+class RefittableStage:
+    def __init__(self, scale):
+        self.scale = scale
+        self._program = None
+
+    def apply(self, x):
+        return x * self.scale
+
+    def batched(self):
+        if self._program is None:
+            # BUG: per-instance memo of a jitted program — a refit
+            # constructs a new instance and recompiles from scratch
+            self._program = jax.jit(jax.vmap(self.apply))
+        return self._program

@@ -1,0 +1,172 @@
+"""Donation-safety and recompile-hazard static passes
+(keystone_tpu/analysis/diagnostics + utils/donation): each rule fires
+on its synthetic offender fixture (tests/lint_fixtures — the pre-PR-2
+``_bcd_jit_for`` bug shape, use/checkpoint-after-donate, the
+``_CAST_JIT_CACHE`` per-instance-memo lesson) and reports today's tree
+clean; the eval_shape donation-shape gate pins every donated carry
+argument to a shape-compatible output (the static promotion of
+``_gram_bcd``'s old per-finalize runtime warning)."""
+import ast
+import pathlib
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from keystone_tpu.analysis.diagnostics import (
+    donating_names,
+    donation_hazards,
+    recompile_hazards,
+)
+from keystone_tpu.utils.donation import (
+    DonationSite,
+    donation_shape_mismatches,
+    registered_donations,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXTURES = pathlib.Path(__file__).resolve().parent / "lint_fixtures"
+
+
+def _tree(name):
+    return ast.parse((FIXTURES / f"{name}.py").read_text())
+
+
+# -- offenders fire ----------------------------------------------------------
+
+def test_use_after_donate_fires_on_offender():
+    hits = donation_hazards(_tree("donation_offender"))
+    codes = {c for _, c, _ in hits}
+    assert codes == {"use-after-donate", "checkpoint-after-donate"}
+    # one hit each: the safe rebind-in-loop pattern is NOT flagged
+    assert len(hits) == 2
+    use = next(h for h in hits if h[1] == "use-after-donate")
+    assert "`carry`" in use[2] and "dead" in use[2]
+
+
+def test_checkpoint_after_donate_names_the_save():
+    hits = donation_hazards(_tree("donation_offender"))
+    ckpt = next(h for h in hits if h[1] == "checkpoint-after-donate")
+    assert "checkpoint save" in ckpt[2]
+
+
+def test_mesh_closure_jit_fires_on_pre_pr2_shape():
+    """The fixture reproduces the exact historical bug: a module-level
+    jit of a solver that reads the ambient mesh one call away
+    (bcd_core -> _class_spec -> get_mesh)."""
+    hits = recompile_hazards(_tree("mesh_closure_offender"))
+    assert [c for _, c, _ in hits] == ["mesh-closure-jit"]
+    assert "_bcd_jit_for" in hits[0][2]  # the fix is named in the hint
+
+
+def test_mesh_closure_exempts_per_mesh_factory():
+    # today's ops/linalg.py: the jit lives inside a factory taking the
+    # mesh as a parameter (lru_cache keyed per mesh) — clean
+    src = (REPO / "keystone_tpu/ops/linalg.py").read_text()
+    assert "_bcd_jit_for" in src
+    assert recompile_hazards(ast.parse(src)) == []
+
+
+def test_per_instance_jit_memo_fires_on_offender():
+    hits = recompile_hazards(_tree("per_instance_memo_offender"))
+    assert [c for _, c, _ in hits] == ["per-instance-jit-memo"]
+
+
+def test_per_instance_memo_blessed_by_global_cache():
+    # the _cached_jit pattern: the same scope also puts the program in
+    # a module-level memo, so the self attr is only a fast path — clean
+    src = (REPO / "keystone_tpu/workflow/transformer.py").read_text()
+    hits = [h for h in recompile_hazards(ast.parse(src))
+            if h[1] == "per-instance-jit-memo"]
+    assert hits == []
+
+
+def test_unstable_jit_tag_still_detected():
+    src = (
+        "class T:\n"
+        "    def f(self, tag):\n"
+        "        return self._cached_jit('ok', lambda: None)\n"
+        "    def g(self, tag):\n"
+        "        return self._cached_jit(tag + 'x', lambda: None)\n")
+    hits = recompile_hazards(ast.parse(src))
+    assert [c for _, c, _ in hits] == ["unstable-jit-cache-tag"]
+
+
+def test_donating_names_parses_both_spellings():
+    src = (
+        "a = donating_jit(impl, donate_argnums=(0, 1))\n"
+        "b = donating_jit(impl2, (2,))\n"
+        "c = other(impl3)\n")
+    names = donating_names(ast.parse(src))
+    assert names == {"a": frozenset({0, 1}), "b": frozenset({2})}
+
+
+# -- the whole tree is clean -------------------------------------------------
+
+@pytest.mark.parametrize("pass_fn", [donation_hazards, recompile_hazards])
+def test_package_tree_is_clean(pass_fn):
+    hits = []
+    for path in sorted((REPO / "keystone_tpu").rglob("*.py")):
+        for lineno, code, msg in pass_fn(ast.parse(path.read_text())):
+            hits.append(f"{path}:{lineno}: {code}")
+    assert hits == [], hits
+
+
+# -- donation shape gate (satellite: the _gram_bcd pin) ----------------------
+
+def test_registered_donation_sites_are_shape_compatible():
+    """Every donating_jit site in the linear family + scaler donates
+    only arguments with a shape-compatible output — the static pin for
+    the old `_gram_bcd` (d,d)-donation warning. Probes make this
+    checkable via eval_shape on any backend, devices untouched."""
+    import keystone_tpu.nodes.learning.linear  # noqa: F401  (registers)
+    import keystone_tpu.nodes.stats  # noqa: F401
+
+    probed = [s for s in registered_donations() if s.probe is not None]
+    assert {s.name for s in probed} >= {
+        "_gram_carry_update_impl", "_finalize_normal_equations_impl",
+        "_gram_bcd_impl", "_accum_moments_impl"}
+    for site in probed:
+        assert donation_shape_mismatches(site) == [], site.name
+
+
+def test_shape_gate_catches_a_bad_donation():
+    # the pre-fix _gram_bcd shape: donating a (d, d) Gram with no
+    # matching output must be reported
+    def impl(G, sx):
+        return sx / G.shape[0]  # only a (d,) output exists
+
+    S = jax.ShapeDtypeStruct
+    site = DonationSite(
+        fn=impl, donate_argnums=(0, 1), static_argnames=(),
+        probe=lambda: ((S((8, 8), np.float32), S((8,), np.float32)), {}),
+        name="impl", module="test")
+    bad = donation_shape_mismatches(site)
+    assert len(bad) == 1 and "arg 0" in bad[0]
+
+
+def test_streamed_finalize_emits_no_donation_warnings(mesh8):
+    """Satellite pin: a full streamed BlockLS fit + finalize runs with
+    ZERO donation warnings — no 'donated buffer not usable' (shape
+    mismatch) and no donated-buffer reuse errors — on this backend and,
+    via the shape gate above, provably on the backends where donation
+    is real."""
+    from keystone_tpu.nodes.learning import BlockLeastSquaresEstimator
+    from keystone_tpu.parallel.streaming import (
+        StreamingDataset,
+        fit_streaming,
+    )
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(512, 64).astype(np.float32)
+    L = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 512)]
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        model = fit_streaming(
+            BlockLeastSquaresEstimator(32, 1, lam=0.1),
+            StreamingDataset.from_numpy(X, chunk_size=128), L)
+    donation_warnings = [w for w in caught
+                        if "donat" in str(w.message).lower()]
+    assert donation_warnings == []
+    assert np.asarray(model.weights).shape == (64, 4)

@@ -10,10 +10,26 @@ Three layers, all hermetic (no data, no device buffers):
      body must not call ``np.asarray``/``np.array`` on its item
      argument (forces a per-item device sync; ADVICE r2/r3 lineage).
      HostTransformers are exempt.
-   - ``unstable-jit-cache-tag``: ``self._cached_jit(tag, ...)`` must
-     pass a string-literal tag — a computed tag makes the global jit
-     cache key unstable across sessions, so warm-executable reuse
-     silently stops working.
+   - **recompile hazards** (``analysis.diagnostics.recompile_hazards``,
+     tree-wide): ``mesh-closure-jit`` — a module-lifetime ``jax.jit``
+     of an ambient-mesh-reading function (the pre-PR-2 ``_bcd_jit_for``
+     bug: the first mesh's sharding bakes into the cached trace);
+     ``per-instance-jit-memo`` — a compiled program memoized on
+     ``self`` with no global cache behind it (the ``_CAST_JIT_CACHE``
+     lesson: refits rebuild the instance and recompile);
+     ``unstable-jit-cache-tag`` — ``self._cached_jit(tag, ...)`` must
+     pass a string-literal tag (computed tags break warm-executable
+     reuse across sessions).
+   - **donation safety** (``analysis.diagnostics.donation_hazards``,
+     tree-wide): ``use-after-donate`` / ``checkpoint-after-donate`` —
+     a name passed at a ``donating_jit`` donate position and read (or
+     checkpoint-saved) afterwards in the same scope: the buffer is
+     dead on TPU/GPU and silently alive on CPU tests. Plus the
+     spec-level ``donation-shape-mismatch`` gate: every registered
+     ``donating_jit`` site with a shape probe must donate only
+     arguments with a shape-compatible output (``jax.eval_shape``,
+     device-free — the static promotion of jax's per-compile
+     donated-buffer-not-usable warning).
    - ``swallow-all-handler`` (ingest + workflow code only —
      ``loaders/``, ``parallel/``, ``workflow/``): no bare ``except:``
      and no silent ``except Exception: pass`` — exactly where "skip
@@ -31,7 +47,9 @@ Three layers, all hermetic (no data, no device buffers):
    are the required gate.
 
 Usage: ``python tools/lint.py [--skip-apps]`` or
-``bin/run-pipeline.sh --check``. Exit code 0 = clean.
+``bin/run-pipeline.sh --check`` (which also runs the budgeted
+``check --all`` plan gate via ``bin/ci.sh --no-tests``). Exit code
+0 = clean.
 """
 from __future__ import annotations
 
@@ -88,24 +106,13 @@ def _host_coercions_in(fdef: ast.FunctionDef):
     yield from host_coercions_in_funcdef(fdef)
 
 
-def _unstable_jit_tags(tree: ast.Module):
-    """``self._cached_jit(<non-literal>, ...)`` call sites."""
-    for call in ast.walk(tree):
-        if not (isinstance(call, ast.Call) and call.args):
-            continue
-        f = call.func
-        if not (isinstance(f, ast.Attribute) and f.attr == "_cached_jit"):
-            continue
-        tag = call.args[0]
-        if not (isinstance(tag, ast.Constant) and isinstance(tag.value, str)):
-            yield call.lineno
-
-
 def run_ast_rules() -> int:
     from keystone_tpu.analysis.diagnostics import (
         CAST_BEFORE_TRANSFER_SCOPES,
         SWALLOW_ALL_SCOPES,
+        donation_hazards,
         float_casts_before_transfer,
+        recompile_hazards,
         swallow_all_handlers,
     )
 
@@ -124,10 +131,14 @@ def run_ast_rules() -> int:
                       f"{cls.name}.apply calls {what} on its item "
                       "(per-item device sync; use jnp or HostTransformer)")
                 failures += 1
-        for lineno in _unstable_jit_tags(tree):
-            print(f"{rel}:{lineno}: unstable-jit-cache-tag: _cached_jit "
-                  "tag must be a string literal (computed tags break "
-                  "warm-executable reuse across sessions)")
+        # recompile hazards + donation safety share one home in the
+        # analysis package (single source of truth; tests parse the
+        # synthetic offender fixtures through the same functions)
+        for lineno, code, msg in recompile_hazards(tree):
+            print(f"{rel}:{lineno}: {code}: {msg}")
+            failures += 1
+        for lineno, code, msg in donation_hazards(tree):
+            print(f"{rel}:{lineno}: {code}: {msg}")
             failures += 1
         if rel.parts[:1] == ("keystone_tpu",) and \
                 rel.parts[1] in SWALLOW_ALL_SCOPES:
@@ -147,6 +158,71 @@ def run_ast_rules() -> int:
                       "(StreamingDataset wire_dtype/compute_dtype, "
                       "README 'Streaming ingest')")
                 failures += 1
+    return failures
+
+
+# -- layer 2b: donation shape gate (spec-level, eval_shape) ------------------
+
+def _donating_modules():
+    """Dotted names of every package module that builds a donating_jit
+    wrapper, discovered from the same AST pass the hazard rules use —
+    a new donation site anywhere in the tree is probed automatically,
+    never silently skipped by a stale hardcoded list."""
+    from keystone_tpu.analysis.diagnostics import donating_names
+
+    mods = []
+    for path in sorted(PKG.rglob("*.py")):
+        try:
+            if not donating_names(ast.parse(path.read_text())):
+                continue
+        except SyntaxError:
+            continue  # reported by run_ast_rules
+        rel = path.relative_to(REPO).with_suffix("")
+        parts = list(rel.parts)
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        mods.append(".".join(parts))
+    return mods
+
+
+def run_donation_shape_gate() -> int:
+    """Every registered ``donating_jit`` site with a shape probe must
+    donate only arguments that have a shape-compatible output —
+    verified abstractly via ``jax.eval_shape`` (no device buffers).
+    The static promotion of the `_gram_bcd` per-finalize runtime warn:
+    an incompatible donation is never honored by XLA, it only buys a
+    donated-buffer-not-usable warning per compile on TPU/GPU. Sites
+    WITHOUT a probe are reported so a donation can never dodge the
+    gate by simply not declaring one."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import importlib
+
+    for mod in _donating_modules():
+        importlib.import_module(mod)
+    from keystone_tpu.utils.donation import (
+        donation_shape_mismatches,
+        registered_donations,
+    )
+
+    failures = 0
+    probed = 0
+    for site in registered_donations():
+        if site.probe is None:
+            print(f"{site.module}: donation-without-probe: "
+                  f"{site.name} donates argnums "
+                  f"{site.donate_argnums} but registers no shape "
+                  "probe — pass probe= so the gate can verify the "
+                  "donation statically")
+            failures += 1
+            continue
+        probed += 1
+        for what in donation_shape_mismatches(site):
+            print(f"{site.module}: donation-shape-mismatch: {what} "
+                  "(XLA cannot honor it; drop the argnum from "
+                  "donate_argnums)")
+            failures += 1
+    print(f"donation shape gate: {probed} probed site(s), "
+          f"{failures} failure(s)")
     return failures
 
 
@@ -193,6 +269,7 @@ def run_ruff() -> int:
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     failures = run_ast_rules()
+    failures += run_donation_shape_gate()
     failures += run_ruff()
     if "--skip-apps" not in argv:
         failures += run_pipeline_checks()
