@@ -4,13 +4,22 @@
 #
 #   1. tools/lint.py --skip-apps   AST rules (host coercions, recompile
 #                                  hazards, donation safety, swallow-all,
-#                                  cast-before-transfer) + the eval_shape
-#                                  donation shape gate (+ ruff if present)
+#                                  cast-before-transfer, the three
+#                                  concurrency pass families) + the
+#                                  eval_shape donation shape gate (+ ruff
+#                                  if present)
 #   2. python -m keystone_tpu check --all --budget $KEYSTONE_CI_HBM_BUDGET
 #                                  abstract interpretation + graph lints +
 #                                  static HBM plans over every CHECK_APPS
-#                                  app, device-free; exit 1 on diagnostics,
-#                                  exit 2 on a predicted budget violation
+#                                  app + the concurrency scan, device-free;
+#                                  exit 1 on diagnostics, exit 2 on a
+#                                  predicted budget violation
+#   2b. bounded-seed stress        the deterministic-interleaving suite
+#                                  (tests/test_concurrency_sched.py):
+#                                  historical-race regression schedules +
+#                                  a bounded seeded fuzz of the prefetcher
+#                                  — cheap, catches schedule-dependent
+#                                  breakage before the full tier-1 bill
 #   3. tier-1 pytest               tests/ -m 'not slow' on the CPU-simulated
 #                                  8-device mesh
 #
@@ -44,6 +53,11 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
   "$PY" -m keystone_tpu check --all --budget "$BUDGET"
 
 if (( run_tests )); then
+  echo "== ci: bounded-seed concurrency stress (regression schedules + fuzz) =="
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    "$PY" -m pytest "$KEYSTONE_HOME/tests/test_concurrency_sched.py" -q \
+    -m 'not slow' -p no:cacheprovider
+
   echo "== ci: tier-1 tests =="
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     "$PY" -m pytest "$KEYSTONE_HOME/tests" -q -m 'not slow' \

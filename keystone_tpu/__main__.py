@@ -9,7 +9,9 @@ Run with no arguments to list the available applications.
 
 ``check`` statically analyzes an app's pipeline DAG — shape/dtype
 propagation, the graph lints, and the static HBM plan (see
-``keystone_tpu/analysis``) — without loading data or allocating a
+``keystone_tpu/analysis``) — plus the tree-wide concurrency-safety
+scan (guarded-by races, lock-order cycles, blocking-under-lock;
+``analysis/concurrency.py``), without loading data or allocating a
 device buffer, and exits non-zero if any diagnostic fires.
 ``--budget BYTES`` (``MiB``/``GiB`` suffixes accepted) gates each app
 on its planned fit-path peak and exits 2 on a predicted violation.
@@ -115,7 +117,21 @@ def check_main(rest) -> int:
                   "arguments to list apps", file=sys.stderr)
             return 2
 
-    failed = 0
+    # tree-wide concurrency-safety scan (analysis.concurrency): the
+    # source-level counterpart of the per-app graph lints — guarded-by
+    # races, lock-order cycles, blocking-under-lock, non-atomic guarded
+    # sequences. AST-only, device-free, a few hundred ms.
+    import pathlib
+
+    from keystone_tpu.analysis.concurrency import scan_package
+
+    pkg_root = pathlib.Path(__file__).resolve().parent
+    concurrency = scan_package(pkg_root)
+    for hit in concurrency:
+        print(f"{hit['file']}:{hit['lineno']}: {hit['code']}: "
+              f"{hit['message']}", file=sys.stderr)
+
+    failed = 1 if concurrency else 0
     over_budget = 0
     reports = []
     for build in builders:
@@ -137,11 +153,16 @@ def check_main(rest) -> int:
         else:
             status = f"FAIL ({len(report.diagnostics)} diagnostic(s))"
         print(f"{target.name}: {status}")
+    print(f"concurrency: {'clean' if not concurrency else f'{len(concurrency)} diagnostic(s)'}")
     if json_out is not None:
         import json as _json
 
-        blob = (reports[0].to_dict() if len(reports) == 1
-                else [r.to_dict() for r in reports])
+        if len(reports) == 1:
+            blob = reports[0].to_dict()
+            blob["concurrency"] = concurrency
+        else:
+            blob = {"apps": [r.to_dict() for r in reports],
+                    "concurrency": concurrency}
         with open(json_out, "w") as f:
             f.write(_json.dumps(blob, indent=2))
         print(f"report written to {json_out}", file=sys.stderr)

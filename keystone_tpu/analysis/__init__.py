@@ -15,6 +15,14 @@ Entry points:
   app registry (``keystone_tpu.pipelines.CHECK_APPS``)
 * ``tools/lint.py``                             — repo-wide static gate
 """
+from .concurrency import (
+    blocking_under_lock,
+    find_lock_cycles,
+    guarded_field_races,
+    guarded_sequence_hazards,
+    lock_order_edges,
+    scan_package,
+)
 from .diagnostics import (
     AnalysisReport,
     Diagnostic,
@@ -56,8 +64,14 @@ __all__ = [
     "analyze",
     "apply_body_host_coercions",
     "as_input_spec",
+    "blocking_under_lock",
     "check_graph",
     "check_pipeline",
+    "find_lock_cycles",
+    "guarded_field_races",
+    "guarded_sequence_hazards",
+    "lock_order_edges",
     "plan_graph",
+    "scan_package",
     "spec_dataset",
 ]

@@ -2,33 +2,51 @@
 
 The cheap, always-on half of the observability layer (the detailed
 per-run structure lives in :mod:`.trace`). A metric update is a dict
-lookup plus a float add — safe to leave in hot paths like the DAG
-executor. Like :class:`~keystone_tpu.workflow.env.PipelineEnv`, the
-registry is a process singleton and relies on the single-threaded
-driver model for safety.
+lookup plus a locked float add — safe to leave in hot paths like the
+DAG executor. Unlike the early single-threaded-driver days, metrics are
+now fed from worker threads too (the streaming prefetcher, the tar
+decode pool, retry helpers — PR 3/4), so every read-modify-write here
+takes a lock; the discipline is declared with
+:func:`~keystone_tpu.utils.guarded.guarded_by` and checked statically
+by ``analysis.concurrency``.
+
+These are deliberately *plain* ``threading.Lock``\\ s, not TracedLocks:
+a TracedLock's contended path reports INTO this registry, so tracing
+the registry's own locks would re-enter them (see
+``utils/guarded.py``). The uncontended cost is ~100 ns per update —
+metrics fire per chunk/record/node, never per element.
 """
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Dict, Iterator, List, Optional
 
+from ..utils.guarded import guarded_by
 
+
+@guarded_by("_lock", "value")
 class Counter:
-    """Monotonically increasing count."""
+    """Monotonically increasing count (thread-safe: the ``+=`` is a
+    read-modify-write and counters are incremented from ingest worker
+    threads — the resilience event funnel, the prefetcher)."""
 
-    __slots__ = ("name", "value")
+    __slots__ = ("name", "value", "_lock")
 
     def __init__(self, name: str):
         self.name = name
         self.value = 0.0
+        self._lock = threading.Lock()
 
     def inc(self, amount: float = 1.0) -> None:
-        self.value += amount
+        with self._lock:
+            self.value += amount
 
 
 class Gauge:
-    """Last-written value."""
+    """Last-written value (a plain overwrite — atomic enough without a
+    lock; last writer wins is the semantics)."""
 
     __slots__ = ("name", "value")
 
@@ -40,12 +58,16 @@ class Gauge:
         self.value = float(value)
 
 
+@guarded_by("_lock", "count", "total", "min", "max", "_tail")
 class Histogram:
     """Streaming aggregates (count/total/min/max) plus a bounded tail of
     raw observations for percentile-ish inspection without unbounded
-    memory growth in long-lived processes."""
+    memory growth in long-lived processes. ``observe`` may be called
+    from multiple threads (ingest stalls, lock waits, retry timings);
+    the aggregates and the tail trim are guarded so concurrent
+    observations can neither lose counts nor corrupt the tail."""
 
-    __slots__ = ("name", "count", "total", "min", "max", "_tail")
+    __slots__ = ("name", "count", "total", "min", "max", "_tail", "_lock")
 
     TAIL = 256
 
@@ -56,18 +78,20 @@ class Histogram:
         self.min = float("inf")
         self.max = float("-inf")
         self._tail: List[float] = []
+        self._lock = threading.Lock()
 
     def observe(self, value: float) -> None:
         value = float(value)
-        self.count += 1
-        self.total += value
-        if value < self.min:
-            self.min = value
-        if value > self.max:
-            self.max = value
-        self._tail.append(value)
-        if len(self._tail) > self.TAIL:
-            del self._tail[: len(self._tail) - self.TAIL]
+        with self._lock:
+            self.count += 1
+            self.total += value
+            if value < self.min:
+                self.min = value
+            if value > self.max:
+                self.max = value
+            self._tail.append(value)
+            if len(self._tail) > self.TAIL:
+                del self._tail[: len(self._tail) - self.TAIL]
 
     @property
     def mean(self) -> float:
@@ -76,24 +100,39 @@ class Histogram:
     def percentile(self, q: float) -> float:
         """Approximate percentile over the retained tail (the most
         recent ``TAIL`` observations), 0 <= q <= 100."""
-        if not self._tail:
+        with self._lock:
+            tail = list(self._tail)
+        if not tail:
             return 0.0
-        ordered = sorted(self._tail)
+        ordered = sorted(tail)
         idx = min(len(ordered) - 1,
                   max(0, int(round(q / 100.0 * (len(ordered) - 1)))))
         return ordered[idx]
 
     def snapshot(self) -> Dict[str, float]:
-        if not self.count:
-            return {"count": 0, "total": 0.0, "mean": 0.0,
-                    "min": 0.0, "max": 0.0}
-        return {"count": self.count, "total": self.total, "mean": self.mean,
-                "min": self.min, "max": self.max,
+        with self._lock:
+            if not self.count:
+                return {"count": 0, "total": 0.0, "mean": 0.0,
+                        "min": 0.0, "max": 0.0}
+            count, total = self.count, self.total
+            lo, hi = self.min, self.max
+        return {"count": count, "total": total, "mean": total / count,
+                "min": lo, "max": hi,
                 "p50": self.percentile(50), "p99": self.percentile(99)}
 
 
+#: guards the singleton create (``get_or_create``/``reset`` may race a
+#: worker thread's first metric against the main thread's — a lost
+#: registry loses every count the loser wrote)
+_REGISTRY_LOCK = threading.Lock()
+
+
+@guarded_by("_lock", "_counters", "_gauges", "_histograms")
 class MetricsRegistry:
-    """Process-wide named metrics (``MetricsRegistry.get_or_create()``)."""
+    """Process-wide named metrics (``MetricsRegistry.get_or_create()``).
+    The lazy per-name creates are check-then-act sequences, hit
+    concurrently by ingest worker threads — both the singleton and the
+    name maps are locked."""
 
     _instance: Optional["MetricsRegistry"] = None
 
@@ -101,35 +140,50 @@ class MetricsRegistry:
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
+        self._lock = threading.Lock()
 
     @classmethod
     def get_or_create(cls) -> "MetricsRegistry":
-        if cls._instance is None:
-            cls._instance = MetricsRegistry()
-        return cls._instance
+        inst = cls._instance
+        if inst is None:
+            with _REGISTRY_LOCK:
+                inst = cls._instance
+                if inst is None:
+                    inst = cls._instance = MetricsRegistry()
+        return inst
 
     @classmethod
     def reset(cls) -> None:
         """Drop the global registry (tests)."""
-        cls._instance = None
+        with _REGISTRY_LOCK:
+            cls._instance = None
 
     # -- access -----------------------------------------------------------
     def counter(self, name: str) -> Counter:
         c = self._counters.get(name)
         if c is None:
-            c = self._counters[name] = Counter(name)
+            with self._lock:
+                c = self._counters.get(name)
+                if c is None:
+                    c = self._counters[name] = Counter(name)
         return c
 
     def gauge(self, name: str) -> Gauge:
         g = self._gauges.get(name)
         if g is None:
-            g = self._gauges[name] = Gauge(name)
+            with self._lock:
+                g = self._gauges.get(name)
+                if g is None:
+                    g = self._gauges[name] = Gauge(name)
         return g
 
     def histogram(self, name: str) -> Histogram:
         h = self._histograms.get(name)
         if h is None:
-            h = self._histograms[name] = Histogram(name)
+            with self._lock:
+                h = self._histograms.get(name)
+                if h is None:
+                    h = self._histograms[name] = Histogram(name)
         return h
 
     @contextlib.contextmanager
@@ -142,11 +196,19 @@ class MetricsRegistry:
 
     # -- export -----------------------------------------------------------
     def snapshot(self) -> Dict[str, Dict]:
+        # copy the maps under the lock before iterating: a worker
+        # thread lazily creating a metric (a contended TracedLock's
+        # first lock.wait_s.<name> histogram) mid-snapshot would
+        # otherwise resize the dict under the iteration
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
         return {
-            "counters": {k: c.value for k, c in sorted(self._counters.items())},
-            "gauges": {k: g.value for k, g in sorted(self._gauges.items())},
+            "counters": {k: c.value for k, c in sorted(counters.items())},
+            "gauges": {k: g.value for k, g in sorted(gauges.items())},
             "histograms": {
-                k: h.snapshot() for k, h in sorted(self._histograms.items())
+                k: h.snapshot() for k, h in sorted(histograms.items())
             },
         }
 

@@ -39,6 +39,8 @@ import time
 from dataclasses import asdict, dataclass, field
 from typing import Any, Dict, Iterator, List, Optional
 
+from ..utils.guarded import TracedLock, guarded_by
+
 _ACTIVE: Optional["PipelineTrace"] = None
 
 
@@ -98,6 +100,8 @@ class _Frame:
         self.child_s = 0.0
 
 
+@guarded_by("_resilience_lock", "resilience", "resilience_stats")
+@guarded_by("_lock_wait_lock", "lock_waits")
 class PipelineTrace:
     """Collects one run's execution telemetry; see module docstring.
 
@@ -107,6 +111,11 @@ class PipelineTrace:
             pipeline.apply(data).numpy()
         print(tr.summary())
         open("trace.json", "w").write(tr.to_json())
+
+    Thread model: the per-node/chunk/optimizer streams are fed by the
+    single driver thread; ``record_resilience`` and
+    ``record_lock_wait`` are fed by ingest worker threads and take
+    locks (declared above, checked by ``analysis.concurrency``).
     """
 
     def __init__(self, name: str = "pipeline"):
@@ -135,8 +144,18 @@ class PipelineTrace:
         self.resilience_stats: Dict[str, float] = {}
         # resilience events fire from decode/prefetch worker threads
         # concurrently; the read-modify-write on the stats dict needs a
-        # real lock for the "counts stay exact" contract to hold
-        self._resilience_lock = threading.Lock()
+        # real lock for the "counts stay exact" contract to hold — a
+        # TracedLock, so its own contention is observable and the
+        # schedule harness can interleave at it (the PR 4 race's
+        # regression schedule lives in tests/test_concurrency_sched.py)
+        self._resilience_lock = TracedLock("trace.resilience")
+        #: contended-lock wait table fed by TracedLock while this trace
+        #: is active: {lock name: {"count": n, "wait_s": total}}. Its
+        #: own guard is a PLAIN lock — TracedLock reports in here, so a
+        #: traced guard would recurse (utils/guarded.py documents the
+        #: boundary).
+        self.lock_waits: Dict[str, Dict[str, float]] = {}
+        self._lock_wait_lock = threading.Lock()
         self.meta: Dict[str, Any] = {}
         self.wall_s: float = 0.0
         self._t0: Optional[float] = None
@@ -275,6 +294,21 @@ class PipelineTrace:
                 del self.resilience[: len(self.resilience)
                                     - self.RESILIENCE_TAIL]
 
+    def record_lock_wait(self, name: str, wait_s: float) -> None:
+        """One contended :class:`~keystone_tpu.utils.guarded.TracedLock`
+        acquire while this trace was active (called from whichever
+        thread lost the race — always under ``_lock_wait_lock``).
+        ``summary()`` prints the top contended locks, so a traced
+        streamed fit shows WHERE its threads serialized, not just that
+        they did."""
+        with self._lock_wait_lock:
+            entry = self.lock_waits.get(name)
+            if entry is None:
+                entry = self.lock_waits[name] = {
+                    "count": 0, "wait_s": 0.0}
+            entry["count"] += 1
+            entry["wait_s"] += float(wait_s)
+
     def ingest_stall_s(self) -> float:
         """Total consumer-side ingest stall across ALL streamed chunks
         (exact aggregate) — compare against ``wall_s`` for the overlap
@@ -307,6 +341,8 @@ class PipelineTrace:
             "streamed_fits": list(self.streamed_fits),
             "resilience": list(self.resilience),
             "resilience_stats": dict(self.resilience_stats),
+            "lock_waits": {k: dict(v)
+                           for k, v in self.lock_waits.items()},
         }
 
     def to_json(self, indent: Optional[int] = 2) -> str:
@@ -348,6 +384,8 @@ class PipelineTrace:
                 ev = str(e.get("event", "other"))
                 tr.resilience_stats[ev] = (
                     tr.resilience_stats.get(ev, 0) + 1)
+        tr.lock_waits = {k: dict(v) for k, v in
+                         data.get("lock_waits", {}).items()}
         return tr
 
     def summary(self, top: int = 0) -> str:
@@ -418,6 +456,14 @@ class PipelineTrace:
                 f"{k}={int(v)}" for k, v in sorted(
                     self.resilience_stats.items()))
             lines.append(f"resilience events: {counts}")
+        if self.lock_waits:
+            top = sorted(self.lock_waits.items(),
+                         key=lambda kv: -kv[1].get("wait_s", 0.0))[:3]
+            shown = ", ".join(
+                f"{name} ({int(v.get('count', 0))}x, "
+                f"{v.get('wait_s', 0.0) * 1e3:.1f} ms)"
+                for name, v in top)
+            lines.append(f"contended locks (top {len(top)}): {shown}")
         for d in self.solver_decisions:
             costs = ", ".join(
                 f"{k}={v:.3g}s" for k, v in d.get("costs", {}).items())

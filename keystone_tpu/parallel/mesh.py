@@ -144,19 +144,64 @@ def h2d_workers() -> int:
         ) from None
 
 
+#: set by the exit teardown: no pool may be (re)built while the
+#: interpreter is shutting down — a producer mid-``_stage`` at exit
+#: would otherwise lazily rebuild a fresh non-daemon pool whose
+#: teardown already ran
+_H2D_EXITING = False
+
+
 def h2d_pool() -> Optional[ThreadPoolExecutor]:
     """The shared staging pool, or None when per-shard staging is
     disabled (``KEYSTONE_H2D_THREADS=1`` / ``0`` forces the single
-    whole-array ``device_put``)."""
+    whole-array ``device_put``) or the interpreter is exiting."""
     workers = h2d_workers()
-    if workers <= 1:
+    if workers <= 1 or _H2D_EXITING:
         return None
     global _H2D_POOL
     with _H2D_POOL_LOCK:
-        if _H2D_POOL is None:
+        if _H2D_POOL is None and not _H2D_EXITING:
             _H2D_POOL = ThreadPoolExecutor(
                 workers, thread_name_prefix="keystone-h2d")
         return _H2D_POOL
+
+
+def shutdown_h2d_pool(wait: bool = False) -> None:
+    """Tear down the shared staging pool (idempotent; the next
+    ``h2d_pool()`` call builds a fresh one). The interpreter-exit path
+    goes through :func:`_shutdown_h2d_pool_at_exit` instead, which also
+    blocks rebuilds."""
+    global _H2D_POOL
+    with _H2D_POOL_LOCK:
+        pool, _H2D_POOL = _H2D_POOL, None
+    if pool is not None:
+        pool.shutdown(wait=wait, cancel_futures=True)
+
+
+def _shutdown_h2d_pool_at_exit() -> None:
+    """Exit teardown: the pool's workers are NON-daemon threads, and
+    without an explicit shutdown an exit under an active stream leaks
+    them into the interpreter's thread join — a prefetch producer
+    racing new ``device_put`` submissions against teardown used to spew
+    'cannot schedule new futures' / join warnings (pinned by the
+    subprocess test in tests/test_concurrency_sched.py)."""
+    global _H2D_EXITING
+    _H2D_EXITING = True
+    shutdown_h2d_pool()
+
+
+# Registered at IMPORT time, not first pool build: threading's private
+# ``_register_atexit`` callbacks run in REVERSE registration order
+# (before non-daemon threads are joined — exactly the window the pool
+# must die in; plain ``atexit`` is the fallback for interpreters
+# without the hook). streaming.py imports this module before
+# registering its stream-stop teardown, so at exit the stream stops
+# run FIRST, then this pool shutdown — stops-before-pool is the
+# invariant that keeps producers from racing teardown.
+import atexit  # noqa: E402
+
+getattr(threading, "_register_atexit", atexit.register)(
+    _shutdown_h2d_pool_at_exit)
 
 
 def shard_put(arr, sharding: NamedSharding, pool=None):

@@ -62,9 +62,11 @@ resumes bit-comparably instead of restarting.
 """
 from __future__ import annotations
 
+import atexit
 import queue
 import threading
 import time
+import weakref
 from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -73,6 +75,7 @@ from jax.sharding import Mesh
 
 from ..observability.metrics import MetricsRegistry
 from ..observability.trace import current_trace
+from ..utils.guarded import TracedLock, TracedSemaphore, guarded_by
 from ..resilience.events import record_event
 from ..resilience.faults import inject
 from ..resilience.retry import (
@@ -145,17 +148,49 @@ _DONE = object()
 from ..utils.lru import LruMemo  # noqa: E402
 
 _CAST_JIT_CACHE = LruMemo()
+# guards the miss path: LruMemo's get/put are individually locked, but
+# get->build->put is a check-then-act — two prefetch threads racing the
+# same key would each build a DISTINCT jit wrapper, and jax's trace
+# cache keys on the function object, so the loser recompiles the cast
+# on every chunk (found by the guarded-by review sweep; pinned in
+# test_concurrency_sched.py)
+_CAST_BUILD_LOCK = TracedLock("stream.cast_build")
 
 
 def _cast_program(treedef, casts: Tuple) -> Callable:
     key = ("wire_cast", treedef, tuple(dt.name for dt in casts))
     fn = _CAST_JIT_CACHE.get(key)
     if fn is None:
-        cast_tree = jax.tree_util.tree_unflatten(treedef, list(casts))
-        fn = jax.jit(lambda data: jax.tree_util.tree_map(
-            lambda x, t: x.astype(t), data, cast_tree))
-        _CAST_JIT_CACHE.put(key, fn)
+        with _CAST_BUILD_LOCK:
+            fn = _CAST_JIT_CACHE.get(key)
+            if fn is None:
+                cast_tree = jax.tree_util.tree_unflatten(
+                    treedef, list(casts))
+                fn = jax.jit(lambda data: jax.tree_util.tree_map(
+                    lambda x, t: x.astype(t), data, cast_tree))
+                _CAST_JIT_CACHE.put(key, fn)
     return fn
+
+
+#: stop events of every live ``chunks()`` iteration, set at interpreter
+#: exit so prefetch producers stop BEFORE the H2D pool tears down —
+#: a daemon producer mid-``device_put`` at exit otherwise races pool
+#: shutdown into join warnings (or, with an unlucky schedule, a hang).
+#: WeakSet: a finished iteration's event is garbage, not a leak.
+_LIVE_STREAM_STOPS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _shutdown_live_streams() -> None:
+    for stop in list(_LIVE_STREAM_STOPS):
+        stop.set()
+
+
+# threading._register_atexit callbacks run at threading shutdown,
+# BEFORE non-daemon threads (the H2D pool's workers) are joined —
+# plain atexit would run too late to matter. Fall back gracefully on
+# interpreters without the private hook.
+_register_teardown = getattr(threading, "_register_atexit", atexit.register)
+_register_teardown(_shutdown_live_streams)
 
 
 class _SourceError:
@@ -182,6 +217,7 @@ class _IterLedger:
         self.working = 0.0
 
 
+@guarded_by("_lock", "buffered", "working", "chunk_nbytes", "peak")
 class _Residency:
     """Thread-safe device-residency ledger for one prefetch pipeline:
     bytes staged in the queue + working chunks, with a peak high-water
@@ -189,12 +225,14 @@ class _Residency:
     (mapped) views; each live ``chunks()`` iteration tracks its own
     contribution through an :class:`_IterLedger`, and closing an
     iteration removes exactly that contribution — never another
-    iteration's."""
+    iteration's. The producer/consumer lock is a TracedLock: its
+    contention is observable and the schedule harness interleaves at it
+    (the PR 3 ledger-close race's regression schedule)."""
 
     __slots__ = ("_lock", "buffered", "working", "chunk_nbytes", "peak")
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = TracedLock("stream.residency")
         self.buffered = 0.0
         self.working = 0.0
         self.chunk_nbytes = 0.0
@@ -477,8 +515,12 @@ class StreamingDataset(Dataset):
         # putting (depth + 2) chunks live against the documented
         # (depth + 1)-chunk budget (review finding, reproduced).
         q: queue.Queue = queue.Queue()
-        slots = threading.Semaphore(self.prefetch_depth)
+        slots = TracedSemaphore("stream.slots", self.prefetch_depth)
         stop = threading.Event()
+        # interpreter-exit teardown: _shutdown_live_streams sets this
+        # before the H2D pool is torn down, so an active producer exits
+        # its slot wait instead of racing pool shutdown
+        _LIVE_STREAM_STOPS.add(stop)
         it_ledger = _IterLedger()
 
         def acquire_slot() -> bool:
@@ -625,6 +667,7 @@ class StreamingDataset(Dataset):
             # concurrently running sibling iteration stays accounted
             producer.join(timeout=5.0)
             self._residency.close(it_ledger)
+            _LIVE_STREAM_STOPS.discard(stop)
         if complete and self.n is None:
             self.n = rows_seen  # a full pass pins the unknown length
 

@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..utils.guarded import TracedLock
 from .events import record_event
 from .retry import TransientError
 
@@ -80,9 +81,11 @@ class FaultPlan:
     """
 
     def __init__(self, seed: int = 0):
+        # specs/log/rng are hit from every instrumented ingest thread;
+        # guarded (utils.guarded.GUARDED_FIELDS declares the fields)
         self._rng = np.random.RandomState(seed)
         self._specs: Dict[str, List[FaultSpec]] = {}
-        self._lock = threading.Lock()
+        self._lock = TracedLock("faults")
         self._release = threading.Event()
         self.log: List[Dict[str, Any]] = []
 

@@ -22,9 +22,9 @@ once — the property checkpoint/resume relies on.
 from __future__ import annotations
 
 import json
-import threading
 from typing import Any, Dict, List, Optional
 
+from ..utils.guarded import TracedLock, guarded_by
 from .events import record_event
 
 
@@ -39,6 +39,7 @@ class QuarantineBudgetExceededError(RuntimeError):
     """Raised when quarantined records exceed ``max_bad_fraction``."""
 
 
+@guarded_by("_lock", "records", "bad_count", "ok_count", "_keys")
 class Quarantine:
     """Skip-but-account sink for corrupt records; see module docstring.
 
@@ -48,6 +49,15 @@ class Quarantine:
     of 2 seen = 50%) from killing a run whose true bad fraction is tiny;
     it also makes the budget check safe during a checkpoint-resume
     replay, where bad counts are restored before good records recount.
+
+    Thread model: decode-pool workers quarantine records concurrently
+    while the consumer thread snapshots ``state()`` for a checkpoint —
+    counts, keys, the manifest tail, AND the JSONL manifest append all
+    happen under the one lock, so a snapshot can never see (or the
+    file never hold) a half-applied record. (The JSONL append used to
+    run outside the lock: two workers could interleave, and a
+    checkpointed ``state()`` could count a record whose manifest line
+    was not yet durable — found by the guarded-by pass, PR 7.)
     """
 
     #: raw manifest entries retained in memory (counts stay exact)
@@ -67,7 +77,7 @@ class Quarantine:
         self.bad_count = 0
         self.ok_count = 0
         self._keys: set = set()
-        self._lock = threading.Lock()
+        self._lock = TracedLock("quarantine")
 
     # -- accounting --------------------------------------------------------
     def record_ok(self, n: int = 1) -> None:
@@ -93,40 +103,62 @@ class Quarantine:
             self.records.append(entry)
             if len(self.records) > self.MANIFEST_TAIL:
                 del self.records[: len(self.records) - self.MANIFEST_TAIL]
-        record_event("quarantine", **entry)
-        if self.manifest_path:
-            try:
-                with open(self.manifest_path, "a") as f:
-                    f.write(json.dumps(entry) + "\n")
-            except OSError as exc:
-                # a full/unwritable manifest disk must not kill the fit;
-                # the in-memory manifest and metrics still hold the record
-                import logging
+            # the JSONL append stays INSIDE the lock: concurrent decode
+            # workers must not interleave lines, and a checkpoint's
+            # state() snapshot must never lead the durable manifest
+            if self.manifest_path:
+                try:
+                    with open(self.manifest_path, "a") as f:
+                        f.write(json.dumps(entry) + "\n")
+                except OSError as exc:
+                    # a full/unwritable manifest disk must not kill the
+                    # fit; the in-memory manifest and metrics still
+                    # hold the record
+                    import logging
 
-                logging.getLogger(__name__).warning(
-                    "quarantine manifest %s unwritable (%s); entry kept "
-                    "in memory only", self.manifest_path, exc)
-        self.check_budget(last_source=entry["source"])
+                    logging.getLogger(__name__).warning(
+                        "quarantine manifest %s unwritable (%s); entry "
+                        "kept in memory only", self.manifest_path, exc)
+            violation = self._budget_violation(entry["source"])
+        # event + raise happen outside the lock (record_event feeds the
+        # metrics/trace layers — keeping the quarantine lock leaf-level
+        # keeps the static lock-order graph acyclic)
+        record_event("quarantine", **entry)
+        if violation is not None:
+            raise QuarantineBudgetExceededError(violation)
 
     # -- budget ------------------------------------------------------------
     def seen(self) -> int:
-        return self.bad_count + self.ok_count
+        with self._lock:
+            return self.bad_count + self.ok_count
 
     def bad_fraction(self) -> float:
-        return self.bad_count / max(self.seen(), 1)
+        with self._lock:
+            return self.bad_count / max(self.bad_count + self.ok_count, 1)
+
+    def _budget_violation(self, last_source: Optional[str] = None
+                          ) -> Optional[str]:
+        """Violation message, or None — caller must hold ``_lock`` (the
+        counts are read together; an unlocked read could pair a new
+        bad_count with a stale ok_count and trip a budget that holds)."""
+        seen = self.bad_count + self.ok_count
+        allowed = self.max_bad_fraction * max(seen, self.min_records)
+        if self.bad_count <= allowed:
+            return None
+        return (
+            f"{self.label}: {self.bad_count} corrupt record(s) out of "
+            f"{seen} seen exceeds the quarantine budget "
+            f"(max_bad_fraction={self.max_bad_fraction:g}, "
+            f"min_records={self.min_records}). Last quarantined "
+            f"source: {last_source or (self.records[-1]['source'] if self.records else '?')}. "
+            "The data is worse than the budget allows — fix the "
+            "source or raise max_bad_fraction explicitly.")
 
     def check_budget(self, last_source: Optional[str] = None) -> None:
-        allowed = self.max_bad_fraction * max(self.seen(),
-                                              self.min_records)
-        if self.bad_count > allowed:
-            raise QuarantineBudgetExceededError(
-                f"{self.label}: {self.bad_count} corrupt record(s) out of "
-                f"{self.seen()} seen exceeds the quarantine budget "
-                f"(max_bad_fraction={self.max_bad_fraction:g}, "
-                f"min_records={self.min_records}). Last quarantined "
-                f"source: {last_source or (self.records[-1]['source'] if self.records else '?')}. "
-                "The data is worse than the budget allows — fix the "
-                "source or raise max_bad_fraction explicitly.")
+        with self._lock:
+            violation = self._budget_violation(last_source)
+        if violation is not None:
+            raise QuarantineBudgetExceededError(violation)
 
     # -- checkpoint state --------------------------------------------------
     def state(self) -> Dict[str, Any]:

@@ -33,6 +33,7 @@ from typing import Any, Callable, Optional, Tuple, Type
 
 import numpy as np
 
+from ..utils.guarded import TracedLock
 from .events import record_event
 from .quarantine import CorruptRecordError
 
@@ -104,8 +105,10 @@ class RetryPolicy:
         self.attempt_timeout_s = attempt_timeout_s
         self.retryable = tuple(retryable)
         self.non_retryable = tuple(non_retryable)
+        # the jitter RNG draws concurrently from decode-pool threads;
+        # guarded (utils.guarded.GUARDED_FIELDS declares _rng -> _lock)
         self._rng = np.random.RandomState(seed)
-        self._lock = threading.Lock()
+        self._lock = TracedLock("retry.jitter")
 
     # -- classification ----------------------------------------------------
     def is_retryable(self, exc: BaseException) -> bool:
@@ -178,10 +181,19 @@ class RetryPolicy:
 #: jitter RNG (deterministic under a fixed seed) and zero per-call
 #: construction cost.
 _DEFAULT_POLICY: Optional[RetryPolicy] = None
+_DEFAULT_POLICY_LOCK = threading.Lock()
 
 
 def default_retry_policy() -> RetryPolicy:
+    # double-checked: two loader threads racing the first build would
+    # otherwise each keep a policy, splitting the shared jitter RNG's
+    # deterministic sequence in two (the lazy-init double-create shape
+    # the concurrency passes hunt)
     global _DEFAULT_POLICY
-    if _DEFAULT_POLICY is None:
-        _DEFAULT_POLICY = RetryPolicy()
-    return _DEFAULT_POLICY
+    policy = _DEFAULT_POLICY
+    if policy is None:
+        with _DEFAULT_POLICY_LOCK:
+            policy = _DEFAULT_POLICY
+            if policy is None:
+                policy = _DEFAULT_POLICY = RetryPolicy()
+    return policy

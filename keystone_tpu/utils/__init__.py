@@ -1,8 +1,12 @@
-"""Utilities: checkpointing, profiling (reference ``utils/`` + SURVEY.md
-section 5 auxiliary subsystems)."""
-from .checkpoint import load_pipeline, load_state, save_pipeline, save_state
-from .donation import donating_jit, donation_enabled
-from .profiling import StepTimer, trace
+"""Utilities: checkpointing, profiling, lock discipline (reference
+``utils/`` + SURVEY.md section 5 auxiliary subsystems).
+
+Submodule re-exports are lazy (PEP 562): ``utils.guarded`` is imported
+by the observability layer's class definitions, and an eager
+``checkpoint`` import here would pull resilience -> events ->
+observability back in mid-initialization (a real import cycle, hit
+when ``observability.metrics`` declared its lock discipline)."""
+from typing import Any
 
 __all__ = [
     "donating_jit",
@@ -14,3 +18,23 @@ __all__ = [
     "StepTimer",
     "trace",
 ]
+
+_HOMES = {
+    "donating_jit": "donation",
+    "donation_enabled": "donation",
+    "load_pipeline": "checkpoint",
+    "load_state": "checkpoint",
+    "save_pipeline": "checkpoint",
+    "save_state": "checkpoint",
+    "StepTimer": "profiling",
+    "trace": "profiling",
+}
+
+
+def __getattr__(name: str) -> Any:
+    home = _HOMES.get(name)
+    if home is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f".{home}", __name__), name)

@@ -1,0 +1,243 @@
+"""Declared lock discipline for shared mutable state.
+
+PRs 3-5 made keystone_tpu a genuinely concurrent system: a prefetch
+producer thread, a shared H2D staging pool, tar decode workers, retry
+helper threads, and the resilience event funnel all mutate shared state
+(`_Residency`, `MetricsRegistry`, `Quarantine`, `PipelineTrace`'s
+resilience stream). Every review round so far caught at least one real
+race by hand. This module makes the discipline *declarative* so the
+static analyzer (:mod:`keystone_tpu.analysis.concurrency`) can check it
+instead:
+
+* :func:`guarded_by` — a class decorator declaring which fields a lock
+  attribute protects. The declaration is consumed two ways: at runtime
+  it lands on ``cls.__guarded_fields__`` (introspection, tests), and
+  statically the concurrency passes read the decorator straight off the
+  AST, flagging any read-modify-write or compound mutation of a guarded
+  field outside a ``with <lock>`` scope.
+* :data:`GUARDED_FIELDS` — the same declaration as a table, for classes
+  whose definition should not grow a decorator (third-party-shaped
+  utility classes). The analyzer merges both sources.
+* :class:`TracedLock` / :class:`TracedSemaphore` — the instrumented
+  synchronization primitives the concurrent subsystems use. A
+  TracedLock's uncontended fast path is one extra branch over a plain
+  ``threading.Lock``; a *contended* acquire feeds the
+  ``lock.wait_s.<name>`` histogram and ``lock.contended_total`` counter
+  in the process :class:`MetricsRegistry` and, when a
+  :class:`PipelineTrace` is active, the trace's per-lock wait table —
+  zero overhead when untraced, same discipline as the PR 1 hooks. Both
+  primitives also expose deterministic *yield points* to the schedule
+  harness (``tests/sched.py``) through :func:`set_sched_hook`, so a
+  seeded scheduler can force chosen thread interleavings at every
+  lock/semaphore operation and replay historical races as regression
+  schedules.
+
+The metrics layer itself keeps plain ``threading.Lock``\\ s
+(``Histogram._lock`` etc.): a TracedLock's contended path *reports into*
+the metrics registry, so tracing the registry's own locks would
+re-enter them. That boundary is documented here once rather than
+allowlisted piecemeal.
+
+``KEYSTONE_TRACED_LOCKS=0`` disables the contention instrumentation
+(the lock itself stays correct) — the knob behind the measured <2%
+overhead bar in PERFORMANCE.md rule 9.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+# -- declarations ------------------------------------------------------------
+
+#: lock discipline for classes that should not grow a decorator (the
+#: analyzer merges this with ``@guarded_by`` declarations; keys are bare
+#: class names — unique within this tree). Every entry means: the named
+#: fields may only be read-modify-written / compound-mutated under
+#: ``with self.<lock_attr>``.
+GUARDED_FIELDS: Dict[str, Dict[str, str]] = {
+    # utils/lru.py — memo maps mutated from loader/prefetch threads
+    "LruMemo": {"_entries": "_lock"},
+    # resilience/retry.py — the shared jitter RNG draws concurrently
+    # from the tar decode pool
+    "RetryPolicy": {"_rng": "_lock"},
+    # resilience/faults.py — injection log + seeded RNG are hit from
+    # every instrumented ingest thread
+    "FaultPlan": {"log": "_lock", "_rng": "_lock"},
+}
+
+
+def guarded_by(lock_attr: str, *fields: str):
+    """Class decorator declaring ``fields`` guarded by ``self.<lock_attr>``.
+
+    Usage::
+
+        @guarded_by("_lock", "count", "_tail")
+        class Histogram: ...
+
+    The static concurrency passes read this off the AST; at runtime the
+    merged declaration (bases included) is ``cls.__guarded_fields__``.
+    """
+    if not fields:
+        raise ValueError("guarded_by needs at least one field name")
+
+    def wrap(cls):
+        # reversed MRO includes cls itself last: bases' declarations
+        # merge first, an earlier (stacked) decorator's own declaration
+        # survives, and this decorator's fields win ties
+        merged: Dict[str, str] = {}
+        for klass in reversed(cls.__mro__):
+            merged.update(getattr(klass, "__guarded_fields__", {}))
+        merged.update({f: lock_attr for f in fields})
+        cls.__guarded_fields__ = merged
+        return cls
+
+    return wrap
+
+
+def guarded_fields(cls) -> Dict[str, str]:
+    """The merged field->lock declaration for ``cls`` (decorator first,
+    then the :data:`GUARDED_FIELDS` table)."""
+    out = dict(getattr(cls, "__guarded_fields__", {}))
+    out.update(GUARDED_FIELDS.get(cls.__name__, {}))
+    return out
+
+
+# -- scheduler hook ----------------------------------------------------------
+
+#: when set (tests/sched.py), every TracedLock/TracedSemaphore operation
+#: calls it with a ``"<op>:<lock name>"`` tag — the yield points a
+#: deterministic scheduler uses to force chosen interleavings. None in
+#: production: the check is one global read per operation.
+_SCHED_HOOK: Optional[Callable[[str], None]] = None
+
+
+def set_sched_hook(hook: Optional[Callable[[str], None]]) -> None:
+    """Install (or clear, with None) the schedule-harness yield hook."""
+    global _SCHED_HOOK
+    _SCHED_HOOK = hook
+
+
+def sched_hook() -> Optional[Callable[[str], None]]:
+    return _SCHED_HOOK
+
+
+#: contention instrumentation switch (the lock semantics never change);
+#: KEYSTONE_TRACED_LOCKS=0 is the baseline side of the overhead
+#: measurement in PERFORMANCE.md rule 9
+_TRACE_CONTENTION = os.environ.get("KEYSTONE_TRACED_LOCKS", "1") != "0"
+
+
+def _note_contention(name: str, wait_s: float) -> None:
+    """A contended acquire happened: feed the always-on metrics and,
+    when a trace is active, the trace's per-lock wait table. Imported
+    lazily — utils must stay importable without the observability
+    layer, and the metrics layer's own (plain) locks keep this from
+    re-entering."""
+    from ..observability.metrics import MetricsRegistry
+
+    reg = MetricsRegistry.get_or_create()
+    reg.counter("lock.contended_total").inc()
+    reg.histogram(f"lock.wait_s.{name}").observe(wait_s)
+    from ..observability.trace import current_trace
+
+    trace = current_trace()
+    if trace is not None:
+        trace.record_lock_wait(name, wait_s)
+
+
+class TracedLock:
+    """A named ``threading.Lock`` with contention telemetry and
+    deterministic-schedule yield points; see the module docstring.
+
+    Fast path (uncontended, no scheduler hook): one non-blocking
+    ``acquire`` — a single extra branch over the bare primitive.
+    """
+
+    __slots__ = ("name", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        hook = _SCHED_HOOK
+        if hook is not None:
+            hook(f"lock.acquire:{self.name}")
+            # cooperative mode: spin through the scheduler so a blocked
+            # waiter parks at a yield point instead of blocking the
+            # scheduler's quiescence detection
+            deadline = (None if timeout is None or timeout < 0
+                        else time.perf_counter() + timeout)
+            while True:
+                if self._lock.acquire(False):
+                    return True
+                if not blocking:
+                    return False
+                if deadline is not None and \
+                        time.perf_counter() >= deadline:
+                    return False
+                hook(f"lock.wait:{self.name}")
+        if self._lock.acquire(False):
+            return True
+        if not blocking:
+            return False
+        t0 = time.perf_counter()
+        ok = self._lock.acquire(True, timeout)
+        if ok and _TRACE_CONTENTION:
+            _note_contention(self.name, time.perf_counter() - t0)
+        return ok
+
+    def release(self) -> None:
+        self._lock.release()
+        hook = _SCHED_HOOK
+        if hook is not None:
+            hook(f"lock.release:{self.name}")
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "TracedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class TracedSemaphore:
+    """A named ``threading.Semaphore`` with the same scheduler yield
+    points as :class:`TracedLock`. No contention metrics: a semaphore
+    wait in this tree is *backpressure by design* (the prefetcher's
+    slot gate), not contention — the ingest-stall histogram already
+    measures it from the consumer side."""
+
+    __slots__ = ("name", "_sem")
+
+    def __init__(self, name: str, value: int = 1):
+        self.name = name
+        self._sem = threading.Semaphore(value)
+
+    def acquire(self, blocking: bool = True,
+                timeout: Optional[float] = None) -> bool:
+        hook = _SCHED_HOOK
+        if hook is None:
+            return self._sem.acquire(blocking, timeout)
+        hook(f"sem.acquire:{self.name}")
+        deadline = (None if timeout is None
+                    else time.perf_counter() + timeout)
+        while True:
+            if self._sem.acquire(False):
+                return True
+            if not blocking:
+                return False
+            if deadline is not None and time.perf_counter() >= deadline:
+                return False
+            hook(f"sem.wait:{self.name}")
+
+    def release(self, n: int = 1) -> None:
+        self._sem.release(n)
+        hook = _SCHED_HOOK
+        if hook is not None:
+            hook(f"sem.release:{self.name}")

@@ -1,0 +1,33 @@
+"""Synthetic offender for the non-atomic guarded sequence pass
+(``analysis.concurrency.guarded_sequence_hazards``): a check-then-act
+on a guarded field split across two ``with`` blocks on the same lock —
+every individual access is locked, but the lock is released between
+the check and the act, so the check is stale. Never imported; parsed
+as AST by tests/tools."""
+import threading
+
+from keystone_tpu.utils.guarded import guarded_by
+
+
+@guarded_by("_lock", "items")
+class SplitCheckThenAct:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+
+    def drain_one(self):
+        with self._lock:
+            pending = len(self.items)  # the check, locked
+        if pending:
+            with self._lock:
+                # non-atomic-guarded-sequence: another thread may have
+                # drained the last item while the lock was released
+                return self.items.pop()
+        return None
+
+    def drain_one_atomic(self):
+        # clean: the lock spans the decision
+        with self._lock:
+            if self.items:
+                return self.items.pop()
+        return None

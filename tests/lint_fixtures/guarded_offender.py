@@ -1,0 +1,40 @@
+"""Synthetic offender for the guarded-by race pass
+(``analysis.concurrency.guarded_field_races``): a class that DECLARES a
+lock discipline and then mutates guarded fields outside it — the exact
+shapes that bit this repo (the PR 4 ``record_resilience``
+read-modify-write, the unlocked ``Histogram`` tail appends fixed in
+PR 7). Never imported; parsed as AST by tests and compiled by the
+schedule-harness regression tests."""
+import threading
+
+from keystone_tpu.utils.guarded import guarded_by
+
+
+@guarded_by("_lock", "count", "tail", "stats")
+class RacyLedger:
+    def __init__(self):
+        # __init__ is exempt: the object is not shared yet
+        self._lock = threading.Lock()
+        self.count = 0
+        self.tail = []
+        self.stats = {}
+
+    def bump(self):
+        self.count += 1  # guarded-field-race: RMW, no lock
+
+    def push(self, x):
+        self.tail.append(x)  # guarded-field-race: compound mutation
+
+    def merge(self, key):
+        # guarded-field-race: the PR 4 record_resilience shape — a
+        # dict read-modify-write outside the declared lock
+        self.stats[key] = self.stats.get(key, 0) + 1
+
+    def locked_bump(self):
+        with self._lock:
+            self.count += 1  # clean: the declared discipline, honored
+
+    def rebind(self, fresh):
+        # clean: a plain rebind is not an RMW (last writer wins is the
+        # semantics, like Gauge.set)
+        self.tail = list(fresh)

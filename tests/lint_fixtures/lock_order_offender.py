@@ -1,0 +1,37 @@
+"""Synthetic offender for the lock-order / blocking-under-lock passes
+(``analysis.concurrency``): two locks acquired in both orders across
+two methods (a deadlock waiting for the right schedule), plus blocking
+calls — ``queue.get``, ``Event.wait``, ``device_put`` — made while
+holding an analyzer-known lock. Never imported; parsed as AST by
+tests/tools."""
+import threading
+
+_MODULE_LOCK = threading.Lock()
+
+
+class DeadlockPair:
+    def __init__(self):
+        self._ingest = threading.Lock()
+        self._ledger = threading.Lock()
+
+    def producer_side(self):
+        with self._ingest:
+            with self._ledger:  # ingest -> ledger
+                pass
+
+    def consumer_side(self):
+        with self._ledger:
+            with self._ingest:  # ledger -> ingest: the cycle
+                pass
+
+    def stalls_everyone(self, q, ev, jax, chunk):
+        with self._ingest:
+            item = q.get(timeout=1.0)      # blocking-under-lock
+            ev.wait()                      # blocking-under-lock
+            staged = jax.device_put(chunk)  # blocking-under-lock
+            return item, staged
+
+    def module_nesting(self):
+        with _MODULE_LOCK:
+            with self._ingest:  # module lock -> instance lock edge
+                pass

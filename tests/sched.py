@@ -1,0 +1,236 @@
+"""Deterministic-interleaving schedule harness (loom-style, scaled to
+this repo's needs).
+
+The concurrency passes (``analysis.concurrency``) prove the DECLARED
+lock discipline is honored; this harness proves the discipline is
+SUFFICIENT — by forcing the thread interleavings that break undisciplined
+code. Two modes:
+
+* :class:`DeterministicScheduler` — cooperative scheduling of threads
+  it spawned. Exactly one spawned thread runs between *yield points*;
+  at each yield point the scheduler picks who runs next, either from an
+  explicit ``picks`` script (a regression schedule: the exact
+  interleaving that reproduces a historical race) or from a seeded RNG
+  (a random schedule; N seeds = N distinct interleavings, each
+  replayable from its seed). Yield points come from two places: the
+  instrumented primitives (``utils.guarded.TracedLock`` /
+  ``TracedSemaphore`` call the installed hook at every
+  acquire/wait/release — entering ``with sched:`` installs it), and
+  explicit ``sched.yield_point(tag)`` calls marking the racy window in
+  offender copies (the way loom models an atomic access). Threads the
+  scheduler did not spawn pass through yield points untouched.
+
+  A thread that blocks in a REAL primitive while another holds it
+  would stall the scheduler's quiescence detection — that is why
+  TracedLock spins through the hook instead of blocking when a hook is
+  installed: lock waits park at yield points like everything else.
+
+* :func:`chaos` — seeded perturbation at the same yield points (tiny
+  sleeps / GIL yields drawn from one seeded RNG) for stressing REAL
+  threaded code paths end to end (the prefetcher fuzz), where full
+  cooperative control is impossible because library internals also
+  block. Not a total order like the scheduler, but seeded: a failing
+  seed reliably perturbs the same sites.
+
+Used by tests/test_concurrency_sched.py: each historical race carries a
+regression schedule that reproduces it on an un-fixed offender copy and
+passes on HEAD, and the prefetcher survives a seeded many-schedule
+fuzz.
+"""
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from keystone_tpu.utils.guarded import set_sched_hook
+
+
+class ScheduleError(RuntimeError):
+    """The schedule could not make progress (a real deadlock, a pick
+    naming no parked thread, or max_steps exhausted)."""
+
+
+class _TState:
+    __slots__ = ("name", "thread", "parked", "finished", "tag")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.thread: Optional[threading.Thread] = None
+        self.parked = False
+        self.finished = False
+        self.tag = ""
+
+
+class DeterministicScheduler:
+    """Cooperative seeded/scripted scheduler; see module docstring.
+
+    Usage (a regression schedule)::
+
+        sched = DeterministicScheduler(picks=["a", "b", "a", "b"])
+        sched.spawn(writer_one, name="a")
+        sched.spawn(writer_two, name="b")
+        with sched:           # installs the TracedLock yield hook
+            sched.run()
+
+    ``picks`` entries are thread names (or substrings); when the script
+    runs out, the seeded RNG picks. ``run`` re-raises the first
+    exception a spawned thread died with.
+    """
+
+    def __init__(self, seed: int = 0,
+                 picks: Optional[List[str]] = None,
+                 max_steps: int = 20000):
+        self._rng = random.Random(seed)
+        self._picks = list(picks or [])
+        self._max_steps = int(max_steps)
+        self._cv = threading.Condition()
+        self._by_thread: Dict[threading.Thread, _TState] = {}
+        self._states: List[_TState] = []
+        self._errors: List[tuple] = []
+        self._stopping = False
+        self.steps: List[str] = []  # granted (name, tag) log, for debug
+
+    # -- building ----------------------------------------------------------
+    def spawn(self, fn: Callable[..., Any], *args: Any,
+              name: Optional[str] = None, **kwargs: Any) -> str:
+        name = name or f"t{len(self._states)}"
+        st = _TState(name)
+
+        def body():
+            self._park(st, "start")  # every thread starts parked
+            try:
+                fn(*args, **kwargs)
+            except BaseException as exc:
+                with self._cv:
+                    self._errors.append((name, exc))
+            finally:
+                with self._cv:
+                    st.finished = True
+                    st.parked = False
+                    self._cv.notify_all()
+
+        st.thread = threading.Thread(
+            target=body, name=f"sched-{name}", daemon=True)
+        self._states.append(st)
+        self._by_thread[st.thread] = st
+        return name
+
+    # -- yield points ------------------------------------------------------
+    def yield_point(self, tag: str = "") -> None:
+        """Park the calling thread until the scheduler grants it. A
+        no-op for threads this scheduler did not spawn (so installing
+        the global hook cannot disturb unrelated background threads)
+        and while the scheduler is unwinding after an error."""
+        st = self._by_thread.get(threading.current_thread())
+        if st is None or self._stopping:
+            return
+        self._park(st, tag)
+
+    def _park(self, st: _TState, tag: str) -> None:
+        with self._cv:
+            st.parked = True
+            st.tag = tag
+            self._cv.notify_all()
+            while st.parked and not self._stopping:
+                self._cv.wait(0.5)
+
+    # -- driving -----------------------------------------------------------
+    def _choose(self, parked: List[_TState]) -> _TState:
+        while self._picks:
+            pick = self._picks.pop(0)
+            for st in parked:
+                if st.name == pick or pick in st.name:
+                    return st
+            # the picked thread already finished (or is not parked at
+            # this step) — scripts may be written loosely; fall through
+            # to the next pick rather than deadlocking the schedule
+        return self._rng.choice(sorted(parked, key=lambda s: s.name))
+
+    def run(self, timeout: float = 30.0) -> None:
+        for st in self._states:
+            st.thread.start()
+        deadline = time.monotonic() + timeout
+        steps = 0
+        try:
+            with self._cv:
+                while True:
+                    if self._errors:
+                        break
+                    live = [s for s in self._states if not s.finished]
+                    if not live:
+                        break
+                    parked = [s for s in live if s.parked]
+                    if len(parked) < len(live):
+                        # someone is still running between yield points
+                        if not self._cv.wait(
+                                timeout=max(deadline - time.monotonic(),
+                                            0.01)):
+                            raise ScheduleError(
+                                "schedule stalled: threads "
+                                f"{[s.name for s in live if not s.parked]}"
+                                " neither parked nor finished within "
+                                f"{timeout:g}s — a real block outside "
+                                "the instrumented primitives?")
+                        continue
+                    steps += 1
+                    if steps > self._max_steps:
+                        raise ScheduleError(
+                            f"schedule exceeded {self._max_steps} steps "
+                            "(livelock? every thread spinning on a held "
+                            "lock)")
+                    nxt = self._choose(parked)
+                    self.steps.append(f"{nxt.name}:{nxt.tag}")
+                    nxt.parked = False
+                    self._cv.notify_all()
+        finally:
+            # unwind: release every parked thread so it can finish (or
+            # die) on its own — they are daemonic, so a thread stuck on
+            # a real lock cannot hang the test session
+            with self._cv:
+                self._stopping = True
+                for s in self._states:
+                    s.parked = False
+                self._cv.notify_all()
+            for s in self._states:
+                s.thread.join(timeout=2.0)
+        if self._errors:
+            name, exc = self._errors[0]
+            raise exc
+
+    # -- hook install ------------------------------------------------------
+    def __enter__(self) -> "DeterministicScheduler":
+        set_sched_hook(self.yield_point)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        set_sched_hook(None)
+
+
+@contextlib.contextmanager
+def chaos(seed: int = 0, sleep_p: float = 0.3, max_sleep_s: float = 1e-4):
+    """Seeded perturbation at every TracedLock/TracedSemaphore yield
+    point: with probability ``sleep_p`` a tiny seeded sleep, with the
+    same probability a bare GIL yield, else nothing. The draw sequence
+    is deterministic per seed; the resulting interleaving is not a
+    total order (real primitives still block), but N seeds reliably
+    explore N different perturbation patterns of the real code path —
+    the fuzz mode for the prefetcher's slot-gated staging."""
+    rng = random.Random(seed)
+    lock = threading.Lock()
+
+    def hook(tag: str) -> None:
+        with lock:
+            r = rng.random()
+        if r < sleep_p:
+            time.sleep(r * max_sleep_s)
+        elif r < 2 * sleep_p:
+            time.sleep(0)  # bare GIL yield
+
+    set_sched_hook(hook)
+    try:
+        yield
+    finally:
+        set_sched_hook(None)

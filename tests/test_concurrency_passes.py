@@ -1,0 +1,258 @@
+"""Concurrency-safety static passes (analysis/concurrency.py): each of
+the three families — guarded-by race, lock-order/deadlock +
+blocking-under-lock, non-atomic guarded sequence — fires on its
+synthetic offender fixture (tests/lint_fixtures) and reports the scoped
+package tree clean; the declarations themselves (``@guarded_by`` +
+``GUARDED_FIELDS``) are introspectable at runtime."""
+import ast
+import pathlib
+
+import pytest
+
+from keystone_tpu.analysis.concurrency import (
+    CONCURRENCY_SCOPES,
+    blocking_under_lock,
+    find_lock_cycles,
+    guarded_classes,
+    guarded_field_races,
+    guarded_sequence_hazards,
+    known_locks,
+    lock_order_edges,
+    scan_package,
+)
+from keystone_tpu.utils.guarded import GUARDED_FIELDS, guarded_fields
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXTURES = pathlib.Path(__file__).resolve().parent / "lint_fixtures"
+
+
+def _tree(name):
+    return ast.parse((FIXTURES / f"{name}.py").read_text())
+
+
+# -- declarations ------------------------------------------------------------
+
+def test_guarded_by_decorator_lands_on_class_and_ast():
+    from lint_fixtures.guarded_offender import RacyLedger
+
+    assert guarded_fields(RacyLedger) == {
+        "count": "_lock", "tail": "_lock", "stats": "_lock"}
+    classes = guarded_classes(_tree("guarded_offender"))
+    assert classes["RacyLedger"] == {
+        "count": "_lock", "tail": "_lock", "stats": "_lock"}
+
+
+def test_guarded_fields_table_merges_for_undecorated_classes():
+    from keystone_tpu.utils.lru import LruMemo
+
+    assert guarded_fields(LruMemo) == {"_entries": "_lock"}
+    # and the analyzer sees table entries off a bare AST too
+    src = "class LruMemo:\n    def f(self):\n        self._entries.clear()\n"
+    hits = guarded_field_races(ast.parse(src))
+    assert [c for _, c, _ in hits] == ["guarded-field-race"]
+
+
+def test_shipped_declarations_cover_the_shared_state_inventory():
+    """The registry covers the classes worker threads actually mutate
+    (the README 'Concurrency model' inventory)."""
+    from keystone_tpu.observability.metrics import (
+        Counter,
+        Histogram,
+        MetricsRegistry,
+    )
+    from keystone_tpu.observability.trace import PipelineTrace
+    from keystone_tpu.parallel.streaming import _Residency
+    from keystone_tpu.resilience.quarantine import Quarantine
+
+    assert guarded_fields(Histogram)["_tail"] == "_lock"
+    assert guarded_fields(Counter)["value"] == "_lock"
+    assert guarded_fields(MetricsRegistry)["_counters"] == "_lock"
+    assert guarded_fields(Quarantine)["bad_count"] == "_lock"
+    assert guarded_fields(PipelineTrace)["resilience_stats"] == \
+        "_resilience_lock"
+    assert guarded_fields(PipelineTrace)["lock_waits"] == "_lock_wait_lock"
+    assert guarded_fields(_Residency)["peak"] == "_lock"
+    assert set(GUARDED_FIELDS) >= {"LruMemo", "RetryPolicy", "FaultPlan"}
+
+
+# -- pass 1: guarded-by race -------------------------------------------------
+
+def test_guarded_race_fires_on_offender_fixture():
+    hits = guarded_field_races(_tree("guarded_offender"))
+    codes = {c for _, c, _ in hits}
+    assert codes == {"guarded-field-race"}
+    # one per racy method: RMW, compound append, dict RMW — and NOT the
+    # locked method, NOT the plain rebind, NOT __init__
+    assert len(hits) == 3
+    by_msg = " ".join(m for _, _, m in hits)
+    assert "read-modify-write" in by_msg
+    assert ".append()" in by_msg
+    assert "item assignment" in by_msg
+    assert "locked_bump" not in by_msg
+    assert "rebind" not in by_msg
+
+
+def test_guarded_race_allowlist_suppresses_with_entry():
+    hits = guarded_field_races(
+        _tree("guarded_offender"),
+        allowlist={"RacyLedger.bump:count", "RacyLedger.push:tail"})
+    assert len(hits) == 1  # only the dict RMW remains
+    assert "merge" in hits[0][2]
+
+
+def test_guarded_race_catches_the_pre_pr4_trace_shape():
+    """The exact record_resilience read-modify-write PR 4's review
+    caught by hand is now machine-found."""
+    src = (
+        "class PipelineTrace:\n"
+        "    def record_resilience(self, entry):\n"
+        "        ev = str(entry.get('event', 'other'))\n"
+        "        self.resilience_stats[ev] = "
+        "self.resilience_stats.get(ev, 0) + 1\n"
+        "        self.resilience.append(entry)\n")
+    extra = {"PipelineTrace": {"resilience": "_resilience_lock",
+                               "resilience_stats": "_resilience_lock"}}
+    hits = guarded_field_races(ast.parse(src), extra=extra)
+    assert len(hits) == 2
+    assert {c for _, c, _ in hits} == {"guarded-field-race"}
+
+
+def test_guarded_race_catches_the_pre_pr7_histogram_shape():
+    src = (
+        "from keystone_tpu.utils.guarded import guarded_by\n"
+        "@guarded_by('_lock', 'count', '_tail')\n"
+        "class Histogram:\n"
+        "    def observe(self, value):\n"
+        "        self.count += 1\n"
+        "        self._tail.append(value)\n"
+        "        if len(self._tail) > 256:\n"
+        "            del self._tail[:1]\n")
+    hits = guarded_field_races(ast.parse(src))
+    assert len(hits) == 3
+
+
+# -- pass 2: lock order + blocking-under-lock --------------------------------
+
+def test_lock_order_cycle_fires_on_offender_fixture():
+    tree = _tree("lock_order_offender")
+    edges = lock_order_edges(tree, "lint_fixtures.lock_order_offender")
+    cycles = find_lock_cycles(edges)
+    assert len(cycles) == 1
+    path, sites = cycles[0]
+    assert set(path) == {"DeadlockPair._ingest", "DeadlockPair._ledger"}
+    assert "producer_side" in sites and "consumer_side" in sites
+
+
+def test_module_level_lock_edges_are_tracked():
+    tree = _tree("lock_order_offender")
+    mod_locks, cls_locks = known_locks(tree)
+    assert mod_locks == {"_MODULE_LOCK"}
+    assert cls_locks["DeadlockPair"] == {"_ingest", "_ledger"}
+    edges = lock_order_edges(tree, "m")
+    assert ("m._MODULE_LOCK", "DeadlockPair._ingest") in {
+        (a, b) for a, b, _, _ in edges}
+
+
+def test_blocking_under_lock_fires_on_offender_fixture():
+    hits = blocking_under_lock(_tree("lock_order_offender"), "m")
+    attrs = sorted(m.split("`")[1] for _, _, m in hits)
+    assert attrs == ["device_put()", "get()", "wait()"]
+    assert all(c == "blocking-under-lock" for _, c, _ in hits)
+
+
+def test_blocking_under_lock_ignores_dict_get():
+    # `.get` is only blocking on queue-shaped receivers: dict lookups
+    # under a lock are the normal registry pattern, never flagged
+    src = (
+        "import threading\n"
+        "class Reg:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def lookup(self, table, name):\n"
+        "        with self._lock:\n"
+        "            return table.get(name)\n")
+    assert blocking_under_lock(ast.parse(src), "m") == []
+
+
+# -- pass 3: non-atomic guarded sequence -------------------------------------
+
+def test_sequence_hazard_fires_on_offender_fixture():
+    hits = guarded_sequence_hazards(_tree("atomicity_offender"))
+    assert len(hits) == 1
+    lineno, code, msg = hits[0]
+    assert code == "non-atomic-guarded-sequence"
+    assert "drain_one" in msg and "items" in msg
+    assert "drain_one_atomic" not in msg
+
+
+def test_sequence_hazard_allowlist():
+    hits = guarded_sequence_hazards(
+        _tree("atomicity_offender"),
+        allowlist={"SplitCheckThenAct.drain_one:items"})
+    assert hits == []
+
+
+# -- the tree is clean -------------------------------------------------------
+
+def test_package_tree_is_concurrency_clean():
+    """All three families over the shipped tree: zero diagnostics (the
+    satellite fixes landed; deliberate exceptions live in the commented
+    CONCURRENCY_ALLOWLIST)."""
+    hits = scan_package(REPO / "keystone_tpu")
+    assert hits == [], hits
+
+
+def test_scan_package_reports_offenders_when_present(tmp_path):
+    pkg = tmp_path / "pkg"
+    (pkg / "parallel").mkdir(parents=True)
+    (pkg / "parallel" / "racy.py").write_text(
+        (FIXTURES / "guarded_offender.py").read_text())
+    hits = scan_package(pkg)
+    assert {h["code"] for h in hits} == {"guarded-field-race"}
+    assert all(h["file"].endswith("racy.py") for h in hits)
+
+
+def test_scopes_cover_the_threaded_subsystems():
+    assert set(CONCURRENCY_SCOPES) >= {
+        "loaders", "observability", "parallel", "resilience", "utils"}
+
+
+# -- wiring: lint + check CLI ------------------------------------------------
+
+def test_lint_gate_runs_concurrency_passes(tmp_path, monkeypatch):
+    """tools/lint.py fails when a scoped module has a concurrency
+    diagnostic (wired like SWALLOW_ALL_SCOPES)."""
+    import sys
+
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import lint
+    finally:
+        sys.path.pop(0)
+    pkg = tmp_path / "keystone_tpu"
+    (pkg / "parallel").mkdir(parents=True)
+    (pkg / "parallel" / "bad.py").write_text(
+        (FIXTURES / "lock_order_offender.py").read_text())
+    monkeypatch.setattr(lint, "REPO", tmp_path)
+    monkeypatch.setattr(lint, "PKG", pkg)
+    assert lint.run_concurrency_rules() > 0
+
+
+@pytest.mark.slow
+def test_check_cli_includes_concurrency_diagnostics(tmp_path):
+    """`python -m keystone_tpu check <app> --json` carries the
+    tree-wide concurrency scan (clean today) and exits 0."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    out = tmp_path / "report.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+    proc = subprocess.run(
+        [sys.executable, "-m", "keystone_tpu", "check",
+         "mnist.random_fft", "--json", str(out)],
+        cwd=REPO, capture_output=True, text=True, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    blob = json.loads(out.read_text())
+    assert blob["concurrency"] == []

@@ -1,0 +1,675 @@
+"""Deterministic-interleaving regression schedules + seeded stress
+(tests/sched.py harness over utils.guarded's instrumented primitives).
+
+Each historical race carries a schedule that REPRODUCES it on an
+un-fixed offender copy and passes on shipped HEAD:
+
+* PR 4: ``PipelineTrace.record_resilience`` read-modify-write on the
+  stats dict from concurrent ingest worker threads (caught by review
+  then; machine-found and schedule-pinned now).
+* PR 3: the producer/consumer residency-ledger close race — a consumer
+  closing the shared ledger while the producer is still mid-stage
+  permanently inflates it (fixed by join-before-close + the producer's
+  self-close; the schedule shows the un-fixed teardown leaking).
+
+Plus: the ``_CAST_JIT_CACHE`` check-then-act double-create fixed this
+PR, TracedLock/TracedSemaphore semantics and contention telemetry, the
+seeded chaos fuzz of the prefetcher's slot-gated staging (bounded here,
+200 seeds under ``slow``), and the interpreter-exit teardown subprocess
+pin (leaked non-daemon H2D pool threads)."""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import sched as sched_mod
+from sched import DeterministicScheduler, ScheduleError, chaos
+
+from keystone_tpu.observability.metrics import MetricsRegistry
+from keystone_tpu.observability.trace import PipelineTrace
+from keystone_tpu.utils import guarded
+from keystone_tpu.utils.guarded import TracedLock, TracedSemaphore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_hook():
+    """The yield hook is process-global: never leak one across tests."""
+    yield
+    guarded.set_sched_hook(None)
+
+
+def test_harness_is_ours_not_stdlib_sched():
+    # tests/sched.py shadows the (practically unused) stdlib `sched`
+    # module inside the test tree; make the shadowing explicit so a
+    # future import surprise fails here, not somewhere weird
+    assert hasattr(sched_mod, "DeterministicScheduler")
+
+
+# -- scheduler basics --------------------------------------------------------
+
+def test_scripted_picks_order_is_deterministic():
+    log = []
+
+    def worker(tag, sched):
+        sched.yield_point(f"{tag}.mid")
+        log.append(tag)
+
+    sched = DeterministicScheduler(picks=["b", "b", "a", "a"])
+    sched.spawn(worker, "a", sched, name="a")
+    sched.spawn(worker, "b", sched, name="b")
+    with sched:
+        sched.run()
+    assert log == ["b", "a"]
+
+
+def test_seeded_schedules_replay_exactly():
+    def run_once(seed):
+        log = []
+
+        def worker(tag, sched):
+            for i in range(3):
+                sched.yield_point(f"{tag}.{i}")
+                log.append(f"{tag}{i}")
+
+        sched = DeterministicScheduler(seed=seed)
+        for t in ("a", "b", "c"):
+            sched.spawn(worker, t, sched, name=t)
+        with sched:
+            sched.run()
+        return log
+
+    assert run_once(7) == run_once(7)
+    # different seeds explore different interleavings (not a proof,
+    # but 3 threads x 3 yields has 1680 orders; identical would be odd)
+    assert any(run_once(7) != run_once(s) for s in range(1, 6))
+
+
+def test_unregistered_threads_pass_through_yield_points():
+    sched = DeterministicScheduler()
+    done = threading.Event()
+
+    def outsider():
+        sched.yield_point("outsider")  # must be a no-op
+        done.set()
+
+    t = threading.Thread(target=outsider)
+    t.start()
+    t.join(timeout=5)
+    assert done.is_set()
+
+
+def test_spawned_thread_exception_propagates():
+    def boom(sched):
+        sched.yield_point("pre")
+        raise ValueError("from schedule")
+
+    sched = DeterministicScheduler()
+    sched.spawn(boom, sched, name="boom")
+    with sched, pytest.raises(ValueError, match="from schedule"):
+        sched.run()
+
+
+def test_traced_lock_waiters_park_instead_of_blocking():
+    """A thread blocked on a TracedLock held by a parked sibling parks
+    at a yield point — the property that keeps the scheduler live (a
+    plain Lock here would stall the schedule and raise)."""
+    lock = TracedLock("t.park")
+    order = []
+
+    def holder(sched):
+        with lock:
+            sched.yield_point("holding")
+            order.append("holder")
+
+    def waiter():
+        with lock:
+            order.append("waiter")
+
+    sched = DeterministicScheduler(picks=["h", "h", "w", "h"])
+    sched.spawn(holder, sched, name="h")
+    sched.spawn(waiter, name="w")
+    with sched:
+        sched.run()
+    assert order == ["holder", "waiter"]
+
+
+# -- historical race 1: PR 4 record_resilience RMW ---------------------------
+
+class _YieldingDict(dict):
+    """Marks the racy read inside the RMW window as a yield point (the
+    loom-style 'atomic access is a scheduling point' trick) — the SAME
+    instrumented dict backs the offender and the shipped code, so the
+    only difference under the schedule is the lock."""
+
+    def __init__(self, sched):
+        super().__init__()
+        self._sched = sched
+
+    def get(self, key, default=None):
+        value = super().get(key, default)
+        # park AFTER the read, INSIDE the read-modify-write window:
+        # the value this thread will add to is already fetched
+        self._sched.yield_point("stats.get")
+        return value
+
+
+class _UnfixedTrace(PipelineTrace):
+    """The pre-PR-4 record_resilience: same body, no lock."""
+
+    def record_resilience(self, entry):
+        event = str(entry.get("event", "other"))
+        self.resilience_stats[event] = (
+            self.resilience_stats.get(event, 0) + 1)
+        self.resilience.append(entry)
+
+
+_RACE_SCHEDULE = ["a", "b"] * 12  # interleave every yield point
+
+
+def _drive_two_records(trace_obj, picks):
+    sched = DeterministicScheduler(picks=list(picks))
+    trace_obj.resilience_stats = _YieldingDict(sched)
+    for name in ("a", "b"):
+        sched.spawn(trace_obj.record_resilience, {"event": "retry"},
+                    name=name)
+    with sched:
+        sched.run()
+    return int(trace_obj.resilience_stats.get("retry", 0))
+
+
+def test_pr4_rmw_race_reproduces_on_unfixed_copy():
+    # both threads read 0 before either writes: one update is lost —
+    # deterministically, under the scripted interleaving
+    assert _drive_two_records(_UnfixedTrace(), _RACE_SCHEDULE) == 1
+
+
+def test_pr4_rmw_race_fixed_on_head():
+    # same schedule, same instrumented dict — the TracedLock serializes
+    # the RMW, so the count is exact
+    assert _drive_two_records(PipelineTrace(), _RACE_SCHEDULE) == 2
+
+
+def test_pr4_fix_survives_seeded_random_schedules():
+    for seed in range(40):
+        tr = PipelineTrace()
+        sched = DeterministicScheduler(seed=seed)
+        tr.resilience_stats = _YieldingDict(sched)
+        for name in ("a", "b", "c"):
+            sched.spawn(tr.record_resilience, {"event": "retry"},
+                        name=name)
+        with sched:
+            sched.run()
+        assert tr.resilience_stats.get("retry") == 3, f"seed {seed}"
+        assert tr.resilience_stats["retry"] == len(tr.resilience)
+
+
+# -- historical race 2: PR 3 producer/consumer ledger close ------------------
+
+def _ledger():
+    from keystone_tpu.parallel.streaming import _IterLedger, _Residency
+
+    return _Residency(), _IterLedger()
+
+
+_CLOSE_SCHEDULE = ["consumer", "consumer", "producer"] + ["producer"] * 8
+
+
+def test_pr3_ledger_close_race_reproduces_on_unfixed_teardown():
+    """The pre-round-2 teardown: the consumer closes the shared ledger
+    WITHOUT joining the producer and the producer never self-closes —
+    a stage() landing after close() inflates the shared residency
+    forever (the next epoch's budget assert would trip spuriously)."""
+    res, it = _ledger()
+
+    def producer(sched):
+        sched.yield_point("mid-stage")  # the producer is inside _stage
+        res.stage(it, 100.0)
+
+    def consumer():
+        res.close(it)  # un-fixed: no join, no producer self-close
+
+    sched = DeterministicScheduler(picks=list(_CLOSE_SCHEDULE))
+    sched.spawn(producer, sched, name="producer")
+    sched.spawn(consumer, name="consumer")
+    with sched:
+        sched.run()
+    assert res.live() == 100.0  # leaked — the bug, reproduced
+
+
+def test_pr3_ledger_close_fixed_shape_survives_both_orders():
+    """The shipped teardown contract (producer self-closes when it
+    observes stop; close() is idempotent) drains the ledger under the
+    exact leaking schedule AND the benign one."""
+    for picks in (_CLOSE_SCHEDULE, ["producer"] * 8 + ["consumer"] * 4):
+        res, it = _ledger()
+        stop = threading.Event()
+
+        def producer(sched):
+            sched.yield_point("mid-stage")
+            res.stage(it, 100.0)
+            if stop.is_set():
+                res.close(it)  # the shipped produce() finally
+
+        def consumer():
+            stop.set()
+            res.close(it)
+
+        sched = DeterministicScheduler(picks=list(picks))
+        sched.spawn(producer, sched, name="producer")
+        sched.spawn(consumer, name="consumer")
+        with sched:
+            sched.run()
+        assert res.live() == 0.0, picks
+
+
+def test_pr3_real_stream_early_exit_drains_ledger(mesh8):
+    """Shipped end-to-end: breaking out of a real prefetched stream
+    leaves zero residual residency, under seeded chaos at every
+    lock/semaphore operation."""
+    from keystone_tpu.parallel.streaming import StreamingDataset
+
+    X = np.arange(64 * 8, dtype=np.float32).reshape(64, 8)
+    for seed in range(8):
+        stream = StreamingDataset.from_numpy(X, chunk_size=16, mesh=mesh8)
+        with chaos(seed=seed):
+            for i, chunk in enumerate(stream.chunks()):
+                if i == 1:
+                    break  # early exit with chunks still staged
+        deadline = time.monotonic() + 5.0
+        while stream.buffered_nbytes() and time.monotonic() < deadline:
+            time.sleep(0.01)  # producer may still be unwinding
+        assert stream.buffered_nbytes() == 0.0, f"seed {seed}"
+
+
+# -- this PR's fix: unlocked Histogram RMW -----------------------------------
+
+class _HistogramRmwReplica:
+    """The Histogram.observe count update, desugared (`+= 1` IS
+    read-then-write) with the racy window marked — un-fixed (no lock)
+    vs fixed (the shipped locked structure, with a TracedLock so the
+    waiter parks for the scheduler)."""
+
+    def __init__(self, locked):
+        self.count = 0
+        self.locked = locked
+        self._lock = TracedLock("hist.replica")
+
+    def observe(self, sched):
+        if self.locked:
+            with self._lock:
+                c = self.count
+                sched.yield_point("rmw")
+                self.count = c + 1
+        else:
+            c = self.count
+            sched.yield_point("rmw")
+            self.count = c + 1
+
+
+def _drive_observes(locked, picks):
+    h = _HistogramRmwReplica(locked)
+    sched = DeterministicScheduler(picks=list(picks))
+    for name in ("a", "b"):
+        sched.spawn(h.observe, sched, name=name)
+    with sched:
+        sched.run()
+    return h.count
+
+
+def test_histogram_rmw_race_reproduces_unlocked():
+    assert _drive_observes(False, ["a", "b"] * 8) == 1  # lost update
+
+
+def test_histogram_rmw_fixed_shape_survives():
+    assert _drive_observes(True, ["a", "b"] * 8) == 2
+    for seed in range(20):
+        h = _HistogramRmwReplica(True)
+        sched = DeterministicScheduler(seed=seed)
+        for name in ("a", "b", "c"):
+            sched.spawn(h.observe, sched, name=name)
+        with sched:
+            sched.run()
+        assert h.count == 3, f"seed {seed}"
+
+
+def test_shipped_histogram_exact_under_thread_hammer():
+    from keystone_tpu.observability.metrics import Histogram
+
+    h = Histogram("hammer")
+    n, per = 8, 5000
+    threads = [threading.Thread(
+        target=lambda: [h.observe(1.0) for _ in range(per)])
+        for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert h.count == n * per
+    assert h.total == float(n * per)
+
+
+# -- this PR's fix: quarantine manifest write racing state() -----------------
+
+def test_quarantine_manifest_write_race(tmp_path):
+    """Pre-PR-7, the JSONL manifest append ran OUTSIDE the lock: a
+    checkpoint's state() snapshot could count a record whose manifest
+    line was not yet written (replayed resume then trusts a manifest
+    missing a known-bad record). The schedule reproduces the
+    inconsistency on the un-fixed copy; shipped HEAD holds
+    state-never-leads-manifest under the same schedule."""
+    import json
+
+    from keystone_tpu.resilience.quarantine import Quarantine
+
+    class UnfixedQuarantine(Quarantine):
+        def quarantine(self, source, reason, site="ingest.decode",
+                       _sched=None):
+            entry = {"source": str(source), "reason": str(reason),
+                     "site": site}
+            with self._lock:
+                if entry["source"] in self._keys:
+                    return
+                self._keys.add(entry["source"])
+                self.bad_count += 1
+                self.records.append(entry)
+            _sched.yield_point("pre-manifest")  # lock dropped, file not written
+            with open(self.manifest_path, "a") as f:
+                f.write(json.dumps(entry) + "\n")
+
+    # 3 worker grants park it exactly PAST the count mutation (lock
+    # released) and BEFORE the manifest write; the snapshotter then
+    # observes; remaining grants let the worker finish
+    picks = ["worker"] * 3 + ["snap"] * 4 + ["worker"] * 4
+    bad_path = tmp_path / "bad.jsonl"
+    bad_path.touch()
+    q_bad = UnfixedQuarantine(max_bad_fraction=1.0,
+                              manifest_path=str(bad_path))
+    sched = DeterministicScheduler(picks=list(picks))
+    seen = {}
+
+    def worker():
+        q_bad.quarantine("tar::bad.jpg", "truncated", _sched=sched)
+
+    def snapshotter():
+        state = q_bad.state()
+        lines = [ln for ln in bad_path.read_text().splitlines() if ln]
+        seen["state_bad"] = state["bad_count"]
+        seen["manifest_lines"] = len(lines)
+
+    sched.spawn(worker, name="worker")
+    sched.spawn(snapshotter, name="snap")
+    with sched:
+        sched.run()
+    # reproduced: the snapshot counted a record the manifest lacks
+    assert seen["state_bad"] == 1 and seen["manifest_lines"] == 0
+
+    good_path = tmp_path / "good.jsonl"
+    good_path.touch()
+    q_ok = Quarantine(max_bad_fraction=1.0, manifest_path=str(good_path))
+    sched2 = DeterministicScheduler(picks=list(picks))
+    seen2 = {}
+
+    def worker2():
+        q_ok.quarantine("tar::bad.jpg", "truncated")
+
+    def snapshotter2():
+        state = q_ok.state()
+        lines = [ln for ln in good_path.read_text().splitlines() if ln]
+        seen2["state_bad"] = state["bad_count"]
+        seen2["manifest_lines"] = len(lines)
+
+    sched2.spawn(worker2, name="worker")
+    sched2.spawn(snapshotter2, name="snap")
+    with sched2:
+        sched2.run()
+    # shipped: whatever the snapshot counted is durably in the manifest
+    assert seen2["manifest_lines"] >= seen2["state_bad"]
+    assert seen2["state_bad"] == 1 or seen2["manifest_lines"] == 1
+
+
+# -- this PR's fix: _CAST_JIT_CACHE double-create ----------------------------
+
+def test_cast_program_build_race_yields_one_program():
+    """Two prefetch threads racing a cold cast cache must end up with
+    the SAME compiled program object: jax's trace cache keys on the
+    function object, so a per-thread wrapper recompiles the cast every
+    chunk (the check-then-act fixed this PR)."""
+    import jax
+
+    from keystone_tpu.parallel import streaming
+
+    streaming._CAST_JIT_CACHE.clear()
+    _, treedef = jax.tree_util.tree_flatten({"x": np.zeros(2, np.uint8)})
+    casts = (np.dtype(np.float32),)
+    got = {}
+
+    def build(name):
+        got[name] = streaming._cast_program(treedef, casts)
+
+    sched = DeterministicScheduler(picks=["a", "b"] * 10)
+    sched.spawn(build, "a", name="a")
+    sched.spawn(build, "b", name="b")
+    with sched:
+        sched.run()
+    assert got["a"] is got["b"]
+
+
+def test_metrics_registry_singleton_survives_thread_hammer():
+    MetricsRegistry.reset()
+    seen = []
+    barrier = threading.Barrier(8)
+
+    def grab():
+        barrier.wait()
+        seen.append(MetricsRegistry.get_or_create())
+
+    threads = [threading.Thread(target=grab) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=5)
+    assert all(r is seen[0] for r in seen)
+
+
+# -- TracedLock / TracedSemaphore semantics + telemetry ----------------------
+
+def test_traced_lock_contention_feeds_metrics_and_trace():
+    MetricsRegistry.reset()
+    lock = TracedLock("test.contended")
+    entered = threading.Event()
+    with PipelineTrace("locks") as tr:
+        def contender():
+            entered.set()
+            with lock:
+                pass
+
+        lock.acquire()
+        t = threading.Thread(target=contender)
+        t.start()
+        entered.wait(timeout=5)
+        time.sleep(0.05)  # let the contender reach the blocking acquire
+        lock.release()
+        t.join(timeout=5)
+    reg = MetricsRegistry.get_or_create()
+    hist = reg.histogram("lock.wait_s.test.contended")
+    assert hist.count == 1
+    assert reg.counter("lock.contended_total").value >= 1
+    assert tr.lock_waits["test.contended"]["count"] == 1
+    assert "contended locks" in tr.summary()
+    # and the wait table round-trips through the JSON artifact
+    back = PipelineTrace.from_json(tr.to_json())
+    assert back.lock_waits["test.contended"]["count"] == 1
+
+
+def test_traced_lock_uncontended_fast_path_records_nothing():
+    MetricsRegistry.reset()
+    lock = TracedLock("test.quiet")
+    for _ in range(100):
+        with lock:
+            pass
+    assert "lock.wait_s.test.quiet" not in \
+        MetricsRegistry.get_or_create().snapshot()["histograms"]
+
+
+def test_traced_lock_instrumentation_opt_out(monkeypatch):
+    monkeypatch.setattr(guarded, "_TRACE_CONTENTION", False)
+    MetricsRegistry.reset()
+    lock = TracedLock("test.optout")
+    lock.acquire()
+    t = threading.Thread(target=lambda: (lock.acquire(), lock.release()))
+    t.start()
+    time.sleep(0.05)
+    lock.release()
+    t.join(timeout=5)
+    assert "lock.wait_s.test.optout" not in \
+        MetricsRegistry.get_or_create().snapshot()["histograms"]
+
+
+def test_traced_semaphore_semantics():
+    sem = TracedSemaphore("test.slots", 1)
+    assert sem.acquire(timeout=0.1)
+    t0 = time.perf_counter()
+    assert not sem.acquire(timeout=0.05)
+    assert time.perf_counter() - t0 >= 0.04
+    sem.release()
+    assert sem.acquire(blocking=False)
+    sem.release()
+
+
+def test_traced_lock_timeout_and_nonblocking():
+    lock = TracedLock("test.timeouts")
+    lock.acquire()
+    assert not lock.acquire(blocking=False)
+    assert not lock.acquire(timeout=0.05)
+    lock.release()
+    assert lock.acquire(timeout=0.05)
+    lock.release()
+
+
+# -- seeded fuzz of the prefetcher's slot-gated staging ----------------------
+
+def _fuzz_one_seed(seed, X, mesh):
+    from keystone_tpu.parallel.streaming import StreamingDataset
+
+    stream = StreamingDataset.from_numpy(X, chunk_size=16, mesh=mesh)
+    with chaos(seed=seed):
+        parts = [c.numpy() for c in stream.chunks()]
+    got = np.concatenate(parts, axis=0)
+    np.testing.assert_array_equal(got, X)
+    assert stream.buffered_nbytes() == 0.0
+
+
+def test_prefetcher_fuzz_bounded_seeds(mesh8):
+    """The tier-1 / ci.sh bounded slice of the stress suite: full
+    passes must deliver every row in order with a drained ledger under
+    seeded perturbation at every lock/semaphore site."""
+    X = np.arange(48 * 8, dtype=np.float32).reshape(48, 8)
+    for seed in range(25):
+        _fuzz_one_seed(seed, X, mesh8)
+
+
+def test_prefetcher_fuzz_wire_cast_seeds(mesh8):
+    """A few seeds through the wire-dtype path too (covers the cast
+    build lock + hand_off transient accounting under perturbation)."""
+    from keystone_tpu.parallel.streaming import StreamingDataset, fit_streaming
+    from keystone_tpu.nodes.stats import StandardScaler
+
+    X = (np.arange(48 * 8) % 251).astype(np.uint8).reshape(48, 8)
+    for seed in range(5):
+        stream = StreamingDataset.from_numpy(
+            X, chunk_size=16, mesh=mesh8,
+            wire_dtype=np.uint8, compute_dtype=np.float32)
+        with chaos(seed=seed):
+            model = fit_streaming(StandardScaler(), stream)
+        np.testing.assert_allclose(
+            np.asarray(model.mean), X.astype(np.float32).mean(axis=0),
+            rtol=1e-5)
+        assert stream.buffered_nbytes() == 0.0
+
+
+@pytest.mark.slow
+def test_prefetcher_fuzz_200_schedules(mesh8):
+    """The full stress suite: >= 200 seeded schedules over the
+    prefetcher's slot-gated staging (acceptance bar), full passes and
+    early exits alternating."""
+    from keystone_tpu.parallel.streaming import StreamingDataset
+
+    X = np.arange(48 * 8, dtype=np.float32).reshape(48, 8)
+    for seed in range(200):
+        if seed % 4 == 3:
+            stream = StreamingDataset.from_numpy(
+                X, chunk_size=16, mesh=mesh8)
+            with chaos(seed=seed):
+                for i, _ in enumerate(stream.chunks()):
+                    if i == 1:
+                        break
+            deadline = time.monotonic() + 5.0
+            while stream.buffered_nbytes() and \
+                    time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert stream.buffered_nbytes() == 0.0, f"seed {seed}"
+        else:
+            _fuzz_one_seed(seed, X, mesh8)
+
+
+# -- interpreter-exit teardown (satellite) -----------------------------------
+
+_EXIT_SCRIPT = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+import time
+import numpy as np
+from keystone_tpu.parallel.streaming import StreamingDataset
+from keystone_tpu.parallel.mesh import h2d_pool
+
+def slow_chunks():
+    for _ in range(1000):
+        time.sleep(0.02)
+        yield np.ones((16, 4), np.float32)
+
+s = StreamingDataset(slow_chunks, chunk_size=16)
+it = s.chunks()
+next(it)          # prefetch producer live, H2D pool built
+assert h2d_pool() is not None
+print("MID-STREAM-EXIT")
+# exit with the stream active: the registered teardown must stop the
+# producer and shut the non-daemon pool down without hanging or
+# spewing thread-join noise
+"""
+
+
+def test_interpreter_exit_under_active_stream_is_clean():
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    proc = subprocess.run(
+        [sys.executable, "-c", _EXIT_SCRIPT], capture_output=True,
+        text=True, timeout=120, env=env, cwd=REPO)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    assert "MID-STREAM-EXIT" in proc.stdout
+    for noise in ("Exception in thread", "cannot join",
+                  "cannot schedule new futures", "Traceback"):
+        assert noise not in proc.stderr, proc.stderr[-2000:]
+
+
+def test_h2d_pool_shutdown_is_idempotent_and_rebuilds(monkeypatch):
+    from keystone_tpu.parallel import mesh
+
+    monkeypatch.delenv("KEYSTONE_H2D_THREADS", raising=False)
+    pool = mesh.h2d_pool()
+    assert pool is not None
+    mesh.shutdown_h2d_pool()
+    mesh.shutdown_h2d_pool()  # idempotent
+    fresh = mesh.h2d_pool()
+    assert fresh is not None and fresh is not pool
+    # leave a live pool behind for other tests

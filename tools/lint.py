@@ -42,6 +42,17 @@ Three layers, all hermetic (no data, no device buffers):
      the transfer ships 4x the bytes; ship the source dtype and let
      the device cast (``StreamingDataset`` ``wire_dtype`` /
      ``compute_dtype``).
+   - **concurrency safety** (``analysis.concurrency``, PR 7):
+     ``guarded-field-race`` — an RMW/compound mutation of a
+     ``@guarded_by``-declared field outside its lock (tree-wide; fires
+     only on declared classes); ``lock-order-cycle`` +
+     ``blocking-under-lock`` — the static lock-acquisition graph from
+     ``with``-nesting must be acyclic and no blocking call
+     (``queue.get``, ``Event.wait``, ``device_put``, ...) may run
+     under an analyzer-known lock (scoped by ``CONCURRENCY_SCOPES``);
+     ``non-atomic-guarded-sequence`` — check-then-act on a guarded
+     field split across two ``with`` blocks. Deliberate exceptions
+     live in the commented ``CONCURRENCY_ALLOWLIST``.
 3. **ruff** (when installed): style/correctness pass over the package.
    Skipped with a notice when the container lacks ruff — layers 1–2
    are the required gate.
@@ -161,6 +172,24 @@ def run_ast_rules() -> int:
     return failures
 
 
+# -- layer 2a: concurrency passes --------------------------------------------
+
+def run_concurrency_rules() -> int:
+    """The three concurrency-safety pass families over the package tree
+    (single source of truth in ``analysis.concurrency``; the synthetic
+    offender fixtures under tests/lint_fixtures pin each rule's firing
+    shape)."""
+    from keystone_tpu.analysis.concurrency import scan_package
+
+    failures = 0
+    for hit in scan_package(PKG):
+        print(f"{hit['file']}:{hit['lineno']}: {hit['code']}: "
+              f"{hit['message']}")
+        failures += 1
+    print(f"concurrency passes: {failures} failure(s)")
+    return failures
+
+
 # -- layer 2b: donation shape gate (spec-level, eval_shape) ------------------
 
 def _donating_modules():
@@ -269,6 +298,7 @@ def run_ruff() -> int:
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     failures = run_ast_rules()
+    failures += run_concurrency_rules()
     failures += run_donation_shape_gate()
     failures += run_ruff()
     if "--skip-apps" not in argv:
