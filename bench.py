@@ -184,6 +184,37 @@ FLAGSHIP = "cifar_randompatch_images_per_sec_per_chip"
 _emitted = 0
 _metrics: dict = {}  # metric name -> emitted line (for the summary line)
 _section_buffer = None  # list while a section runs under _run_section
+_scaled_sections: set = set()  # sections run at _SCALE < 1 this run
+
+
+def _emit_meta():
+    """Emit the ``bench_meta`` identity line: hostname, device kind,
+    jax version, and which sections ran budget-shrunk. ``benchdiff``
+    reads it from the artifact's stdout tail — cross-HOST comparisons
+    refuse without ``--force`` (different host = different experiment),
+    and shrunk sections are excluded from regression classification
+    (their metric lines carry ``scaled`` keys; the list here is the
+    run-level summary). Emitted at start (so a cut-short run still
+    carries its identity) and again before the final summary (with the
+    complete scaled-sections list)."""
+    import socket
+
+    try:
+        dev = jax.devices()[0]
+        device_kind, backend, n_dev = (
+            dev.device_kind, dev.platform, len(jax.devices()))
+    except Exception:
+        device_kind = backend = "unknown"
+        n_dev = 0
+    print(json.dumps({"bench_meta": {
+        "hostname": socket.gethostname(),
+        "device_kind": device_kind,
+        "backend": backend,
+        "num_devices": n_dev,
+        "jax_version": jax.__version__,
+        "small": SMALL,
+        "scaled_sections": sorted(_scaled_sections),
+    }}), flush=True)
 
 
 def _emit(metric, value, unit, vs_baseline, **extra):
@@ -1527,6 +1558,7 @@ def main():
     # seconds-long sections would poison the full-run budget estimates
     measured = {} if SMALL else _load_durations()
     deadline = _START + BUDGET_S
+    _emit_meta()  # host identity up front: survives a cut-short run
     for section, fallback in sections:
         est = 1.15 * measured.get(section.__name__, fallback)
         remaining = deadline - time.monotonic()
@@ -1541,6 +1573,7 @@ def main():
             # floor-scaled trailing sections fit inside it.
             _SCALE = max(_MIN_SCALE,
                          min(1.0, 0.8 * max(remaining, 0.0) / est))
+            _scaled_sections.add(section.__name__)
             print(f"# shrinking {section.__name__} to scale "
                   f"{_SCALE:.2f}: {remaining:.0f}s of budget left < "
                   f"{est:.0f}s estimate", flush=True)
@@ -1567,6 +1600,7 @@ def main():
         # every section failed: fail loudly instead of exiting 0 with an
         # empty metrics stream
         raise SystemExit(1)
+    _emit_meta()  # refresh: now carries the complete scaled list
     # The LAST stdout JSON line must be a metric line: the flagship
     # summary when available, else the flagship alone, else the best
     # (first-emitted) surviving metric.
@@ -1621,6 +1655,7 @@ if __name__ == "__main__":
 
     def _run_all():
         if picked:
+            _emit_meta()  # single-section runs carry host identity too
             for f in picked:
                 sections[f]()
         else:
@@ -1631,12 +1666,17 @@ if __name__ == "__main__":
     else:
         # bench numbers should travel with their execution evidence
         # (PERFORMANCE.md): the trace JSON records per-node wall times,
-        # optimizer rule log, auto-cache report, and solver decisions
-        from keystone_tpu.observability import PipelineTrace
+        # optimizer rule log, auto-cache report, and solver decisions;
+        # a *.perfetto.json path writes the flight recorder's Chrome
+        # trace instead (ingest/H2D/compute lanes — the overlap
+        # evidence, viewable at https://ui.perfetto.dev)
+        from keystone_tpu.observability import (
+            PipelineTrace,
+            write_trace_artifact,
+        )
 
         with PipelineTrace("bench") as _tr:
             _run_all()
-        with open(trace_out, "w") as _f:
-            _f.write(_tr.to_json())
+        _kind = write_trace_artifact(trace_out, _tr)
         print(_tr.summary(top=30), file=sys.stderr)
-        print(f"# trace written to {trace_out}", file=sys.stderr)
+        print(f"# {_kind} written to {trace_out}", file=sys.stderr)

@@ -11,9 +11,14 @@
 #   2. python -m keystone_tpu check --all --budget $KEYSTONE_CI_HBM_BUDGET
 #                                  abstract interpretation + graph lints +
 #                                  static HBM plans over every CHECK_APPS
-#                                  app + the concurrency scan, device-free;
+#                                  app + the concurrency scan + the
+#                                  metric-name-drift scan, device-free;
 #                                  exit 1 on diagnostics, exit 2 on a
 #                                  predicted budget violation
+#   2a. benchdiff (ADVISORY)       classify the two newest BENCH_r*.json
+#                                  against per-metric noise bands
+#                                  (observability/benchdiff.py); prints
+#                                  the table, never fails the gate
 #   2b. bounded-seed stress        the deterministic-interleaving suite
 #                                  (tests/test_concurrency_sched.py):
 #                                  historical-race regression schedules +
@@ -51,6 +56,22 @@ echo "== ci: lint (AST rules + donation shape gate) =="
 echo "== ci: static pipeline checks + HBM plans (budget $BUDGET) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
   "$PY" -m keystone_tpu check --all --budget "$BUDGET"
+
+# Advisory bench-regression gate: classify the two most recent
+# BENCH_r*.json artifacts against the per-metric noise bands
+# (observability/benchdiff.py). NON-FATAL by design — CI machines do
+# not produce fresh artifacts, so a historical regression verdict
+# should inform the PR, not block it; the classification table lands
+# in the CI log either way. Exit 2 = regression beyond band.
+bench_artifacts=$(ls "$KEYSTONE_HOME"/BENCH_r*.json 2>/dev/null | sort | tail -2 || true)
+if [[ $(echo "$bench_artifacts" | wc -w) -eq 2 ]]; then
+  echo "== ci: benchdiff (advisory) =="
+  # shellcheck disable=SC2086
+  "$PY" -m keystone_tpu benchdiff $bench_artifacts \
+    || echo "benchdiff: advisory verdict exit $? (not failing CI)"
+else
+  echo "== ci: benchdiff skipped (need >= 2 BENCH_r*.json artifacts) =="
+fi
 
 if (( run_tests )); then
   echo "== ci: bounded-seed concurrency stress (regression schedules + fuzz) =="

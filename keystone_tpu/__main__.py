@@ -4,8 +4,15 @@
     python -m keystone_tpu <app> [--flags]
     python -m keystone_tpu check <app> [--json PATH] [--budget BYTES]
     python -m keystone_tpu check --all [--budget BYTES]
+    python -m keystone_tpu benchdiff BASE.json CURRENT.json [--force]
 
 Run with no arguments to list the available applications.
+
+``benchdiff`` is the statistical bench-regression gate
+(``observability/benchdiff.py``): it classifies every metric shared by
+two ``BENCH_r*.json`` artifacts as improved / in-band / regressed
+against per-metric noise bands derived from the artifact history, and
+exits 0/1/2 accordingly.
 
 ``check`` statically analyzes an app's pipeline DAG — shape/dtype
 propagation, the graph lints, and the static HBM plan (see
@@ -22,7 +29,10 @@ diagnostics + plan).
 :class:`~keystone_tpu.observability.PipelineTrace` and writes the full
 execution trace (per-node wall times and memory, optimizer rule log,
 auto-cache report, solver decisions) as JSON to PATH; a per-node summary
-table is printed to stderr.
+table is printed to stderr. A PATH ending ``.perfetto.json`` instead
+writes the flight recorder's Chrome trace-event timeline (node, ingest,
+H2D-lane, and lock spans on per-thread lanes — load it at
+https://ui.perfetto.dev).
 """
 from __future__ import annotations
 
@@ -124,14 +134,22 @@ def check_main(rest) -> int:
     import pathlib
 
     from keystone_tpu.analysis.concurrency import scan_package
+    from keystone_tpu.analysis.diagnostics import scan_metric_names
 
     pkg_root = pathlib.Path(__file__).resolve().parent
     concurrency = scan_package(pkg_root)
     for hit in concurrency:
         print(f"{hit['file']}:{hit['lineno']}: {hit['code']}: "
               f"{hit['message']}", file=sys.stderr)
+    # metric-name drift: every counter/gauge/histogram call site must
+    # use a catalogued name (observability/names.py) — the scrape
+    # surface's contract with its dashboards
+    metrics_names = scan_metric_names(pkg_root)
+    for hit in metrics_names:
+        print(f"{hit['file']}:{hit['lineno']}: {hit['code']}: "
+              f"{hit['message']}", file=sys.stderr)
 
-    failed = 1 if concurrency else 0
+    failed = (1 if concurrency else 0) + (1 if metrics_names else 0)
     over_budget = 0
     reports = []
     for build in builders:
@@ -154,15 +172,18 @@ def check_main(rest) -> int:
             status = f"FAIL ({len(report.diagnostics)} diagnostic(s))"
         print(f"{target.name}: {status}")
     print(f"concurrency: {'clean' if not concurrency else f'{len(concurrency)} diagnostic(s)'}")
+    print(f"metrics names: {'clean' if not metrics_names else f'{len(metrics_names)} diagnostic(s)'}")
     if json_out is not None:
         import json as _json
 
         if len(reports) == 1:
             blob = reports[0].to_dict()
             blob["concurrency"] = concurrency
+            blob["metrics_names"] = metrics_names
         else:
             blob = {"apps": [r.to_dict() for r in reports],
-                    "concurrency": concurrency}
+                    "concurrency": concurrency,
+                    "metrics_names": metrics_names}
         with open(json_out, "w") as f:
             f.write(_json.dumps(blob, indent=2))
         print(f"report written to {json_out}", file=sys.stderr)
@@ -175,13 +196,20 @@ def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help", "help"):
         print("usage: python -m keystone_tpu <app> [--flags]\n"
-              "       python -m keystone_tpu check <app>|--all\n\napps:")
+              "       python -m keystone_tpu check <app>|--all\n"
+              "       python -m keystone_tpu benchdiff BASE.json "
+              "CURRENT.json\n\napps:")
         for name in sorted(APPS):
             print(f"  {name}")
         return 0
     app, rest = argv[0], argv[1:]
     if app == "check":
         return check_main(rest)
+    if app == "benchdiff":
+        # device-free: the bench-regression gate only parses artifacts
+        from keystone_tpu.observability.benchdiff import main as bd_main
+
+        return bd_main(rest)
     import os
 
     # Environments that import jax at interpreter start (device-plugin
@@ -246,14 +274,15 @@ def main(argv=None) -> int:
     if trace_out is None:
         mod.main(rest)
         return 0
-    from keystone_tpu.observability import PipelineTrace
+    from keystone_tpu.observability import PipelineTrace, write_trace_artifact
 
     with PipelineTrace(app) as tr:
         mod.main(rest)
-    with open(trace_out, "w") as f:
-        f.write(tr.to_json())
+    # *.perfetto.json gets the flight recorder's Chrome trace (load in
+    # https://ui.perfetto.dev); anything else the PipelineTrace JSON
+    kind = write_trace_artifact(trace_out, tr)
     print(tr.summary(), file=sys.stderr)
-    print(f"trace written to {trace_out}", file=sys.stderr)
+    print(f"{kind} written to {trace_out}", file=sys.stderr)
     return 0
 
 

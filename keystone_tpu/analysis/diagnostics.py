@@ -302,6 +302,82 @@ def swallow_all_handlers(tree) -> List[tuple]:
     return hits
 
 
+#: metric-factory method names whose first argument is a metric name
+#: (``MetricsRegistry.counter/gauge/histogram/timer``)
+_METRIC_FACTORIES = frozenset({"counter", "gauge", "histogram", "timer"})
+
+
+def metric_name_drift(tree) -> List[tuple]:
+    """``(lineno, code, description)`` for every
+    ``counter(...)``/``gauge(...)``/``histogram(...)``/``timer(...)``
+    call site whose metric name is not in the catalogue
+    (``observability/names.py``). Prometheus dashboards and benchdiff
+    address metrics by name across process boundaries — a rename that
+    skips the catalogue silently flatlines every consumer. Literal
+    names must be catalogued exactly (or live under a catalogued
+    prefix); f-strings must OPEN with a catalogued prefix
+    (``f"resilience.{event}"``); a fully dynamic name (a bare variable)
+    is uncheckable and passes through — keep those inside the
+    observability layer itself."""
+    from ..observability.names import (
+        METRIC_PREFIXES,
+        is_catalogued,
+        is_catalogued_prefix,
+    )
+
+    hits: List[tuple] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_FACTORIES
+                and node.args):
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if not is_catalogued(arg.value):
+                hits.append((
+                    node.lineno, "metric-name-drift",
+                    f".{node.func.attr}({arg.value!r}) uses an "
+                    "uncatalogued metric name — add it to "
+                    "observability/names.py (dashboards and benchdiff "
+                    "address metrics by name; an uncatalogued name is "
+                    "either a typo or an unreviewed rename)"))
+        elif isinstance(arg, ast.JoinedStr):
+            head = ""
+            if arg.values and isinstance(arg.values[0], ast.Constant) \
+                    and isinstance(arg.values[0].value, str):
+                head = arg.values[0].value
+            if not is_catalogued_prefix(head):
+                hits.append((
+                    node.lineno, "metric-name-drift",
+                    f".{node.func.attr}(f\"{head}...\") does not open "
+                    "with a catalogued metric-name prefix "
+                    f"({', '.join(METRIC_PREFIXES)}) — dynamic metric "
+                    "families must be declared in "
+                    "observability/names.py METRIC_PREFIXES"))
+    return sorted(set(hits))
+
+
+def scan_metric_names(pkg_root) -> List[dict]:
+    """Run :func:`metric_name_drift` over a package tree (the shape
+    ``tools/lint.py`` and ``check --json`` consume:
+    ``[{file, lineno, code, message}]``)."""
+    from pathlib import Path
+
+    pkg_root = Path(pkg_root)
+    out: List[dict] = []
+    for path in sorted(pkg_root.rglob("*.py")):
+        rel = path.relative_to(pkg_root.parent)
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:
+            continue  # reported by the other passes
+        for lineno, code, msg in metric_name_drift(tree):
+            out.append({"file": str(rel), "lineno": lineno,
+                        "code": code, "message": msg})
+    return out
+
+
 def apply_body_host_coercions(cls) -> List[str]:
     """Names of ``np.*`` host coercions applied to the item argument in
     ``cls.apply`` — the static (AST) form of the host-sync lint."""

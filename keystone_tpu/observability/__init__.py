@@ -22,8 +22,31 @@ the TPU port's equivalent, threaded through the workflow stack:
 Tracing is zero-overhead by default: every instrumentation site first
 checks :func:`current_trace` and does nothing when no trace context is
 active.
+
+PR 8 grew the package into a full telemetry plane:
+
+* :mod:`.timeline` — the always-on :class:`FlightRecorder` span ring
+  buffer with Chrome-trace/Perfetto export (``--trace-out
+  run.perfetto.json``).
+* :mod:`.sampler` — the background :class:`TelemetrySampler` plus the
+  Prometheus scrape endpoint (:func:`serve_metrics`,
+  ``MetricsRegistry.to_prometheus``).
+* :mod:`.postmortem` — crash dumps of recorder + metrics, attached to
+  the failure exceptions.
+* :mod:`.names` — the metric-name catalogue the ``metric-name-drift``
+  lint enforces.
+* :mod:`.benchdiff` — the statistical bench-regression gate
+  (``python -m keystone_tpu benchdiff``).
 """
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry, StepTimer
+from .postmortem import attach_postmortem, dump_postmortem
+from .sampler import TelemetrySampler, serve_metrics
+from .timeline import (
+    FlightRecorder,
+    flight_recorder,
+    record_span,
+    write_trace_artifact,
+)
 from .trace import (
     NodeRecord,
     PipelineTrace,
@@ -41,4 +64,12 @@ __all__ = [
     "PipelineTrace",
     "current_trace",
     "xprof_trace",
+    "FlightRecorder",
+    "flight_recorder",
+    "record_span",
+    "write_trace_artifact",
+    "TelemetrySampler",
+    "serve_metrics",
+    "attach_postmortem",
+    "dump_postmortem",
 ]

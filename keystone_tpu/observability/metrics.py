@@ -19,8 +19,10 @@ metrics fire per chunk/record/node, never per element.
 from __future__ import annotations
 
 import contextlib
+import re
 import threading
 import time
+import warnings
 from typing import Dict, Iterator, List, Optional
 
 from ..utils.guarded import guarded_by
@@ -212,16 +214,68 @@ class MetricsRegistry:
             },
         }
 
+    def to_prometheus(self) -> str:
+        """The registry as Prometheus text exposition (format 0.0.4):
+        counters and gauges one sample each, histograms as summaries
+        (``_count``/``_sum`` plus p50/p99 quantile samples from the
+        retained tail). Names are namespaced ``keystone_`` and
+        sanitized to the Prometheus charset (dots become underscores
+        — the canonical dotted names live in ``observability/names.py``
+        and the mapping is mechanical, so dashboards can be written
+        from the catalogue). This is what :func:`~keystone_tpu.\
+        observability.sampler.serve_metrics` serves on ``/metrics``."""
+        snap = self.snapshot()
+        lines: List[str] = []
+        for name, value in snap["counters"].items():
+            n = _prometheus_name(name) + "_total"
+            lines.append(f"# TYPE {n} counter")
+            lines.append(f"{n} {_prometheus_value(value)}")
+        for name, value in snap["gauges"].items():
+            n = _prometheus_name(name)
+            lines.append(f"# TYPE {n} gauge")
+            lines.append(f"{n} {_prometheus_value(value)}")
+        for name, h in snap["histograms"].items():
+            n = _prometheus_name(name)
+            lines.append(f"# TYPE {n} summary")
+            for q, key in (("0.5", "p50"), ("0.99", "p99")):
+                lines.append(
+                    f'{n}{{quantile="{q}"}} '
+                    f"{_prometheus_value(h.get(key, 0.0))}")
+            lines.append(f"{n}_sum {_prometheus_value(h['total'])}")
+            lines.append(f"{n}_count {int(h['count'])}")
+        return "\n".join(lines) + "\n"
+
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prometheus_name(name: str) -> str:
+    return "keystone_" + _PROM_BAD.sub("_", name)
+
+
+def _prometheus_value(value: float) -> str:
+    v = float(value)
+    return str(int(v)) if v == int(v) and abs(v) < 1e15 else repr(v)
+
 
 class StepTimer:
-    """Wall-clock step timing (formerly ``utils.profiling.StepTimer``;
-    kept API-compatible). ``timed(name, fn, ...)`` blocks on the device
-    result before reading the clock — the honest way to time jitted
-    programs. ``step(name)`` times the enclosed block as-is (callers
-    must block_until_ready inside if the block dispatches async device
-    work)."""
+    """DEPRECATED wall-clock step timing (formerly
+    ``utils.profiling.StepTimer``; kept API-compatible for external
+    callers — constructing one warns). Use
+    ``MetricsRegistry.get_or_create().timer(name)`` instead: same
+    one-line timing, but the samples land in the process histogram
+    (p50/p99, Prometheus exposition) instead of a private dict.
+    ``timed(name, fn, ...)`` blocks on the device result before reading
+    the clock — the honest way to time jitted programs. ``step(name)``
+    times the enclosed block as-is (callers must block_until_ready
+    inside if the block dispatches async device work)."""
 
     def __init__(self) -> None:
+        warnings.warn(
+            "StepTimer is deprecated; use MetricsRegistry.get_or_create()"
+            ".timer(name) (observability/metrics.py) — same block-style "
+            "timing, recorded into the process histograms",
+            DeprecationWarning, stacklevel=2)
         self.times: Dict[str, list] = {}
 
     @contextlib.contextmanager

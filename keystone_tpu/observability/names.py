@@ -1,0 +1,68 @@
+"""The metric-name catalogue: every process-metric name, in one place.
+
+Prometheus dashboards, the benchdiff gate, and the telemetry sampler
+all address metrics BY NAME across process boundaries — a renamed
+counter silently breaks every one of them (the dashboard shows a flat
+zero, not an error). So the names are catalogued here and the
+``metric-name-drift`` AST pass (:func:`keystone_tpu.analysis.\
+diagnostics.metric_name_drift`, enforced by ``tools/lint.py`` and
+``python -m keystone_tpu check``) flags any
+``counter(...)``/``gauge(...)``/``histogram(...)``/``timer(...)`` call
+site whose literal name is not listed below. Renaming a metric is a
+two-line change (the call site and this catalogue), and therefore a
+reviewable one.
+
+Families with a dynamic tail (``resilience.<event>``,
+``lock.wait_s.<lock name>``) are catalogued as PREFIXES: the pass
+checks an f-string's literal head against :data:`METRIC_PREFIXES`.
+Fully dynamic names (a bare variable) are uncheckable and pass through
+— keep those inside the observability layer itself
+(``MetricsRegistry.timer`` forwarding to ``histogram(name)``).
+"""
+from __future__ import annotations
+
+from typing import FrozenSet, Tuple
+
+#: exact metric names (counters, gauges, histograms) the tree may use
+METRIC_NAMES: FrozenSet[str] = frozenset({
+    # workflow/executor.py — always-on DAG executor counters
+    "executor.nodes_executed",
+    "executor.memo_hits",
+    "executor.prefix_hits",
+    # parallel/streaming.py — streamed-ingest telemetry
+    "streaming.ingest_stall_s",
+    "streaming.prefetch_occupancy",
+    "streaming.chunks_total",
+    "streaming.h2d_bytes",
+    "streaming.resident_bytes",
+    "streaming.carry_bytes",
+    # utils/guarded.py — lock-contention instrumentation
+    "lock.contended_total",
+    # observability/sampler.py — background sampler probes (exposed as
+    # gauges so the Prometheus endpoint scrapes them)
+    "process.rss_bytes",
+    "h2d.pool_queue_depth",
+})
+
+#: catalogued name FAMILIES: a dynamic metric name must start with one
+#: of these literal heads (``f"resilience.{event}"`` is fine; a bare
+#: ``f"{x}"`` is not checkable and is flagged)
+METRIC_PREFIXES: Tuple[str, ...] = (
+    "resilience.",   # resilience/events.py: one counter per event kind
+    "lock.wait_s.",  # utils/guarded.py: one histogram per traced lock
+)
+
+
+def is_catalogued(name: str) -> bool:
+    """True when a LITERAL metric name is in the catalogue (exact, or
+    under a catalogued prefix family)."""
+    return name in METRIC_NAMES or any(
+        name.startswith(p) for p in METRIC_PREFIXES)
+
+
+def is_catalogued_prefix(head: str) -> bool:
+    """True when an f-string's literal head lands inside a catalogued
+    prefix family (``"resilience."`` matches; so does the longer
+    ``"lock.wait_s.stream."``)."""
+    return bool(head) and any(
+        head.startswith(p) for p in METRIC_PREFIXES)

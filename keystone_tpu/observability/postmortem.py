@@ -1,0 +1,108 @@
+"""Crash post-mortems: dump the telemetry plane when a fit dies.
+
+A multi-hour streamed fit that dies with ``IngestTimeoutError`` at
+chunk 31 807 leaves, by default, one exception line — the flight
+recorder's last N seconds of spans and the metrics registry's counters
+are exactly the evidence that explains it, and they die with the
+process. This module makes the failure path dump them first:
+
+* :func:`dump_postmortem` writes one JSON artifact — the failure
+  reason and context, a full :meth:`MetricsRegistry.snapshot`, and the
+  flight recorder's Chrome trace (loadable in Perfetto as-is) — to
+  ``$KEYSTONE_POSTMORTEM_DIR`` (default ``~/.keystone_tpu/postmortems``,
+  the calibration-artifact convention). ``KEYSTONE_POSTMORTEM=0``
+  disables dumping entirely.
+* :func:`attach_postmortem` is the raise-site helper: it dumps, stores
+  the artifact path on the exception (``exc.postmortem_path``), and
+  appends ``[post-mortem: <path>]`` to the message — so the path
+  travels up through every log line that prints the exception. Wired
+  at the failure funnels: the ingest watchdog's
+  ``IngestTimeoutError``\\ s, ``RetryPolicy``'s
+  ``RetryExhaustedError``, and ``fit_streaming``'s HBM-budget
+  ``MemoryError``\\ s.
+* interpreter exit under an active stream also dumps
+  (``parallel/streaming.py``'s ``threading._register_atexit``
+  teardown, which runs BEFORE the H2D pool dies) — a ctrl-C'd or
+  driver-killed fit still leaves its timeline behind.
+
+Dumping is strictly best-effort: any failure inside the dump returns
+None / leaves the exception untouched — crash reporting must never
+mask the crash.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+from .metrics import MetricsRegistry
+from .timeline import flight_recorder
+
+_SEQ = 0
+_SEQ_LOCK = threading.Lock()
+
+
+def postmortem_enabled() -> bool:
+    return os.environ.get("KEYSTONE_POSTMORTEM", "1") != "0"
+
+
+def postmortem_dir() -> Path:
+    override = os.environ.get("KEYSTONE_POSTMORTEM_DIR")
+    if override:
+        return Path(override)
+    return Path.home() / ".keystone_tpu" / "postmortems"
+
+
+def dump_postmortem(reason: str,
+                    context: Optional[Dict[str, Any]] = None
+                    ) -> Optional[str]:
+    """Write one post-mortem artifact; returns its path, or None when
+    disabled or the dump itself failed (best-effort by contract)."""
+    if not postmortem_enabled():
+        return None
+    global _SEQ
+    try:
+        directory = postmortem_dir()
+        directory.mkdir(parents=True, exist_ok=True)
+        with _SEQ_LOCK:
+            _SEQ += 1
+            seq = _SEQ
+        path = directory / (
+            f"postmortem-{reason}-{os.getpid()}-{seq}.json")
+        rec = flight_recorder()
+        blob = {
+            "reason": reason,
+            "time_unix": time.time(),
+            "pid": os.getpid(),
+            "context": context or {},
+            "metrics": MetricsRegistry.get_or_create().snapshot(),
+            "flight_recorder": rec.to_chrome_trace(),
+        }
+        tmp = path.with_name(path.name + f".tmp{os.getpid()}")
+        with open(tmp, "w") as f:
+            json.dump(blob, f, indent=1, default=str)
+        os.replace(tmp, path)  # atomic publish, like every artifact here
+        return str(path)
+    except Exception:
+        return None  # never let evidence collection mask the failure
+
+
+def attach_postmortem(exc: BaseException, reason: str,
+                      context: Optional[Dict[str, Any]] = None
+                      ) -> BaseException:
+    """Dump a post-mortem for ``exc`` and name the artifact in the
+    exception message (``exc.postmortem_path`` carries it structured).
+    Returns ``exc`` so raise sites stay one line::
+
+        raise attach_postmortem(IngestTimeoutError(...),
+                                "ingest_timeout", {"chunk": seen})
+    """
+    path = dump_postmortem(reason, context)
+    exc.postmortem_path = path
+    if path and exc.args and isinstance(exc.args[0], str):
+        exc.args = (exc.args[0] + f" [post-mortem: {path}]",
+                    *exc.args[1:])
+    return exc

@@ -1,0 +1,265 @@
+"""Background telemetry sampler + Prometheus scrape endpoint.
+
+The metrics registry records what the workload PUSHES (counters fire at
+chunk/node/retry granularity); gauges like device residency or process
+RSS are only as fresh as the last push. This module adds the PULL half
+of the telemetry plane:
+
+* :class:`TelemetrySampler` — a daemon thread that, every
+  ``interval_s``, snapshots every registry counter/gauge plus a set of
+  *probes* (process RSS from ``/proc/self/statm``, the shared H2D
+  staging pool's queue depth) into bounded in-memory time-series
+  (``capacity`` points per series — a long-lived process can never
+  grow them). Probe values are also published back into the registry
+  as gauges (``process.rss_bytes``, ``h2d.pool_queue_depth``), so the
+  Prometheus endpoint scrapes them like everything else.
+* :meth:`MetricsRegistry.to_prometheus` (``observability/metrics.py``)
+  — text exposition of the whole registry.
+* :func:`serve_metrics` — a stdlib ``http.server`` endpoint serving
+  ``GET /metrics`` (the exposition) and ``GET /healthz``. This is the
+  scrape surface the ROADMAP item-1 serving layer will mount; until
+  then ``serve_metrics(port=9109)`` next to any long fit gives
+  Prometheus something to poll.
+
+Thread model: the sampler thread and readers share ``_series``/
+``_probes``; both are declared ``@guarded_by`` and every mutation runs
+under the lock (checked by ``analysis.concurrency``). The sampling
+pause is an ``Event.wait`` OUTSIDE the lock — ``stop()`` wakes it
+immediately instead of waiting out the interval. ``start``/``stop``
+are idempotent and a stopped sampler can be started again.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+from ..utils.guarded import guarded_by
+from .metrics import MetricsRegistry
+
+
+def _rss_bytes() -> float:
+    """Current resident set size. Linux: ``/proc/self/statm`` resident
+    pages x page size; fallback: peak RSS from getrusage (documented as
+    peak, better than nothing on non-procfs platforms)."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return float(pages * os.sysconf("SC_PAGE_SIZE"))
+    except (OSError, ValueError, IndexError):
+        try:
+            import resource
+
+            return float(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024)
+        except Exception:
+            return 0.0
+
+
+def _h2d_pool_queue_depth() -> float:
+    """Pending shard-put tasks in the shared H2D staging pool (0 when
+    the pool is down or per-shard staging is disabled). Read-only peek
+    at the executor's work queue — no pool lock needed for a gauge."""
+    from ..parallel import mesh
+
+    pool = mesh._H2D_POOL
+    if pool is None:
+        return 0.0
+    try:
+        return float(pool._work_queue.qsize())
+    except AttributeError:
+        return 0.0
+
+
+#: default probes installed on every sampler (name -> zero-arg float fn)
+DEFAULT_PROBES: Dict[str, Callable[[], float]] = {
+    "process.rss_bytes": _rss_bytes,
+    "h2d.pool_queue_depth": _h2d_pool_queue_depth,
+}
+
+
+@guarded_by("_lock", "_series", "_probes")
+class TelemetrySampler:
+    """Interval sampler of registry scalars + probes into bounded
+    time-series; see module docstring.
+
+    Usage::
+
+        sampler = TelemetrySampler(interval_s=0.5)
+        sampler.start()            # idempotent
+        ...
+        sampler.stop()             # idempotent, joins the thread
+        rss = sampler.series("process.rss_bytes")   # [(t, value), ...]
+    """
+
+    def __init__(self, interval_s: float = 0.5, capacity: int = 512,
+                 registry: Optional[MetricsRegistry] = None):
+        if interval_s <= 0:
+            raise ValueError("interval_s must be > 0")
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.interval_s = float(interval_s)
+        self.capacity = int(capacity)
+        self._registry = registry
+        self._series: Dict[str, Deque[Tuple[float, float]]] = {}
+        self._probes: Dict[str, Callable[[], float]] = dict(DEFAULT_PROBES)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- probes ------------------------------------------------------------
+    def add_probe(self, name: str, fn: Callable[[], float]) -> None:
+        """Register an extra sampled value (zero-arg callable; failures
+        are skipped for that tick, never raised into the thread)."""
+        with self._lock:
+            self._probes[name] = fn
+
+    # -- sampling ----------------------------------------------------------
+    def sample_once(self) -> Dict[str, float]:
+        """Take one sample tick (also usable without the thread).
+        Returns the values sampled at this tick."""
+        reg = self._registry or MetricsRegistry.get_or_create()
+        with self._lock:
+            probes = list(self._probes.items())
+        values: Dict[str, float] = {}
+        for name, fn in probes:
+            try:
+                v = float(fn())
+            except Exception:
+                continue  # a broken probe must not kill the sampler
+            values[name] = v
+            reg.gauge(name).set(v)  # scrapeable alongside everything else
+        snap = reg.snapshot()
+        for name, v in snap["gauges"].items():
+            values.setdefault(name, float(v))
+        for name, v in snap["counters"].items():
+            values[name] = float(v)
+        now = time.time()
+        with self._lock:
+            for name, v in values.items():
+                series = self._series.get(name)
+                if series is None:
+                    series = deque(maxlen=self.capacity)
+                    self._series[name] = series
+                series.append((now, v))
+        return values
+
+    def _loop(self, stop: threading.Event) -> None:
+        # wait FIRST so stop() right after start() takes no sample, and
+        # the wait runs outside any lock (stop() wakes it immediately)
+        while not stop.wait(self.interval_s):
+            self.sample_once()
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def start(self) -> "TelemetrySampler":
+        """Start the daemon sampling thread (no-op when already
+        running; restartable after ``stop``). The check-then-spawn runs
+        under the lock so two racing ``start()`` calls cannot leave two
+        sampler threads behind."""
+        with self._lock:
+            if self._thread is not None:
+                return self
+            stop = self._stop = threading.Event()
+            t = threading.Thread(
+                target=self._loop, args=(stop,),
+                name="keystone-telemetry-sampler", daemon=True)
+            self._thread = t
+            # start INSIDE the lock: a racing start() gating on is_alive()
+            # would see a created-but-unstarted thread as "not running"
+            # and spawn a second, unstoppable sampler
+            t.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Stop and join the sampling thread (no-op when not running).
+        The join runs OUTSIDE the lock — the sampler thread takes it
+        every tick."""
+        with self._lock:
+            t = self._thread
+            self._thread = None
+            self._stop.set()
+        if t is not None:
+            t.join(timeout=timeout)
+
+    # -- views -------------------------------------------------------------
+    def series(self, name: str) -> List[Tuple[float, float]]:
+        """One series' retained ``(unix time, value)`` points (empty
+        when never sampled)."""
+        with self._lock:
+            return list(self._series.get(name, ()))
+
+    def series_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def snapshot(self) -> Dict[str, List[Tuple[float, float]]]:
+        with self._lock:
+            return {k: list(v) for k, v in sorted(self._series.items())}
+
+
+# -- scrape endpoint ---------------------------------------------------------
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    registry: Optional[MetricsRegistry] = None
+
+    def do_GET(self):  # noqa: N802 (stdlib handler API)
+        if self.path.split("?")[0] == "/healthz":
+            body = b"ok\n"
+            ctype = "text/plain; charset=utf-8"
+        elif self.path.split("?")[0] == "/metrics":
+            reg = self.registry or MetricsRegistry.get_or_create()
+            body = reg.to_prometheus().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # scrapes must not spam stderr
+        pass
+
+
+class _MetricsServer(ThreadingHTTPServer):
+    daemon_threads = True
+    _keystone_thread: Optional[threading.Thread] = None
+
+    def shutdown(self) -> None:
+        """Stop the serve loop, join its thread, and close the listening
+        socket — plain ``ThreadingHTTPServer.shutdown()`` leaves the
+        port bound, so a same-port restart would raise EADDRINUSE."""
+        super().shutdown()
+        t = self._keystone_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
+        self.server_close()
+
+
+def serve_metrics(port: int = 0, host: str = "127.0.0.1",
+                  registry: Optional[MetricsRegistry] = None
+                  ) -> ThreadingHTTPServer:
+    """Serve ``GET /metrics`` (Prometheus text exposition of the
+    process registry) and ``GET /healthz`` on ``host:port`` from a
+    daemon thread. ``port=0`` binds an ephemeral port — read it back
+    from ``server.server_port``. Returns the server; ``.shutdown()``
+    stops it, joins the serve thread, and releases the port."""
+    handler = type("_BoundMetricsHandler", (_MetricsHandler,),
+                   {"registry": registry})
+    server = _MetricsServer((host, port), handler)
+    t = threading.Thread(target=server.serve_forever,
+                         name="keystone-metrics-http", daemon=True)
+    server._keystone_thread = t
+    t.start()
+    return server

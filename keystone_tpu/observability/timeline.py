@@ -1,0 +1,311 @@
+"""Flight recorder: a bounded, always-on ring buffer of execution spans.
+
+The :class:`~.trace.PipelineTrace` answers "how long did each node
+take"; this module answers "WHEN did everything run, on WHICH thread" —
+the Dapper/Perfetto-shaped view that makes prefetch-vs-compute overlap
+and lock contention visually inspectable instead of argued from
+aggregate counters. Every instrumented subsystem feeds it through the
+funnels that already exist:
+
+* the DAG executor's node timers (``workflow/executor.py``, only while
+  a trace is active — untraced runs do not wrap thunks);
+* the streaming prefetcher: one ``stage:<tag>`` span per chunk on the
+  producer thread (decode + pad + H2D staging) and one ``stall:<tag>``
+  span per chunk on the consumer (time the device-side loop waited);
+* per-shard H2D puts on the ``keystone-h2d`` pool lanes
+  (``parallel/mesh.shard_put``);
+* the resilience event funnel (``resilience/events.py``) as instant
+  events: retries, watchdog trips, checkpoint snapshots, quarantines;
+* contended :class:`~keystone_tpu.utils.guarded.TracedLock` acquires
+  (one span per lost race, on the losing thread);
+* ``fit_streaming``'s per-chunk ``accumulate`` spans (the compute lane
+  of a streamed fit).
+
+The buffer is a fixed-capacity ring (``KEYSTONE_FLIGHT_SPANS``, default
+8192): recording is a lock + two list writes (~1 µs), old spans fall
+off the back, and a long-lived process can never grow it. A crash
+post-mortem (:mod:`.postmortem`) or an interpreter exit under an active
+stream dumps whatever the ring holds — the last N seconds of evidence,
+exactly when it matters.
+
+``to_chrome_trace()`` exports the ring as Chrome trace-event JSON
+(``chrome://tracing`` / https://ui.perfetto.dev -> Open trace file):
+one lane per real thread, with overlapping spans on a thread (nested
+executor nodes) overflowing to ``<thread> (nested k)`` sub-lanes so
+every exported lane holds strictly non-overlapping ``ts``/``dur``
+ranges. ``--trace-out something.perfetto.json`` on
+``python -m keystone_tpu <app>`` and ``bench.py`` writes it directly.
+
+Thread model: the ring is mutated from every instrumented thread and
+its guard is a PLAIN ``threading.Lock``, never a TracedLock — a
+contended TracedLock acquire reports INTO this recorder, so tracing the
+recorder's own lock would re-enter it on the same thread and deadlock
+(the same boundary as ``observability/metrics.py``, documented once in
+``utils/guarded.py``). ``KEYSTONE_FLIGHT_RECORDER=0`` disables
+recording entirely (one branch per call — the telemetry-off side of the
+PERFORMANCE.md rule 10 overhead bar).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, List, NamedTuple, Optional
+
+from ..utils.guarded import guarded_by
+
+
+class Span(NamedTuple):
+    """One recorded interval (or instant, when ``ph == "i"``). Times
+    are ``time.perf_counter`` seconds (monotonic, process-local)."""
+
+    name: str
+    cat: str
+    start_s: float
+    dur_s: float
+    tid: int
+    thread: str
+    args: Optional[Dict[str, Any]]
+    ph: str  # "X" complete event, "i" instant
+
+
+def _env_flag(name: str, default: str = "1") -> bool:
+    return os.environ.get(name, default) != "0"
+
+
+def _env_capacity() -> int:
+    raw = os.environ.get("KEYSTONE_FLIGHT_SPANS")
+    if not raw:
+        return 8192
+    try:
+        cap = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"KEYSTONE_FLIGHT_SPANS must be an integer, got {raw!r}"
+        ) from None
+    if cap < 1:
+        raise ValueError("KEYSTONE_FLIGHT_SPANS must be >= 1")
+    return cap
+
+
+@guarded_by("_lock", "_ring", "_idx", "_total")
+class FlightRecorder:
+    """Bounded ring buffer of :class:`Span` entries; see module
+    docstring. ``record``/``record_instant`` are called from every
+    instrumented thread — the ring index bump is a read-modify-write
+    and wraparound writes land in shared slots, so both run under the
+    (plain) lock; the regression schedule for the unlocked shape lives
+    in tests/test_concurrency_sched.py."""
+
+    def __init__(self, capacity: Optional[int] = None,
+                 enabled: Optional[bool] = None):
+        self.capacity = _env_capacity() if capacity is None else int(capacity)
+        if self.capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.enabled = (_env_flag("KEYSTONE_FLIGHT_RECORDER")
+                        if enabled is None else bool(enabled))
+        self._ring: List[Optional[Span]] = [None] * self.capacity
+        self._idx = 0
+        self._total = 0
+        self._lock = threading.Lock()  # plain: TracedLock reports in here
+        #: perf_counter epoch for chrome-trace timestamps
+        self.t0_s = time.perf_counter()
+
+    # -- recording ---------------------------------------------------------
+    def record(self, name: str, cat: str, start_s: float, dur_s: float,
+               args: Optional[Dict[str, Any]] = None, ph: str = "X") -> None:
+        """Append one span (cheap: thread lookup + lock + two writes)."""
+        if not self.enabled:
+            return
+        t = threading.current_thread()
+        span = Span(name, cat, float(start_s), float(dur_s),
+                    t.ident or 0, t.name, args, ph)
+        with self._lock:
+            self._ring[self._idx] = span
+            self._idx = (self._idx + 1) % self.capacity
+            self._total += 1
+
+    def record_instant(self, name: str, cat: str,
+                       ts_s: Optional[float] = None,
+                       args: Optional[Dict[str, Any]] = None) -> None:
+        """A zero-duration marker event (resilience events, faults)."""
+        self.record(name, cat,
+                    time.perf_counter() if ts_s is None else ts_s,
+                    0.0, args, ph="i")
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str, **args: Any) -> Iterator[None]:
+        """Record the enclosed block as one span (recorded even when the
+        block raises — a crashing stage is exactly what a post-mortem
+        needs to show)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, cat, t0, time.perf_counter() - t0,
+                        args or None)
+
+    # -- views -------------------------------------------------------------
+    def spans(self) -> List[Span]:
+        """Retained spans, oldest first (at most ``capacity``)."""
+        with self._lock:
+            ring = list(self._ring)
+            idx = self._idx
+            total = self._total
+        if total < self.capacity:
+            return [s for s in ring[:idx] if s is not None]
+        return [s for s in ring[idx:] + ring[:idx] if s is not None]
+
+    @property
+    def total_recorded(self) -> int:
+        with self._lock:
+            return self._total
+
+    def dropped(self) -> int:
+        """Spans that fell off the back of the ring."""
+        with self._lock:
+            return max(0, self._total - self.capacity)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring = [None] * self.capacity
+            self._idx = 0
+            self._total = 0
+
+    # -- export ------------------------------------------------------------
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        """The ring as a Chrome trace-event / Perfetto JSON object.
+
+        Lane assignment: one lane per recording thread, in first-seen
+        order. Within a thread, spans are laid greedily onto sub-lanes
+        so no exported lane ever holds two overlapping ``"X"`` events
+        (nested executor node spans overflow onto ``<thread>
+        (nested k)``) — the strictly-non-overlapping-per-lane invariant
+        the round-trip test pins, and what keeps the Perfetto render
+        unambiguous. Instants ride lane 0 of their thread."""
+        spans = self.spans()
+        events: List[Dict[str, Any]] = []
+        # (os thread id, sublane) -> exported integer tid, plus names
+        lane_ids: Dict[tuple, int] = {}
+        lane_names: Dict[int, str] = {}
+
+        def lane(tid: int, thread: str, sub: int) -> int:
+            key = (tid, sub)
+            if key not in lane_ids:
+                lane_ids[key] = len(lane_ids) + 1
+                lane_names[lane_ids[key]] = (
+                    thread if sub == 0 else f"{thread} (nested {sub})")
+            return lane_ids[key]
+
+        by_thread: Dict[int, List[Span]] = {}
+        for s in spans:
+            by_thread.setdefault(s.tid, []).append(s)
+        for tid in by_thread:
+            # longer spans first at equal start so a nested child (same
+            # start, shorter) overflows, not its parent
+            complete = sorted(
+                (s for s in by_thread[tid] if s.ph == "X"),
+                key=lambda s: (s.start_s, -s.dur_s))
+            lane_end: List[float] = []  # per sub-lane, last span end
+            for s in complete:
+                sub = 0
+                while sub < len(lane_end) and s.start_s < lane_end[sub]:
+                    sub += 1
+                if sub == len(lane_end):
+                    lane_end.append(0.0)
+                lane_end[sub] = s.start_s + s.dur_s
+                events.append({
+                    "name": s.name, "cat": s.cat, "ph": "X",
+                    "ts": round((s.start_s - self.t0_s) * 1e6, 3),
+                    "dur": round(s.dur_s * 1e6, 3),
+                    "pid": 1, "tid": lane(s.tid, s.thread, sub),
+                    "args": s.args or {},
+                })
+            for s in by_thread[tid]:
+                if s.ph != "i":
+                    continue
+                events.append({
+                    "name": s.name, "cat": s.cat, "ph": "i", "s": "t",
+                    "ts": round((s.start_s - self.t0_s) * 1e6, 3),
+                    "pid": 1, "tid": lane(s.tid, s.thread, 0),
+                    "args": s.args or {},
+                })
+        meta = [{"name": "process_name", "ph": "M", "pid": 1,
+                 "args": {"name": "keystone_tpu"}}]
+        for lid, lname in sorted(lane_names.items()):
+            meta.append({"name": "thread_name", "ph": "M", "pid": 1,
+                         "tid": lid, "args": {"name": lname}})
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms",
+                "otherData": {"dropped_spans": self.dropped(),
+                              "recorded_spans": self.total_recorded}}
+
+    def to_chrome_json(self) -> str:
+        return json.dumps(self.to_chrome_trace(), default=str)
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_chrome_json())
+
+
+# -- process-global recorder -------------------------------------------------
+
+_RECORDER: Optional[FlightRecorder] = None
+_RECORDER_LOCK = threading.Lock()
+
+
+def flight_recorder() -> FlightRecorder:
+    """The process-global recorder (lazily built; the create is
+    double-checked — worker threads record from the first chunk)."""
+    global _RECORDER
+    rec = _RECORDER
+    if rec is None:
+        with _RECORDER_LOCK:
+            rec = _RECORDER
+            if rec is None:
+                rec = _RECORDER = FlightRecorder()
+    return rec
+
+
+def reset_flight_recorder() -> None:
+    """Drop the global recorder (tests; the next record builds a fresh
+    one, re-reading the env knobs)."""
+    global _RECORDER
+    with _RECORDER_LOCK:
+        _RECORDER = None
+
+
+def record_span(name: str, cat: str, start_s: float, dur_s: float,
+                args: Optional[Dict[str, Any]] = None) -> None:
+    """Module-level convenience for instrumentation sites."""
+    flight_recorder().record(name, cat, start_s, dur_s, args)
+
+
+def record_instant(name: str, cat: str,
+                   args: Optional[Dict[str, Any]] = None) -> None:
+    flight_recorder().record_instant(name, cat, args=args)
+
+
+@contextlib.contextmanager
+def flight_span(name: str, cat: str, **args: Any) -> Iterator[None]:
+    with flight_recorder().span(name, cat, **args):
+        yield
+
+
+def write_trace_artifact(path: str, trace=None) -> str:
+    """The ``--trace-out`` dispatch shared by the app CLI and bench:
+    a path ending ``.perfetto.json`` gets the flight recorder's Chrome
+    trace (open in https://ui.perfetto.dev); anything else gets the
+    :class:`~.trace.PipelineTrace` JSON. Returns which kind was
+    written (``"perfetto"`` / ``"trace"``)."""
+    if str(path).endswith(".perfetto.json"):
+        flight_recorder().dump(path)
+        return "perfetto"
+    if trace is None:
+        raise ValueError(
+            "write_trace_artifact needs an active PipelineTrace for "
+            "non-perfetto paths")
+    with open(path, "w") as f:
+        f.write(trace.to_json())
+    return "trace"

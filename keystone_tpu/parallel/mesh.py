@@ -231,7 +231,25 @@ def shard_put(arr, sharding: NamedSharding, pool=None):
         return jax.device_put(arr, sharding)
     if len(dev_map) <= 1:
         return jax.device_put(arr, sharding)
-    futures = [pool.submit(jax.device_put, arr[idx], dev)
+
+    def put_shard(slice_, dev):
+        # one flight-recorder span per shard put, on the pool worker
+        # thread — the H2D staging lanes in the Perfetto export. The
+        # put is async; the span covers dispatch + host-side slicing,
+        # which is what the lane occupancy shows (transfer completion
+        # is the device's business).
+        import time as _time
+
+        from ..observability.timeline import record_span
+
+        t0 = _time.perf_counter()
+        out = jax.device_put(slice_, dev)
+        record_span("h2d", "h2d", t0, _time.perf_counter() - t0,
+                    args={"nbytes": int(getattr(slice_, "nbytes", 0)),
+                          "device": str(dev)})
+        return out
+
+    futures = [pool.submit(put_shard, arr[idx], dev)
                for dev, idx in dev_map.items()]
     shards = [f.result() for f in futures]
     return jax.make_array_from_single_device_arrays(

@@ -74,6 +74,8 @@ import numpy as np
 from jax.sharding import Mesh
 
 from ..observability.metrics import MetricsRegistry
+from ..observability.postmortem import attach_postmortem, dump_postmortem
+from ..observability.timeline import record_span
 from ..observability.trace import current_trace
 from ..utils.guarded import TracedLock, TracedSemaphore, guarded_by
 from ..resilience.events import record_event
@@ -181,8 +183,17 @@ _LIVE_STREAM_STOPS: "weakref.WeakSet" = weakref.WeakSet()
 
 
 def _shutdown_live_streams() -> None:
-    for stop in list(_LIVE_STREAM_STOPS):
+    live = list(_LIVE_STREAM_STOPS)
+    for stop in live:
         stop.set()
+    if live:
+        # exit under an ACTIVE stream: flush the flight recorder +
+        # metrics to a post-mortem before the H2D pool teardown runs
+        # (this callback is registered after mesh's pool shutdown, so
+        # threading._register_atexit's reverse order runs it FIRST) —
+        # a driver-killed or ctrl-C'd fit still leaves its timeline
+        dump_postmortem("exit_under_active_stream",
+                        {"live_streams": len(live)})
 
 
 # threading._register_atexit callbacks run at threading shutdown,
@@ -531,6 +542,7 @@ class StreamingDataset(Dataset):
 
         def produce():
             try:
+                produced = 0
                 for raw in self._chunk_source():
                     # named fault site for producer hangs/stalls; abort
                     # wakes a "hang" injection when the consumer leaves
@@ -538,7 +550,17 @@ class StreamingDataset(Dataset):
                            abort=stop.is_set)
                     if not acquire_slot():
                         return
+                    t_stage = time.perf_counter()
                     ad, meta = self._stage(raw)
+                    # the prefetch lane of the flight-recorder timeline:
+                    # one span per chunk on this producer thread, so
+                    # ingest-vs-compute overlap is visually inspectable
+                    # in the Perfetto export
+                    record_span(f"stage:{self.tag or 'stream'}", "ingest",
+                                t_stage, time.perf_counter() - t_stage,
+                                args={"chunk": produced,
+                                      "h2d_bytes": meta["h2d_bytes"]})
+                    produced += 1
                     nbytes = device_nbytes(ad)
                     reg.counter("streaming.h2d_bytes").inc(
                         meta["h2d_bytes"])
@@ -586,24 +608,35 @@ class StreamingDataset(Dataset):
                         record_event("watchdog_trip",
                                      source=self.tag or "stream",
                                      reason="producer_died", chunk=seen)
-                        raise IngestTimeoutError(
+                        # the post-mortem carries the flight recorder's
+                        # last spans + the metrics snapshot — what the
+                        # producer was doing when it died, not just
+                        # that it did
+                        raise attach_postmortem(IngestTimeoutError(
                             f"stream {self.tag or '<untagged>'}: the "
                             f"producer thread died without completing "
-                            f"the stream (after chunk {seen})")
+                            f"the stream (after chunk {seen})"),
+                            "ingest_timeout",
+                            {"source": self.tag or "stream",
+                             "reason": "producer_died", "chunk": seen})
                     if (deadline is not None
                             and time.perf_counter() >= deadline):
                         record_event("watchdog_trip",
                                      source=self.tag or "stream",
                                      reason="stall_deadline", chunk=seen,
                                      stall_s=starved_s)
-                        raise IngestTimeoutError(
+                        raise attach_postmortem(IngestTimeoutError(
                             f"stream {self.tag or '<untagged>'}: no "
                             f"chunk from the producer in "
                             f"{starved_s:.1f}s (stall_timeout_s="
                             f"{self.stall_timeout_s:g}, after chunk "
                             f"{seen}; producer thread alive) — hung "
                             "source? Raise stall_timeout_s if the "
-                            "source is legitimately this slow.")
+                            "source is legitimately this slow."),
+                            "ingest_timeout",
+                            {"source": self.tag or "stream",
+                             "reason": "stall_deadline", "chunk": seen,
+                             "stall_s": starved_s})
 
         try:
             while True:
@@ -630,6 +663,12 @@ class StreamingDataset(Dataset):
                 reg.histogram("streaming.ingest_stall_s").observe(stall)
                 reg.gauge("streaming.prefetch_occupancy").set(occupancy)
                 reg.counter("streaming.chunks_total").inc()
+                # the sampler scrapes residency as a gauge; the stall
+                # span is the consumer-side lane of the flight timeline
+                reg.gauge("streaming.resident_bytes").set(
+                    self._residency.live())
+                record_span(f"stall:{self.tag or 'stream'}", "ingest",
+                            t0, stall, args={"chunk": seen})
                 if trace is not None:
                     trace.record_chunk({
                         "source": self.tag or "stream",
@@ -1018,13 +1057,16 @@ def fit_streaming(estimator: Any, data: StreamingDataset,
     static_plan = plan_fn() if callable(plan_fn) else None
     if (static_plan is not None and hbm_budget is not None
             and static_plan > hbm_budget):
-        raise MemoryError(
+        raise attach_postmortem(MemoryError(
             f"streamed fit would exceed its HBM budget before any chunk "
             f"is staged: static plan {static_plan:.0f} B (prefetch_depth "
             f"x staged chunk + working chunk + cast transient) > "
             f"{hbm_budget:.0f} B — shrink chunk_size or prefetch_depth "
             "(PERFORMANCE.md 'plan HBM statically'; `python -m "
-            "keystone_tpu check --budget` predicts this device-free)")
+            "keystone_tpu check --budget` predicts this device-free)"),
+            "hbm_budget",
+            {"source": data.tag or "stream", "phase": "static_plan",
+             "static_plan_nbytes": static_plan, "hbm_budget": hbm_budget})
     if quarantine is None:
         # a stream built by a quarantining loader carries its own
         # (stream_tar_images); use it so checkpoints keep the accounting
@@ -1054,23 +1096,38 @@ def fit_streaming(estimator: Any, data: StreamingDataset,
     takes_labels = labels is not None
     chunks_seen = 0
     idx = -1
+    reg = MetricsRegistry.get_or_create()
+    tag = data.tag or "stream"
     for chunk, lchunk in _paired_chunks(data, labels):
         idx += 1
         if idx < start_chunk:
             continue  # resume replay: already folded into the carry
+        t_acc = time.perf_counter()
         if takes_labels:
             carry = estimator.accumulate(carry, chunk, lchunk)
         else:
             carry = estimator.accumulate(carry, chunk)
+        # the compute lane of a streamed fit's flight timeline (host
+        # wall of the accumulate dispatch — jax async work continues
+        # past it, which is exactly the overlap the lanes show)
+        record_span(f"accumulate:{tag}", "compute", t_acc,
+                    time.perf_counter() - t_acc, args={"chunk": idx})
+        reg.gauge("streaming.carry_bytes").set(sum(
+            float(getattr(leaf, "nbytes", 0) or 0)
+            for leaf in jax.tree_util.tree_leaves(carry)))
         chunks_seen += 1
         if hbm_budget is not None:
             resident = data.buffered_nbytes()
             if resident > hbm_budget:
-                raise MemoryError(
+                raise attach_postmortem(MemoryError(
                     f"streamed fit exceeded its HBM budget: "
                     f"{resident:.0f} B resident > {hbm_budget:.0f} B "
                     f"(chunk {chunks_seen}; shrink chunk_size or "
-                    "prefetch_depth)")
+                    "prefetch_depth)"),
+                    "hbm_budget",
+                    {"source": tag, "phase": "runtime",
+                     "resident_nbytes": resident,
+                     "hbm_budget": hbm_budget, "chunk": chunks_seen})
         if ckpt is not None and (idx + 1) % checkpoint_every == 0:
             ckpt.save(fingerprint, idx + 1, carry,
                       None if quarantine is None else quarantine.state())

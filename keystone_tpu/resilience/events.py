@@ -22,12 +22,17 @@ from __future__ import annotations
 from typing import Any
 
 from ..observability.metrics import MetricsRegistry
+from ..observability.timeline import record_instant
 from ..observability.trace import current_trace
 
 
 def record_event(event: str, **fields: Any) -> None:
-    """Count ``resilience.<event>`` and trace the structured entry."""
+    """Count ``resilience.<event>``, mark it on the flight recorder's
+    timeline (an instant event on whichever thread it fired from — a
+    retry storm or watchdog trip lands next to the ingest spans it
+    interrupted), and trace the structured entry."""
     MetricsRegistry.get_or_create().counter(f"resilience.{event}").inc()
+    record_instant(event, "resilience", args=fields or None)
     trace = current_trace()
     if trace is not None:
         trace.record_resilience({"event": event, **fields})

@@ -173,7 +173,16 @@ class RetryPolicy:
         record_event("retry_exhausted", site=site,
                      attempts=self.max_attempts,
                      error=f"{type(last).__name__}: {last}")
-        raise RetryExhaustedError(site, self.max_attempts, last) from last
+        from ..observability.postmortem import attach_postmortem
+
+        # the dump carries every retry's instant event plus the ingest
+        # spans around them — the difference between "it failed" and
+        # "the third attempt timed out mid-stage while the pool drained"
+        raise attach_postmortem(
+            RetryExhaustedError(site, self.max_attempts, last),
+            "retry_exhausted",
+            {"site": site, "attempts": self.max_attempts,
+             "last_error": f"{type(last).__name__}: {last}"}) from last
 
 
 #: shared default policy: 3 attempts, 50 ms base backoff. Module-level

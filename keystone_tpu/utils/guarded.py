@@ -130,16 +130,23 @@ _TRACE_CONTENTION = os.environ.get("KEYSTONE_TRACED_LOCKS", "1") != "0"
 
 
 def _note_contention(name: str, wait_s: float) -> None:
-    """A contended acquire happened: feed the always-on metrics and,
-    when a trace is active, the trace's per-lock wait table. Imported
-    lazily — utils must stay importable without the observability
-    layer, and the metrics layer's own (plain) locks keep this from
-    re-entering."""
+    """A contended acquire happened: feed the always-on metrics, the
+    flight recorder (one span per lost race, on the losing thread —
+    lock contention becomes a visible lane in the Perfetto timeline),
+    and, when a trace is active, the trace's per-lock wait table.
+    Imported lazily — utils must stay importable without the
+    observability layer, and the metrics layer's / flight recorder's
+    own PLAIN locks keep this from re-entering (a traced guard there
+    would recurse through this very function)."""
     from ..observability.metrics import MetricsRegistry
 
     reg = MetricsRegistry.get_or_create()
     reg.counter("lock.contended_total").inc()
     reg.histogram(f"lock.wait_s.{name}").observe(wait_s)
+    from ..observability.timeline import record_span
+
+    record_span(f"lock:{name}", "lock",
+                time.perf_counter() - wait_s, wait_s)
     from ..observability.trace import current_trace
 
     trace = current_trace()

@@ -22,6 +22,7 @@ from __future__ import annotations
 from typing import Dict, FrozenSet, Optional
 
 from ..observability.metrics import MetricsRegistry
+from ..observability.timeline import record_span
 from ..observability.trace import NodeRecord, current_trace, metrics_suppressed
 from .env import PipelineEnv
 from .expression import (
@@ -108,6 +109,9 @@ def _traced_thunk(orig, node_id: int, label: str, kind: str):
         import jax
 
         record = NodeRecord(node_id=node_id, operator=label, kind=kind)
+        import time as _time
+
+        t0 = _time.perf_counter()
         with trace.node_timer(record):
             scope = f"{label}#{node_id}"
             try:
@@ -120,6 +124,11 @@ def _traced_thunk(orig, node_id: int, label: str, kind: str):
                 value = orig()
             _block_on_device(value)
             _measure_output(record, value)
+        # flight-recorder span (inclusive wall): traced node timelines
+        # land in the Perfetto export next to ingest/H2D/lock lanes;
+        # nested node spans overflow to sub-lanes at export time
+        record_span(scope, "node", t0, record.total_s,
+                    args={"node_id": node_id, "kind": kind})
         return value
 
     run._keystone_traced = True

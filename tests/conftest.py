@@ -15,6 +15,15 @@ os.environ["JAX_PLATFORMS"] = "cpu"  # tests never touch the real TPU
 # nonexistent path unless a test overrides it explicitly.
 os.environ["KEYSTONE_COST_CALIBRATION"] = (
     "/nonexistent/keystone-test-calibration.json")
+# Crash post-mortems (observability/postmortem.py) default to
+# ~/.keystone_tpu/postmortems; tests deliberately trigger the failure
+# paths that dump them, so point the dumps at a throwaway temp dir —
+# a test run must not litter (or depend on) the host's artifact dir.
+import tempfile  # noqa: E402
+
+os.environ.setdefault(
+    "KEYSTONE_POSTMORTEM_DIR",
+    tempfile.mkdtemp(prefix="keystone-test-postmortems-"))
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -42,14 +51,17 @@ def fresh_env():
         clear_calibration_cache,
     )
     from keystone_tpu.observability.metrics import MetricsRegistry
+    from keystone_tpu.observability.timeline import reset_flight_recorder
     from keystone_tpu.workflow.env import PipelineEnv
 
     PipelineEnv.reset()
     MetricsRegistry.reset()
+    reset_flight_recorder()
     clear_calibration_cache()
     yield
     PipelineEnv.reset()
     MetricsRegistry.reset()
+    reset_flight_recorder()
     clear_calibration_cache()
 
 

@@ -1,0 +1,32 @@
+"""Synthetic offender for the ``metric-name-drift`` pass
+(``analysis.diagnostics.metric_name_drift``): metric factory calls
+whose names are NOT in the ``observability/names.py`` catalogue.
+Parsed by tests, never imported."""
+
+from keystone_tpu.observability.metrics import MetricsRegistry
+
+reg = MetricsRegistry.get_or_create()
+
+# uncatalogued literal: a typo'd counter name (drifted from
+# streaming.chunks_total) — the dashboard scraping the real name
+# flatlines silently
+reg.counter("streaming.chunk_total").inc()
+
+# uncatalogued literal gauge
+reg.gauge("ingest.depth").set(2)
+
+# f-string that does not open with a catalogued prefix: the family was
+# never declared in METRIC_PREFIXES
+kind = "decode"
+reg.histogram(f"pool.wait_s.{kind}").observe(0.01)
+
+
+def fine_paths():
+    # catalogued literal: NOT flagged
+    reg.counter("streaming.chunks_total").inc()
+    # catalogued prefix family: NOT flagged
+    event = "retry"
+    reg.counter(f"resilience.{event}").inc()
+    # fully dynamic name: uncheckable, passes through
+    name = "anything"
+    reg.histogram(name).observe(1.0)

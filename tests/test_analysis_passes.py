@@ -17,6 +17,7 @@ import pytest
 from keystone_tpu.analysis.diagnostics import (
     donating_names,
     donation_hazards,
+    metric_name_drift,
     recompile_hazards,
 )
 from keystone_tpu.utils.donation import (
@@ -102,9 +103,45 @@ def test_donating_names_parses_both_spellings():
     assert names == {"a": frozenset({0, 1}), "b": frozenset({2})}
 
 
+# -- metric-name drift (PR 8 satellite) --------------------------------------
+
+def test_metric_name_drift_fires_on_offender():
+    """The fixture's three drifted sites fire; the catalogued literal,
+    the catalogued f-string prefix, and the fully dynamic name do not."""
+    hits = metric_name_drift(_tree("metric_name_offender"))
+    assert len(hits) == 3, hits
+    assert {c for _, c, _ in hits} == {"metric-name-drift"}
+    msgs = " ".join(m for _, _, m in hits)
+    assert "streaming.chunk_total" in msgs   # the typo'd counter
+    assert "ingest.depth" in msgs            # uncatalogued gauge
+    assert "pool.wait_s." in msgs            # undeclared prefix family
+    assert "observability/names.py" in msgs  # fix hint names the catalogue
+
+
+def test_metric_catalogue_matches_registry_usage():
+    """Every catalogued exact name is plausible (non-empty, dotted) and
+    the prefix families end with a separator — the catalogue is an
+    interface file, keep it well-formed."""
+    from keystone_tpu.observability.names import (
+        METRIC_NAMES,
+        METRIC_PREFIXES,
+        is_catalogued,
+        is_catalogued_prefix,
+    )
+
+    assert all("." in n for n in METRIC_NAMES)
+    assert all(p.endswith(".") for p in METRIC_PREFIXES)
+    assert is_catalogued("streaming.chunks_total")
+    assert is_catalogued("resilience.retry")       # prefix family
+    assert not is_catalogued("streaming.chunk_total")
+    assert is_catalogued_prefix("lock.wait_s.")
+    assert not is_catalogued_prefix("")            # bare f-string head
+
+
 # -- the whole tree is clean -------------------------------------------------
 
-@pytest.mark.parametrize("pass_fn", [donation_hazards, recompile_hazards])
+@pytest.mark.parametrize(
+    "pass_fn", [donation_hazards, recompile_hazards, metric_name_drift])
 def test_package_tree_is_clean(pass_fn):
     hits = []
     for path in sorted((REPO / "keystone_tpu").rglob("*.py")):

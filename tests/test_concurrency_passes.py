@@ -241,7 +241,8 @@ def test_lint_gate_runs_concurrency_passes(tmp_path, monkeypatch):
 @pytest.mark.slow
 def test_check_cli_includes_concurrency_diagnostics(tmp_path):
     """`python -m keystone_tpu check <app> --json` carries the
-    tree-wide concurrency scan (clean today) and exits 0."""
+    tree-wide concurrency scan AND the metric-name-drift scan (both
+    clean today) and exits 0."""
     import json
     import os
     import subprocess
@@ -256,3 +257,4 @@ def test_check_cli_includes_concurrency_diagnostics(tmp_path):
     assert proc.returncode == 0, proc.stderr[-2000:]
     blob = json.loads(out.read_text())
     assert blob["concurrency"] == []
+    assert blob["metrics_names"] == []

@@ -618,6 +618,115 @@ def test_prefetcher_fuzz_200_schedules(mesh8):
             _fuzz_one_seed(seed, X, mesh8)
 
 
+# -- PR 8: flight-recorder ring-buffer writer race ---------------------------
+
+class _RingRmwReplica:
+    """The FlightRecorder.record slot-write + index-bump, desugared
+    (``ring[idx] = span; idx = (idx + 1) % cap`` IS read-then-write on
+    the shared index) with the racy window marked — un-fixed (no lock)
+    vs fixed (the shipped locked structure, TracedLock so waiters park
+    for the scheduler)."""
+
+    def __init__(self, locked, capacity=4):
+        self.ring = [None] * capacity
+        self.idx = 0
+        self.total = 0
+        self.locked = locked
+        self._lock = TracedLock("ring.replica")
+
+    def _record(self, sched, name):
+        i = self.idx
+        sched.yield_point("ring.rmw")  # both threads read the same idx
+        self.ring[i % len(self.ring)] = name
+        self.idx = i + 1
+        self.total += 1
+
+    def record(self, sched, name):
+        if self.locked:
+            with self._lock:
+                self._record(sched, name)
+        else:
+            self._record(sched, name)
+
+
+def _drive_ring(locked, picks, names=("a", "b")):
+    ring = _RingRmwReplica(locked)
+    sched = DeterministicScheduler(picks=list(picks))
+    for name in names:
+        sched.spawn(ring.record, sched, f"span-{name}", name=name)
+    with sched:
+        sched.run()
+    return ring
+
+
+def test_ring_writer_race_reproduces_unlocked():
+    """Both writers read idx=0 before either bumps: the second write
+    lands in the SAME slot — one span silently lost, deterministically,
+    under the scripted interleaving."""
+    ring = _drive_ring(False, ["a", "b"] * 8)
+    stored = [s for s in ring.ring if s is not None]
+    assert len(stored) == 1  # one of the two spans overwrote the other
+
+
+def test_ring_writer_race_fixed_shape_survives():
+    ring = _drive_ring(True, ["a", "b"] * 8)
+    stored = [s for s in ring.ring if s is not None]
+    assert sorted(stored) == ["span-a", "span-b"]
+    for seed in range(20):
+        ring = _RingRmwReplica(True)
+        sched = DeterministicScheduler(seed=seed)
+        for name in ("a", "b", "c"):
+            sched.spawn(ring.record, sched, f"span-{name}", name=name)
+        with sched:
+            sched.run()
+        stored = [s for s in ring.ring if s is not None]
+        assert len(stored) == 3 and ring.idx == 3, f"seed {seed}"
+
+
+def test_ring_wraparound_race_two_threads():
+    """Threads racing the WRAPAROUND boundary (capacity 2, three
+    records): the locked shape keeps the exact count and retains
+    exactly `capacity` spans; the un-fixed shape under the same
+    schedule collapses the index (all writers saw idx=0)."""
+    for picks in (["a", "b", "c"] * 6, ["c", "b", "a"] * 6):
+        ring = _RingRmwReplica(True, capacity=2)
+        sched = DeterministicScheduler(picks=list(picks))
+        for name in ("a", "b", "c"):
+            sched.spawn(ring.record, sched, f"span-{name}", name=name)
+        with sched:
+            sched.run()
+        assert ring.total == 3 and ring.idx == 3, picks
+        assert sum(s is not None for s in ring.ring) == 2  # last two
+        broken = _RingRmwReplica(False, capacity=2)
+        sched = DeterministicScheduler(picks=list(picks))
+        for name in ("a", "b", "c"):
+            sched.spawn(broken.record, sched, f"span-{name}", name=name)
+        with sched:
+            sched.run()
+        assert broken.idx < 3, picks  # lost index bumps, reproduced
+
+
+def test_shipped_flight_recorder_exact_under_thread_hammer():
+    """The REAL FlightRecorder under a thread hammer: the total count
+    is exact (no lost updates) and the ring retains exactly capacity
+    spans after overflow."""
+    from keystone_tpu.observability.timeline import FlightRecorder
+
+    rec = FlightRecorder(capacity=64, enabled=True)
+    n, per = 8, 500
+    threads = [threading.Thread(
+        target=lambda: [rec.record("s", "hammer", 0.0, 0.0)
+                        for _ in range(per)])
+        for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert rec.total_recorded == n * per
+    assert len(rec.spans()) == 64
+    assert rec.dropped() == n * per - 64
+
+
 # -- interpreter-exit teardown (satellite) -----------------------------------
 
 _EXIT_SCRIPT = r"""
@@ -659,6 +768,40 @@ def test_interpreter_exit_under_active_stream_is_clean():
     assert "MID-STREAM-EXIT" in proc.stdout
     for noise in ("Exception in thread", "cannot join",
                   "cannot schedule new futures", "Traceback"):
+        assert noise not in proc.stderr, proc.stderr[-2000:]
+
+
+def test_interpreter_exit_under_active_stream_flushes_flight_recorder(
+        tmp_path):
+    """PR 8 extension of the teardown pin: an exit under an active
+    stream must FLUSH the flight recorder to a post-mortem before the
+    H2D pool dies (the stream-stop teardown runs first by registration
+    order, and the dump happens inside it). The dumped timeline carries
+    the ingest spans the stream produced — evidence survives the kill."""
+    import glob
+    import json
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               KEYSTONE_POSTMORTEM_DIR=str(tmp_path),
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    proc = subprocess.run(
+        [sys.executable, "-c", _EXIT_SCRIPT], capture_output=True,
+        text=True, timeout=120, env=env, cwd=REPO)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    dumps = glob.glob(str(
+        tmp_path / "postmortem-exit_under_active_stream-*.json"))
+    assert len(dumps) == 1, dumps
+    blob = json.loads(open(dumps[0]).read())
+    assert blob["context"]["live_streams"] == 1
+    # the stream staged at least one chunk before the exit: its ingest
+    # span is in the flushed timeline, and the metrics snapshot
+    # counted it
+    cats = {e.get("cat") for e in blob["flight_recorder"]["traceEvents"]}
+    assert "ingest" in cats
+    assert blob["metrics"]["counters"]["streaming.chunks_total"] >= 1
+    # no join noise: the dump happened BEFORE pool teardown, not during
+    for noise in ("Exception in thread", "cannot schedule new futures"):
         assert noise not in proc.stderr, proc.stderr[-2000:]
 
 

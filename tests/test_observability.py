@@ -423,3 +423,43 @@ def test_node_record_defaults_round_trip():
     tr.record_node(rec)
     restored = PipelineTrace.from_json(tr.to_json())
     assert restored.nodes[0] == rec
+
+
+def test_steptimer_deprecated_but_functional():
+    """PR 8 satellite: StepTimer is a deprecated shim — constructing
+    one warns, the API still works, and the MetricsRegistry.timer
+    replacement records the same block timing into the histograms."""
+    import warnings
+
+    from keystone_tpu.observability import StepTimer
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        timer = StepTimer()
+    assert any(issubclass(w.category, DeprecationWarning)
+               and "MetricsRegistry" in str(w.message) for w in caught)
+    with timer.step("s"):
+        pass
+    assert timer.timed("t", lambda: 1 + 1) == 2
+    assert set(timer.times) == {"s", "t"} and timer.summary()
+    # the replacement path
+    reg = MetricsRegistry.get_or_create()
+    with reg.timer("streaming.ingest_stall_s"):
+        pass
+    assert reg.snapshot()["histograms"]["streaming.ingest_stall_s"][
+        "count"] == 1
+
+
+def test_steptimer_compat_reexports_still_work():
+    """Both import homes keep working (and both warn on construction)."""
+    import warnings
+
+    from keystone_tpu.observability.metrics import StepTimer as direct
+    from keystone_tpu.utils.profiling import StepTimer as via_profiling
+    from keystone_tpu.utils import StepTimer as via_utils
+
+    assert direct is via_profiling is via_utils
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        via_profiling()
+    assert any(issubclass(w.category, DeprecationWarning) for w in caught)

@@ -1,0 +1,24 @@
+"""Bench-regression gate CLI (thin wrapper).
+
+The implementation lives in ``keystone_tpu.observability.benchdiff``
+(so ``python -m keystone_tpu benchdiff`` and this script are the same
+tool); this wrapper exists for the tools/ convention::
+
+    python tools/bench_compare.py BENCH_r03.json BENCH_r05.json
+
+Exit codes: 0 = every shared metric improved or within its noise band,
+1 = usage/load error or cross-host refusal (pass ``--force``),
+2 = at least one metric regressed beyond its band. See the module
+docstring of ``observability/benchdiff.py`` for the band model.
+"""
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from keystone_tpu.observability.benchdiff import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

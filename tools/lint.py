@@ -42,6 +42,12 @@ Three layers, all hermetic (no data, no device buffers):
      the transfer ships 4x the bytes; ship the source dtype and let
      the device cast (``StreamingDataset`` ``wire_dtype`` /
      ``compute_dtype``).
+   - ``metric-name-drift`` (tree-wide): every
+     ``counter/gauge/histogram/timer(...)`` call site must use a name
+     (or f-string prefix) from the catalogue in
+     ``observability/names.py`` — Prometheus dashboards and the
+     benchdiff gate address metrics by name, so an uncatalogued
+     literal is a typo or an unreviewed rename.
    - **concurrency safety** (``analysis.concurrency``, PR 7):
      ``guarded-field-race`` — an RMW/compound mutation of a
      ``@guarded_by``-declared field outside its lock (tree-wide; fires
@@ -123,6 +129,7 @@ def run_ast_rules() -> int:
         SWALLOW_ALL_SCOPES,
         donation_hazards,
         float_casts_before_transfer,
+        metric_name_drift,
         recompile_hazards,
         swallow_all_handlers,
     )
@@ -149,6 +156,12 @@ def run_ast_rules() -> int:
             print(f"{rel}:{lineno}: {code}: {msg}")
             failures += 1
         for lineno, code, msg in donation_hazards(tree):
+            print(f"{rel}:{lineno}: {code}: {msg}")
+            failures += 1
+        # metric-name drift is tree-wide: a renamed counter anywhere
+        # silently flatlines dashboards/benchdiff (catalogue:
+        # observability/names.py)
+        for lineno, code, msg in metric_name_drift(tree):
             print(f"{rel}:{lineno}: {code}: {msg}")
             failures += 1
         if rel.parts[:1] == ("keystone_tpu",) and \
