@@ -15,11 +15,16 @@
 #                                  metric-name-drift scan, device-free;
 #                                  exit 1 on diagnostics, exit 2 on a
 #                                  predicted budget violation
-#   2a. benchdiff (ADVISORY)       classify the two newest BENCH_r*.json
-#                                  against per-metric noise bands
-#                                  (observability/benchdiff.py); prints
-#                                  the table, never fails the gate
-#   2b. bounded-seed stress        the deterministic-interleaving suite
+#   2a. benchdiff (ADVISORY)       classify the two newest artifacts of
+#                                  each family (BENCH_r*.json and
+#                                  MULTICHIP_r*.json) against per-metric
+#                                  noise bands (observability/benchdiff.py);
+#                                  prints the table, never fails the gate
+#   2b. recompile gate             tools/recompile_gate.py — a smoke
+#                                  streamed fit twice; ANY compile in the
+#                                  second epoch fails (compile observatory
+#                                  fence, the dynamic recompile-hazard gate)
+#   2c. bounded-seed stress        the deterministic-interleaving suite
 #                                  (tests/test_concurrency_sched.py):
 #                                  historical-race regression schedules +
 #                                  a bounded seeded fuzz of the prefetcher
@@ -58,22 +63,35 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
   "$PY" -m keystone_tpu check --all --budget "$BUDGET"
 
 # Advisory bench-regression gate: classify the two most recent
-# BENCH_r*.json artifacts against the per-metric noise bands
+# artifacts of each driver family (BENCH_r*.json and MULTICHIP_r*.json
+# — benchdiff derives noise bands per family from the artifact's own
+# prefix) against the per-metric noise bands
 # (observability/benchdiff.py). NON-FATAL by design — CI machines do
 # not produce fresh artifacts, so a historical regression verdict
 # should inform the PR, not block it; the classification table lands
 # in the CI log either way. Exit 2 = regression beyond band.
-bench_artifacts=$(ls "$KEYSTONE_HOME"/BENCH_r*.json 2>/dev/null | sort | tail -2 || true)
-if [[ $(echo "$bench_artifacts" | wc -w) -eq 2 ]]; then
-  echo "== ci: benchdiff (advisory) =="
-  # shellcheck disable=SC2086
-  "$PY" -m keystone_tpu benchdiff $bench_artifacts \
-    || echo "benchdiff: advisory verdict exit $? (not failing CI)"
-else
-  echo "== ci: benchdiff skipped (need >= 2 BENCH_r*.json artifacts) =="
-fi
+for prefix in BENCH MULTICHIP; do
+  bench_artifacts=$(ls "$KEYSTONE_HOME/${prefix}"_r*.json 2>/dev/null | sort | tail -2 || true)
+  if [[ $(echo "$bench_artifacts" | wc -w) -eq 2 ]]; then
+    echo "== ci: benchdiff $prefix (advisory) =="
+    # shellcheck disable=SC2086
+    "$PY" -m keystone_tpu benchdiff $bench_artifacts \
+      || echo "benchdiff: advisory verdict exit $? (not failing CI)"
+  else
+    echo "== ci: benchdiff $prefix skipped (need >= 2 ${prefix}_r*.json artifacts) =="
+  fi
+done
 
 if (( run_tests )); then
+  echo "== ci: recompile gate (second epoch must compile nothing) =="
+  # the dynamic complement of the static recompile-hazard lints: a
+  # smoke streamed fit runs twice and any compile in the second epoch
+  # fails the gate, naming the jit site + signature delta (PR 3's
+  # zero-recompile invariant, now asserted by the compile observatory
+  # instead of only by one tier-1 test)
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    "$PY" "$KEYSTONE_HOME/tools/recompile_gate.py"
+
   echo "== ci: bounded-seed concurrency stress (regression schedules + fuzz) =="
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     "$PY" -m pytest "$KEYSTONE_HOME/tests/test_concurrency_sched.py" -q \

@@ -73,12 +73,17 @@ def _parse_bytes(text: str) -> float:
 
 def check_main(rest) -> int:
     """``python -m keystone_tpu check <app>|--all [--json PATH]
-    [--budget BYTES]``.
+    [--budget BYTES] [--xla]``.
 
     ``--budget`` (bytes; ``MiB``/``GiB`` suffixes accepted) gates every
     checked app on its static HBM plan — the device-free prediction of
-    the fit path's peak residency. Exit codes: 0 clean, 1 lint
-    diagnostics, 2 predicted budget violation (or usage error)."""
+    the fit path's peak residency. ``--xla`` cross-checks that plan
+    against XLA's own memory model: every planner-resolved node with a
+    per-item program is compiled-without-executing on the sample spec
+    and its ``memory_analysis`` output/temp bytes are compared with the
+    planner's per-item charge (``plan_vs_xla`` ratios; advisory, never
+    changes the exit code). Exit codes: 0 clean, 1 lint diagnostics,
+    2 predicted budget violation (or usage error)."""
     import os
 
     plat = os.environ.get("JAX_PLATFORMS")
@@ -108,12 +113,15 @@ def check_main(rest) -> int:
                   f"16GiB), got {rest[i + 1]!r}", file=sys.stderr)
             return 2
         del rest[i:i + 2]
+    xla_verify = "--xla" in rest
+    if xla_verify:
+        rest.remove("--xla")
 
     from keystone_tpu.pipelines import CHECK_APPS, resolve_check_app
 
     if not rest or rest[0] in ("-h", "--help"):
         print("usage: python -m keystone_tpu check <app>|--all "
-              "[--json PATH] [--budget BYTES]\n\napps:")
+              "[--json PATH] [--budget BYTES] [--xla]\n\napps:")
         for name in sorted(CHECK_APPS):
             print(f"  {name}")
         return 0
@@ -158,6 +166,15 @@ def check_main(rest) -> int:
                                        hbm_budget=budget)
         reports.append(report)
         print(report.summary(), file=sys.stderr)
+        if xla_verify:
+            from keystone_tpu.analysis.resources import (
+                format_xla_verify,
+                xla_verify_plan,
+            )
+
+            rows = xla_verify_plan(report.analysis, report.plan)
+            report.xla_verify = rows
+            print(format_xla_verify(rows, target.name), file=sys.stderr)
         violated = any(d.code == "hbm-budget" for d in report.diagnostics)
         over_budget += violated
         if not report.ok:
@@ -176,12 +193,18 @@ def check_main(rest) -> int:
     if json_out is not None:
         import json as _json
 
+        def _dump(r):
+            d = r.to_dict()
+            if getattr(r, "xla_verify", None) is not None:
+                d["xla_verify"] = r.xla_verify
+            return d
+
         if len(reports) == 1:
-            blob = reports[0].to_dict()
+            blob = _dump(reports[0])
             blob["concurrency"] = concurrency
             blob["metrics_names"] = metrics_names
         else:
-            blob = {"apps": [r.to_dict() for r in reports],
+            blob = {"apps": [_dump(r) for r in reports],
                     "concurrency": concurrency,
                     "metrics_names": metrics_names}
         with open(json_out, "w") as f:
@@ -278,6 +301,17 @@ def main(argv=None) -> int:
 
     with PipelineTrace(app) as tr:
         mod.main(rest)
+    # back-fill per-node MFU / bandwidth-utilization / FLOPs from the
+    # compile observatory's per-executable cost_analysis before export:
+    # node wall times gain the hardware denominator (PERFORMANCE.md
+    # rule 11); best-effort — an app with no observed compiles simply
+    # annotates zero nodes
+    try:
+        from keystone_tpu.observability.utilization import annotate_trace
+
+        annotate_trace(tr)
+    except Exception as exc:
+        print(f"utilization annotation skipped: {exc}", file=sys.stderr)
     # *.perfetto.json gets the flight recorder's Chrome trace (load in
     # https://ui.perfetto.dev); anything else the PipelineTrace JSON
     kind = write_trace_artifact(trace_out, tr)

@@ -704,8 +704,14 @@ def _ambient_mesh_functions(tree) -> set:
 
 
 def _is_jit_func(f) -> bool:
-    return (isinstance(f, ast.Attribute) and f.attr == "jit") or (
-        isinstance(f, ast.Name) and f.id == "jit")
+    # ``observed_jit``/``watch_jit`` (observability/compilelog.py) are
+    # jax.jit plus compile telemetry: the recompile-hazard rules must
+    # treat an observed site exactly like a bare jit, so routing a
+    # program through the compile observatory never weakens the gates
+    return (isinstance(f, ast.Attribute)
+            and f.attr in ("jit", "observed_jit", "watch_jit")) or (
+        isinstance(f, ast.Name)
+        and f.id in ("jit", "observed_jit", "watch_jit"))
 
 
 def recompile_hazards(tree) -> List[tuple]:

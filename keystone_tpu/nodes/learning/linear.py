@@ -17,6 +17,7 @@ import numpy as np
 
 from ...ops import linalg
 from ...parallel.dataset import ensure_array, ArrayDataset, Dataset
+from ...parallel.mesh import replicated_zeros
 from ...utils.donation import donating_jit
 from ...workflow.label_estimator import LabelEstimator
 from ...workflow.transformer import Transformer
@@ -344,10 +345,16 @@ def accumulate_gram_carry(carry, chunk, labels):
             f"{Y.shape[0]}")
     if carry is None:
         d, k = X.shape[1], Y.shape[1]
-        carry = (jnp.zeros((d, d), jnp.float32),
-                 jnp.zeros((d, k), jnp.float32),
-                 jnp.zeros((d,), jnp.float32),
-                 jnp.zeros((k,), jnp.float32), 0)
+        # the zero carry is REPLICATED on the chunk's mesh explicitly:
+        # a plain jnp.zeros is SingleDeviceSharding, and since jax's
+        # jit cache keys on input shardings, chunk 2 (whose carry is
+        # the mesh-sharded output of chunk 1's update) would recompile
+        # _gram_carry_update once per fit — an ~80 ms chunk-2 stall the
+        # compile observatory's fit fence flagged the moment it was
+        # armed (PR 9); with a replicated init the output shardings are
+        # stable from call 1 and the whole fit compiles exactly once
+        carry = tuple(replicated_zeros(chunk.mesh, (
+            (d, d), (d, k), (d,), (k,)))) + (0,)
     G, C, sx, sy, n = carry
     G, C, sx, sy = _gram_carry_update(G, C, sx, sy, X, Y)
     return (G, C, sx, sy, n + chunk.n)

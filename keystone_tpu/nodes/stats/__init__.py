@@ -215,8 +215,17 @@ class StandardScaler(Estimator):
         assert isinstance(chunk, ArrayDataset), \
             "StandardScaler streams over array chunks"
         if carry is None:
-            s, sq = _moments(chunk.data)
-            return (s, sq, chunk.n)
+            # replicated zero init + the SAME update program as every
+            # later chunk: seeding from _moments(chunk.data) handed
+            # chunk 2 a differently-SHARDED carry, so _accum_moments
+            # compiled twice per fit (jax's cache keys on input
+            # shardings) — flagged by the PR 9 fit fence, same fix as
+            # the least-squares Gram carry
+            from ...parallel.mesh import replicated_zeros
+
+            d = chunk.data.shape[1]
+            carry = tuple(replicated_zeros(
+                chunk.mesh, ((d,), (d,)))) + (0,)
         S, SQ, n = carry
         S, SQ = _accum_moments(S, SQ, chunk.data)
         return (S, SQ, n + chunk.n)

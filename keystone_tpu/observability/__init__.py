@@ -37,7 +37,28 @@ PR 8 grew the package into a full telemetry plane:
   lint enforces.
 * :mod:`.benchdiff` — the statistical bench-regression gate
   (``python -m keystone_tpu benchdiff``).
+
+PR 9 added the hardware denominator:
+
+* :mod:`.compilelog` — the compile observatory: every XLA compile
+  counted, timed, attributed to a named jit site, and classified
+  (first-compile / signature-change / mesh-change); a warmup fence
+  turns any later compile into an *unexpected* recompile
+  (``compile.unexpected_total``), the dynamic complement of the static
+  recompile-hazard lints.
+* :mod:`.utilization` — MFU / roofline accounting from per-executable
+  ``cost_analysis()``/``memory_analysis()`` against a per-device-kind
+  peak catalogue (``*_mfu`` / ``*_membw_util`` bench keys).
 """
+from .compilelog import (
+    CompileObservatory,
+    compile_context,
+    compile_observatory,
+    expect_no_compiles,
+    observed_jit,
+    reset_compile_observatory,
+    watch_jit,
+)
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry, StepTimer
 from .postmortem import attach_postmortem, dump_postmortem
 from .sampler import TelemetrySampler, serve_metrics
@@ -72,4 +93,11 @@ __all__ = [
     "serve_metrics",
     "attach_postmortem",
     "dump_postmortem",
+    "CompileObservatory",
+    "compile_context",
+    "compile_observatory",
+    "expect_no_compiles",
+    "observed_jit",
+    "reset_compile_observatory",
+    "watch_jit",
 ]

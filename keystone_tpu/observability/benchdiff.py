@@ -61,8 +61,10 @@ DEFAULT_BAND = 0.08
 #: clearly exceed typical historical wiggle, not merely tie it
 BAND_MARGIN = 1.5
 
-#: metric-name markers for "lower is better" (errors, stalls, latency)
-_LOWER_BETTER_MARKERS = ("error", "stall", "_ms", "_latency")
+#: metric-name markers for "lower is better" (errors, stalls, latency,
+#: byte counts — h2d_bytes_per_image shrinking is the PR 5 win, not a
+#: regression)
+_LOWER_BETTER_MARKERS = ("error", "stall", "_ms", "_latency", "_bytes")
 
 #: ``parsed`` summary keys that are metric metadata, never metrics
 _NON_METRIC_KEYS = frozenset({
@@ -104,7 +106,8 @@ def _looks_like_metric(key: str, value: Any) -> bool:
             or not isinstance(value, (int, float)):
         return False
     return ("_per_" in key or key.endswith(
-        ("_per_sec", "_tflops", "_error", "_map", "_qps", "_p99_ms")))
+        ("_per_sec", "_tflops", "_error", "_map", "_qps", "_p99_ms",
+         "_mfu", "_membw_util")))
 
 
 def load_artifact(path: str) -> Artifact:
@@ -139,6 +142,14 @@ def load_artifact(path: str) -> Artifact:
                 and not isinstance(value, bool):
             metrics[name] = {"value": float(value),
                              "scaled": "scaled" in obj}
+            # companion keys riding the metric line (*_mfu,
+            # *_membw_util, other *_per_* evidence) band like
+            # first-class metrics, inheriting the line's scaled flag
+            for key, extra in obj.items():
+                if _looks_like_metric(key, extra):
+                    metrics.setdefault(key, {
+                        "value": float(extra),
+                        "scaled": "scaled" in obj})
     parsed = blob.get("parsed")
     if isinstance(parsed, dict):
         headline = parsed.get("metric")
@@ -158,14 +169,30 @@ def load_artifact(path: str) -> Artifact:
     return Artifact(path, round_n, metrics, meta)
 
 
-def discover_history(current_path: str) -> List[Artifact]:
-    """Every ``BENCH_r*.json`` in the current artifact's directory,
+def artifact_prefix(path: str) -> str:
+    """The artifact-family prefix of one ``<PREFIX>_r<N>.json`` driver
+    artifact (``BENCH_r05.json`` -> ``BENCH``, ``MULTICHIP_r05.json``
+    -> ``MULTICHIP``); unrecognized names fall back to ``BENCH`` so the
+    historical behaviour is preserved."""
+    m = re.match(r"(?P<prefix>.+?)_r\d+\.json$", os.path.basename(path))
+    return m.group("prefix") if m else "BENCH"
+
+
+def discover_history(current_path: str,
+                     prefix: Optional[str] = None) -> List[Artifact]:
+    """Every ``<prefix>_r*.json`` in the current artifact's directory,
     EXCLUDING the current artifact (its own value must not widen its
-    own band), ordered by round."""
+    own band), ordered by round. ``prefix`` defaults to the current
+    artifact's own family (:func:`artifact_prefix`), so comparing two
+    ``MULTICHIP_r*.json`` artifacts draws its noise bands from the
+    MULTICHIP history, never from the BENCH one."""
+    if prefix is None:
+        prefix = artifact_prefix(current_path)
     directory = os.path.dirname(os.path.abspath(current_path)) or "."
     out: List[Artifact] = []
     cur = os.path.abspath(current_path)
-    for path in sorted(glob.glob(os.path.join(directory, "BENCH_r*.json"))):
+    for path in sorted(glob.glob(
+            os.path.join(directory, glob.escape(prefix) + "_r*.json"))):
         if os.path.abspath(path) == cur:
             continue
         try:

@@ -42,6 +42,12 @@ METRIC_NAMES: FrozenSet[str] = frozenset({
     # gauges so the Prometheus endpoint scrapes them)
     "process.rss_bytes",
     "h2d.pool_queue_depth",
+    # observability/compilelog.py — the compile observatory (PR 9):
+    # every XLA compile counted and timed; compiles recorded while a
+    # warmup fence is armed are runtime recompiles, i.e. bugs
+    "compile.count",
+    "compile.wall_s",
+    "compile.unexpected_total",
 })
 
 #: catalogued name FAMILIES: a dynamic metric name must start with one

@@ -57,10 +57,19 @@ def postmortem_dir() -> Path:
 
 
 def dump_postmortem(reason: str,
-                    context: Optional[Dict[str, Any]] = None
+                    context: Optional[Dict[str, Any]] = None,
+                    capture_executables: bool = False
                     ) -> Optional[str]:
     """Write one post-mortem artifact; returns its path, or None when
-    disabled or the dump itself failed (best-effort by contract)."""
+    disabled or the dump itself failed (best-effort by contract).
+
+    The dump always carries the compile observatory's per-executable
+    table (which programs ran, how often, what compiled); with
+    ``capture_executables=True`` (the device-OOM path) missing
+    ``memory_analysis`` stats are resolved first through the AOT path,
+    so the artifact names which executables' argument/output/temp
+    bytes were holding HBM when the allocator failed — not just that
+    one ran out."""
     if not postmortem_enabled():
         return None
     global _SEQ
@@ -73,6 +82,12 @@ def dump_postmortem(reason: str,
         path = directory / (
             f"postmortem-{reason}-{os.getpid()}-{seq}.json")
         rec = flight_recorder()
+        from .compilelog import compile_observatory, executable_table
+
+        try:
+            executables = executable_table(capture=capture_executables)
+        except Exception:
+            executables = []  # evidence collection must not mask the crash
         blob = {
             "reason": reason,
             "time_unix": time.time(),
@@ -80,6 +95,8 @@ def dump_postmortem(reason: str,
             "context": context or {},
             "metrics": MetricsRegistry.get_or_create().snapshot(),
             "flight_recorder": rec.to_chrome_trace(),
+            "compiles": compile_observatory().snapshot(),
+            "executables": executables,
         }
         tmp = path.with_name(path.name + f".tmp{os.getpid()}")
         with open(tmp, "w") as f:
@@ -91,7 +108,8 @@ def dump_postmortem(reason: str,
 
 
 def attach_postmortem(exc: BaseException, reason: str,
-                      context: Optional[Dict[str, Any]] = None
+                      context: Optional[Dict[str, Any]] = None,
+                      capture_executables: bool = False
                       ) -> BaseException:
     """Dump a post-mortem for ``exc`` and name the artifact in the
     exception message (``exc.postmortem_path`` carries it structured).
@@ -99,8 +117,13 @@ def attach_postmortem(exc: BaseException, reason: str,
 
         raise attach_postmortem(IngestTimeoutError(...),
                                 "ingest_timeout", {"chunk": seen})
+
+    ``capture_executables=True`` is the device-OOM spelling: the dump
+    resolves per-executable ``memory_analysis`` tables first (see
+    :func:`dump_postmortem`).
     """
-    path = dump_postmortem(reason, context)
+    path = dump_postmortem(reason, context,
+                           capture_executables=capture_executables)
     exc.postmortem_path = path
     if path and exc.args and isinstance(exc.args[0], str):
         exc.args = (exc.args[0] + f" [post-mortem: {path}]",

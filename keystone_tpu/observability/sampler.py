@@ -41,22 +41,32 @@ from ..utils.guarded import guarded_by
 from .metrics import MetricsRegistry
 
 
+def _ru_maxrss_bytes() -> float:
+    """Peak RSS from ``getrusage``, unit-normalized: POSIX leaves
+    ``ru_maxrss``'s unit to the platform — Linux/BSD report KILOBYTES,
+    macOS reports BYTES. Multiplying blindly by 1024 would inflate a
+    Darwin reading 1024x (a 2 GiB process would read as 2 TiB), so the
+    shim keys the multiplier on the platform."""
+    import resource
+    import sys
+
+    raw = float(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+    return raw if sys.platform == "darwin" else raw * 1024.0
+
+
 def _rss_bytes() -> float:
     """Current resident set size. Linux: ``/proc/self/statm`` resident
-    pages x page size; fallback: peak RSS from getrusage (documented as
-    peak, better than nothing on non-procfs platforms)."""
+    pages x page size; non-procfs platforms (macOS, some containers)
+    fall back to :func:`_ru_maxrss_bytes` — documented as PEAK rather
+    than current RSS, better than a dead probe. Both paths broken
+    raises, and ``sample_once`` skips the probe for that tick (the
+    broken-probe contract, pinned in tests)."""
     try:
         with open("/proc/self/statm") as f:
             pages = int(f.read().split()[1])
         return float(pages * os.sysconf("SC_PAGE_SIZE"))
     except (OSError, ValueError, IndexError):
-        try:
-            import resource
-
-            return float(
-                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024)
-        except Exception:
-            return 0.0
+        return _ru_maxrss_bytes()
 
 
 def _h2d_pool_queue_depth() -> float:

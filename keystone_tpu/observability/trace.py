@@ -91,6 +91,13 @@ class NodeRecord:
     cached: bool = False       # value came from the prefix/state memo
     shards: int = 1            # data shards of the output dataset
     kind: str = ""             # expression kind (dataset/datum/transformer)
+    # hardware-utilization annotations (observability/utilization.py
+    # ``annotate_trace`` back-fills them from the compile observatory's
+    # per-executable cost_analysis; zero = not annotated)
+    flops: float = 0.0         # XLA cost-model FLOPs of this node's program
+    mfu: float = 0.0           # achieved FLOP/s over device peak
+    membw_util: float = 0.0    # achieved bytes/s over HBM bandwidth
+    plan_vs_xla: float = 0.0   # static HbmPlan bytes / XLA output+temp bytes
 
 
 class _Frame:
@@ -102,6 +109,7 @@ class _Frame:
 
 @guarded_by("_resilience_lock", "resilience", "resilience_stats")
 @guarded_by("_lock_wait_lock", "lock_waits")
+@guarded_by("_compile_lock", "compiles", "compile_stats")
 class PipelineTrace:
     """Collects one run's execution telemetry; see module docstring.
 
@@ -149,6 +157,18 @@ class PipelineTrace:
         # schedule harness can interleave at it (the PR 4 race's
         # regression schedule lives in tests/test_concurrency_sched.py)
         self._resilience_lock = TracedLock("trace.resilience")
+        #: compile events observed while this trace was active
+        #: (``observability/compilelog.py``): site name, wall, trigger
+        #: classification, signature delta, unexpected flag — same
+        #: bounded-tail-plus-exact-stats shape as ``resilience``.
+        #: Compiles can fire from ingest worker threads (the streaming
+        #: consumer's wire-cast, decode-side helpers), hence the lock
+        #: (plain: compile records also feed metrics/recorder, the
+        #: usual boundary).
+        self.compiles: List[Dict[str, Any]] = []
+        self.compile_stats: Dict[str, float] = {
+            "count": 0, "wall_s": 0.0, "unexpected": 0}
+        self._compile_lock = threading.Lock()
         #: contended-lock wait table fed by TracedLock while this trace
         #: is active: {lock name: {"count": n, "wait_s": total}}. Its
         #: own guard is a PLAIN lock — TracedLock reports in here, so a
@@ -294,6 +314,25 @@ class PipelineTrace:
                 del self.resilience[: len(self.resilience)
                                     - self.RESILIENCE_TAIL]
 
+    #: raw compile entries retained (``compile_stats`` stays exact)
+    COMPILE_TAIL = 512
+
+    def record_compile(self, entry: Dict[str, Any]) -> None:
+        """One XLA compile observed while this trace was active
+        (:mod:`keystone_tpu.observability.compilelog`): site name,
+        compile wall, trigger (first-compile / signature-change /
+        mesh-change / retrace / unowned), the signature delta when one
+        is nameable, the attributing context (an executor node scope),
+        and the ``unexpected`` flag when a warmup fence was armed."""
+        with self._compile_lock:
+            self.compile_stats["count"] += 1
+            self.compile_stats["wall_s"] += float(entry.get("wall_s", 0.0))
+            if entry.get("unexpected"):
+                self.compile_stats["unexpected"] += 1
+            self.compiles.append(entry)
+            if len(self.compiles) > self.COMPILE_TAIL:
+                del self.compiles[: len(self.compiles) - self.COMPILE_TAIL]
+
     def record_lock_wait(self, name: str, wait_s: float) -> None:
         """One contended :class:`~keystone_tpu.utils.guarded.TracedLock`
         acquire while this trace was active (called from whichever
@@ -341,6 +380,8 @@ class PipelineTrace:
             "streamed_fits": list(self.streamed_fits),
             "resilience": list(self.resilience),
             "resilience_stats": dict(self.resilience_stats),
+            "compiles": list(self.compiles),
+            "compile_stats": dict(self.compile_stats),
             "lock_waits": {k: dict(v)
                            for k, v in self.lock_waits.items()},
         }
@@ -384,6 +425,18 @@ class PipelineTrace:
                 ev = str(e.get("event", "other"))
                 tr.resilience_stats[ev] = (
                     tr.resilience_stats.get(ev, 0) + 1)
+        tr.compiles = list(data.get("compiles", []))
+        cstats = data.get("compile_stats")
+        if cstats is None and tr.compiles:  # older artifact: rebuild
+            cstats = {
+                "count": len(tr.compiles),
+                "wall_s": sum(float(c.get("wall_s", 0.0))
+                              for c in tr.compiles),
+                "unexpected": sum(1 for c in tr.compiles
+                                  if c.get("unexpected")),
+            }
+        if cstats is not None:
+            tr.compile_stats = dict(cstats)
         tr.lock_waits = {k: dict(v) for k, v in
                          data.get("lock_waits", {}).items()}
         return tr
@@ -451,6 +504,16 @@ class PipelineTrace:
                 f"streamed fit [{sf.get('source')}]: "
                 f"{sf.get('chunks', 0)} chunk(s), measured peak "
                 f"{peak / mib:.2f} MiB, {shown}")
+        if self.compile_stats["count"]:
+            c = self.compile_stats
+            worst = sorted(self.compiles,
+                           key=lambda e: -float(e.get("wall_s", 0.0)))[:3]
+            shown = ", ".join(
+                f"{e.get('name')} ({float(e.get('wall_s', 0.0)):.2f}s, "
+                f"{e.get('trigger')})" for e in worst)
+            lines.append(
+                f"compiles: {int(c['count'])} ({c['wall_s']:.2f}s wall, "
+                f"{int(c['unexpected'])} unexpected) — top: {shown}")
         if self.resilience_stats:
             counts = " ".join(
                 f"{k}={int(v)}" for k, v in sorted(

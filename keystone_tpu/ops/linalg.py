@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..observability.compilelog import observed_jit, watch_jit
 from ..parallel.mesh import get_mesh
 
 
@@ -100,7 +101,7 @@ def _gram_sym_tile(d: int):
     return t if d % t == 0 else None
 
 
-@functools.partial(jax.jit, static_argnames=("preferred",))
+@functools.partial(observed_jit, static_argnames=("preferred",))
 def gram(A: jax.Array, preferred: Optional[jnp.dtype] = None) -> jax.Array:
     """A^T A. With A row-sharded this compiles to local GEMM + all-reduce
     (the analogue of the reference's treeReduce of per-partition Grams).
@@ -134,7 +135,7 @@ def gram(A: jax.Array, preferred: Optional[jnp.dtype] = None) -> jax.Array:
     return jnp.concatenate(rows, axis=0)
 
 
-@functools.partial(jax.jit, static_argnames=("preferred",))
+@functools.partial(observed_jit, static_argnames=("preferred",))
 def cross(A: jax.Array, B: jax.Array, preferred: Optional[jnp.dtype] = None) -> jax.Array:
     """A^T B with co-sharded rows."""
     return jnp.einsum("nd,nk->dk", A, B, preferred_element_type=preferred,
@@ -226,12 +227,12 @@ def _finite_or_eigh_solve(W, reg_fn, rhs, ok=None):
     return jax.lax.cond(ok, lambda _: W, fallback, None)
 
 
-@functools.partial(jax.jit, static_argnames=())
+@functools.partial(observed_jit, static_argnames=())
 def _normal_equations_jit(A, Y, lam):
     return ridge_cho_solve(gram(A), cross(A, Y), lam)
 
 
-@functools.partial(jax.jit, static_argnames=())
+@functools.partial(observed_jit, static_argnames=())
 def _normal_equations_pallas_jit(A, Y, lam):
     from .pallas_kernels import gram_cross_pallas
 
@@ -277,7 +278,7 @@ def local_least_squares_dual(A: jax.Array, Y: jax.Array, lam: float) -> jax.Arra
     return _dual_solve_jit(A, Y, jnp.asarray(lam, A.dtype))
 
 
-@jax.jit
+@observed_jit
 def _dual_solve_jit(A, Y, lam):
     with solver_precision():
         n = A.shape[0]
@@ -490,7 +491,9 @@ def _bcd_jit_for(mesh):
     def _bcd_core_on_mesh(blocks, Y, lam, *, num_passes: int):
         return bcd_core(blocks, Y, lam, num_passes=num_passes)
 
-    return jax.jit(_bcd_core_on_mesh, static_argnames=("num_passes",))
+    return watch_jit(
+        jax.jit(_bcd_core_on_mesh, static_argnames=("num_passes",)),
+        name="bcd_core")
 
 
 def solve_one_pass_l2(
@@ -594,10 +597,10 @@ def _tsqr_run(mesh):
             **check_kw,
         )(A)
 
-    return run
+    return watch_jit(run, name="tsqr_run")
 
 
-@jax.jit
+@observed_jit
 def _fix_r_sign(R: jax.Array) -> jax.Array:
     sign = jnp.sign(jnp.diagonal(R))
     sign = jnp.where(sign == 0, 1.0, sign).astype(R.dtype)
@@ -606,7 +609,7 @@ def _fix_r_sign(R: jax.Array) -> jax.Array:
 
 # -- helpers ---------------------------------------------------------------
 
-@jax.jit
+@observed_jit
 def _sum_cols_div(A, n):
     return jnp.sum(A, axis=0) / n
 

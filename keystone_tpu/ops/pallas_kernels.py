@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability.compilelog import observed_jit
+
 try:  # pallas ships with jax; guard anyway for minimal builds
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -72,7 +74,7 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(observed_jit, static_argnames=("interpret",))
 def gram_cross_pallas(X: jax.Array, Y: jax.Array,
                       interpret: bool = False) -> Tuple[jax.Array, jax.Array]:
     """(X^T X, X^T Y) in one pass over X. Pads to tile alignment
@@ -239,7 +241,7 @@ def _fused_featurize_kernel(patch_ref, filt_ref, fsum_ref, bias_ref,
 
 
 @functools.partial(
-    jax.jit,
+    observed_jit,
     static_argnames=("img_size", "patch_size", "channels", "pool_stride",
                      "pool_size", "var_constant", "alpha", "interpret"),
 )

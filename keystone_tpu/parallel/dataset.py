@@ -297,17 +297,23 @@ def _vmap_cacheable(fn) -> bool:
 
 
 def _masked_vmap(fn, data, n: int, padded_n: int, mesh: Mesh):
+    from ..observability.compilelog import watch_jit
+
+    name = f"vmap:{getattr(fn, '__name__', 'fn')}"
     jfn = None
     if _vmap_cacheable(fn):
         try:
             jfn = _VMAP_JIT_CACHE.get(fn)
             if jfn is None:
-                jfn = jax.jit(jax.vmap(fn))
+                jfn = watch_jit(jax.jit(jax.vmap(fn)), name=name)
                 _VMAP_JIT_CACHE.put(fn, jfn)
         except TypeError:  # unhashable fn
             jfn = None
     if jfn is None:
-        jfn = jax.jit(jax.vmap(fn))
+        # uncacheable per-call jit: the compile observatory makes this
+        # visible as a fresh first-compile per call — the exact hazard
+        # the memo above exists to avoid
+        jfn = watch_jit(jax.jit(jax.vmap(fn)), name=name)
     out = jfn(data)
     return _apply_mask(out, n, mesh) if n < padded_n else out
 

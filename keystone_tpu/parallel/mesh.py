@@ -117,6 +117,21 @@ def replicated_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def replicated_zeros(mesh: Mesh, shapes):
+    """f32 zero buffers explicitly replicated on ``mesh``
+    (``NamedSharding(mesh, P())`` rather than the default
+    SingleDeviceSharding). The sharding KIND matters: jax's jit cache
+    keys on input shardings, so a streamed-accumulate carry seeded as
+    single-device recompiles its update program on chunk 2 when the
+    mesh-sharded chunk-1 output arrives — a replicated init keeps the
+    carry's sharding stable from call 1 (the compile observatory's fit
+    fence flagged exactly this in the Gram and moments carries)."""
+    import jax.numpy as jnp
+
+    sh = NamedSharding(mesh, P())
+    return [jax.device_put(jnp.zeros(s, jnp.float32), sh) for s in shapes]
+
+
 #: shared per-shard H2D staging pool (lazy; every staging site —
 #: streaming prefetch, resident ArrayDataset construction — fans shard
 #: puts through ONE small pool: staging is transfer-bound, not

@@ -166,10 +166,17 @@ def _cast_program(treedef, casts: Tuple) -> Callable:
         with _CAST_BUILD_LOCK:
             fn = _CAST_JIT_CACHE.get(key)
             if fn is None:
+                from ..observability.compilelog import watch_jit
+
                 cast_tree = jax.tree_util.tree_unflatten(
                     treedef, list(casts))
-                fn = jax.jit(lambda data: jax.tree_util.tree_map(
-                    lambda x, t: x.astype(t), data, cast_tree))
+                # observed site: the memo stores the WATCHED wrapper,
+                # so a cast that recompiles per chunk (the pre-PR-5
+                # per-instance-memo bug) shows up as classified
+                # compile records, not silent wall time
+                fn = watch_jit(jax.jit(lambda data: jax.tree_util.tree_map(
+                    lambda x, t: x.astype(t), data, cast_tree)),
+                    name="wire_cast")
                 _CAST_JIT_CACHE.put(key, fn)
     return fn
 
@@ -1098,39 +1105,71 @@ def fit_streaming(estimator: Any, data: StreamingDataset,
     idx = -1
     reg = MetricsRegistry.get_or_create()
     tag = data.tag or "stream"
-    for chunk, lchunk in _paired_chunks(data, labels):
-        idx += 1
-        if idx < start_chunk:
-            continue  # resume replay: already folded into the carry
-        t_acc = time.perf_counter()
-        if takes_labels:
-            carry = estimator.accumulate(carry, chunk, lchunk)
-        else:
-            carry = estimator.accumulate(carry, chunk)
-        # the compute lane of a streamed fit's flight timeline (host
-        # wall of the accumulate dispatch — jax async work continues
-        # past it, which is exactly the overlap the lanes show)
-        record_span(f"accumulate:{tag}", "compute", t_acc,
-                    time.perf_counter() - t_acc, args={"chunk": idx})
-        reg.gauge("streaming.carry_bytes").set(sum(
-            float(getattr(leaf, "nbytes", 0) or 0)
-            for leaf in jax.tree_util.tree_leaves(carry)))
-        chunks_seen += 1
-        if hbm_budget is not None:
-            resident = data.buffered_nbytes()
-            if resident > hbm_budget:
-                raise attach_postmortem(MemoryError(
-                    f"streamed fit exceeded its HBM budget: "
-                    f"{resident:.0f} B resident > {hbm_budget:.0f} B "
-                    f"(chunk {chunks_seen}; shrink chunk_size or "
-                    "prefetch_depth)"),
-                    "hbm_budget",
-                    {"source": tag, "phase": "runtime",
-                     "resident_nbytes": resident,
-                     "hbm_budget": hbm_budget, "chunk": chunks_seen})
-        if ckpt is not None and (idx + 1) % checkpoint_every == 0:
-            ckpt.save(fingerprint, idx + 1, carry,
-                      None if quarantine is None else quarantine.state())
+    from ..observability.compilelog import compile_observatory, is_device_oom
+
+    obs = compile_observatory()
+    fence_armed = False
+    try:
+        for chunk, lchunk in _paired_chunks(data, labels):
+            idx += 1
+            if idx < start_chunk:
+                continue  # resume replay: already folded into the carry
+            t_acc = time.perf_counter()
+            try:
+                if takes_labels:
+                    carry = estimator.accumulate(carry, chunk, lchunk)
+                else:
+                    carry = estimator.accumulate(carry, chunk)
+            except Exception as exc:
+                if is_device_oom(exc):
+                    # the allocator failed mid-accumulate: the dump must
+                    # say WHICH executables' argument/output/temp bytes
+                    # held HBM, so resolve per-executable
+                    # memory_analysis tables into it (AOT, no execution)
+                    raise attach_postmortem(
+                        exc, "device_oom",
+                        {"source": tag, "phase": "accumulate",
+                         "chunk": idx},
+                        capture_executables=True)
+                raise
+            # the compute lane of a streamed fit's flight timeline (host
+            # wall of the accumulate dispatch — jax async work continues
+            # past it, which is exactly the overlap the lanes show)
+            record_span(f"accumulate:{tag}", "compute", t_acc,
+                        time.perf_counter() - t_acc, args={"chunk": idx})
+            reg.gauge("streaming.carry_bytes").set(sum(
+                float(getattr(leaf, "nbytes", 0) or 0)
+                for leaf in jax.tree_util.tree_leaves(carry)))
+            chunks_seen += 1
+            if hbm_budget is not None:
+                resident = data.buffered_nbytes()
+                if resident > hbm_budget:
+                    raise attach_postmortem(MemoryError(
+                        f"streamed fit exceeded its HBM budget: "
+                        f"{resident:.0f} B resident > {hbm_budget:.0f} B "
+                        f"(chunk {chunks_seen}; shrink chunk_size or "
+                        "prefetch_depth)"),
+                        "hbm_budget",
+                        {"source": tag, "phase": "runtime",
+                         "resident_nbytes": resident,
+                         "hbm_budget": hbm_budget, "chunk": chunks_seen},
+                        capture_executables=True)
+            if ckpt is not None and (idx + 1) % checkpoint_every == 0:
+                ckpt.save(fingerprint, idx + 1, carry,
+                          None if quarantine is None
+                          else quarantine.state())
+            if chunks_seen == 1 and not fence_armed:
+                # per-chunk compile fence: every later chunk shares this
+                # chunk's padded shape, so steady state must compile
+                # NOTHING (the PR 3 zero-recompile invariant, asserted
+                # dynamically) — any compile recorded from here to the
+                # last chunk is classified unexpected, named with its
+                # signature delta
+                obs.arm_fence(f"fit_streaming:{tag}")
+                fence_armed = True
+    finally:
+        if fence_armed:
+            obs.disarm_fence()
     if carry is None:
         raise ValueError("empty stream: nothing to fit")
     model = estimator.finalize(carry)

@@ -132,9 +132,17 @@ def donating_jit(fn: Callable, donate_argnums: Sequence[int],
         if jitted is None:
             import jax
 
+            from ..observability.compilelog import watch_jit
+
             donate = tuple(donate_argnums) if donation_enabled() else ()
-            jitted = jax.jit(fn, donate_argnums=donate,
-                             static_argnames=static_argnames)
+            # compile-observatory site: every compile of this donated
+            # program is counted/timed/classified, and a recompile
+            # after a warmup fence (a carry whose shape drifted) is
+            # flagged as unexpected with its signature delta
+            jitted = watch_jit(
+                jax.jit(fn, donate_argnums=donate,
+                        static_argnames=static_argnames),
+                name=getattr(fn, "__name__", "donating_jit"))
             box["fn"] = jitted
         return jitted(*args, **kwargs)
 

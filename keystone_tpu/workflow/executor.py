@@ -21,6 +21,7 @@ from __future__ import annotations
 
 from typing import Dict, FrozenSet, Optional
 
+from ..observability.compilelog import compile_context
 from ..observability.metrics import MetricsRegistry
 from ..observability.timeline import record_span
 from ..observability.trace import NodeRecord, current_trace, metrics_suppressed
@@ -120,8 +121,14 @@ def _traced_thunk(orig, node_id: int, label: str, kind: str):
                 import contextlib
 
                 ann = contextlib.nullcontext()
-            with jax.named_scope(scope), ann:
-                value = orig()
+            # compile attribution: any XLA compile dispatched while
+            # this node's thunk runs — including app-level jits the
+            # observatory does not own — is recorded against
+            # "node:<label>#<id>", which is what utilization's
+            # annotate_trace joins per-node MFU on
+            with compile_context(f"node:{scope}"):
+                with jax.named_scope(scope), ann:
+                    value = orig()
             _block_on_device(value)
             _measure_output(record, value)
         # flight-recorder span (inclusive wall): traced node timelines

@@ -89,10 +89,17 @@ def struct_cached_jit(key: Any, builder: Callable[[], Callable]) -> Callable:
     the structure-keyed sibling of ``Transformer._cached_jit`` (which
     keys on content-bearing eq_keys). Used by fusion to share ONE
     compiled program across refits whose fitted params ride as runtime
-    arguments."""
+    arguments. Programs are compile-observatory sites: the memo stores
+    the WATCHED wrapper, so every refit shares one site and a refit
+    that recompiles shows up as a classified compile record instead of
+    silent wall time."""
+    from ..observability.compilelog import watch_jit
+
     fn = _JIT_CACHE.get(key)
     if fn is None:
-        fn = jax.jit(builder())
+        name = (key[0] if isinstance(key, tuple) and key
+                and isinstance(key[0], str) else "struct_jit")
+        fn = watch_jit(jax.jit(builder()), name=name)
         _JIT_CACHE.put(key, fn)
     return fn
 
@@ -189,6 +196,8 @@ class Transformer(TransformerOperator, Chainable):
         attr = "_jit_" + tag
         fn = self.__dict__.get(attr)
         if fn is None:
+            from ..observability.compilelog import watch_jit
+
             try:
                 key = (tag, self._cached_eq_key())
                 fn = _JIT_CACHE.get(key)
@@ -196,7 +205,12 @@ class Transformer(TransformerOperator, Chainable):
                 key = None
                 fn = None
             if fn is None:
-                fn = jax.jit(builder())
+                # observed site named by node class + tag: a
+                # per-instance-only program (unhashable eq_key) that
+                # recompiles per refit is exactly what the runtime
+                # recompile detector exists to surface
+                fn = watch_jit(jax.jit(builder()),
+                               name=f"{type(self).__name__}.{tag}")
                 if key is not None:
                     _JIT_CACHE.put(key, fn)
             self.__dict__[attr] = fn

@@ -50,6 +50,9 @@ def fresh_env():
     from keystone_tpu.nodes.learning.least_squares import (
         clear_calibration_cache,
     )
+    from keystone_tpu.observability.compilelog import (
+        reset_compile_observatory,
+    )
     from keystone_tpu.observability.metrics import MetricsRegistry
     from keystone_tpu.observability.timeline import reset_flight_recorder
     from keystone_tpu.workflow.env import PipelineEnv
@@ -57,11 +60,13 @@ def fresh_env():
     PipelineEnv.reset()
     MetricsRegistry.reset()
     reset_flight_recorder()
+    reset_compile_observatory()
     clear_calibration_cache()
     yield
     PipelineEnv.reset()
     MetricsRegistry.reset()
     reset_flight_recorder()
+    reset_compile_observatory()
     clear_calibration_cache()
 
 
