@@ -24,6 +24,12 @@
 #                                  streamed fit twice; ANY compile in the
 #                                  second epoch fails (compile observatory
 #                                  fence, the dynamic recompile-hazard gate)
+#   2b'. numerics gate             tools/numerics_gate.py — a clean smoke
+#                                  streamed fit must pull health words and
+#                                  write NO post-mortem; the same fit with
+#                                  one fault-injected NaN chunk must raise
+#                                  NumericsError naming chunk+stream with
+#                                  a post-mortem carrying the health series
 #   2c. bounded-seed stress        the deterministic-interleaving suite
 #                                  (tests/test_concurrency_sched.py):
 #                                  historical-race regression schedules +
@@ -91,6 +97,13 @@ if (( run_tests )); then
   # instead of only by one tier-1 test)
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     "$PY" "$KEYSTONE_HOME/tools/recompile_gate.py"
+
+  echo "== ci: numerics gate (injected NaN must trip; clean fit must not) =="
+  # the dynamic pin for the data-health plane: both directions of the
+  # tripwire contract (tools/numerics_gate.py), against the real
+  # streamed path with a deterministic kind="corrupt" fault injection
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    "$PY" "$KEYSTONE_HOME/tools/numerics_gate.py"
 
   echo "== ci: bounded-seed concurrency stress (regression schedules + fuzz) =="
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \

@@ -5,6 +5,7 @@
     python -m keystone_tpu check <app> [--json PATH] [--budget BYTES]
     python -m keystone_tpu check --all [--budget BYTES]
     python -m keystone_tpu benchdiff BASE.json CURRENT.json [--force]
+    python -m keystone_tpu numerics POSTMORTEM.json
 
 Run with no arguments to list the available applications.
 
@@ -13,6 +14,11 @@ Run with no arguments to list the available applications.
 two ``BENCH_r*.json`` artifacts as improved / in-band / regressed
 against per-metric noise bands derived from the artifact history, and
 exits 0/1/2 accordingly.
+
+``numerics`` renders a numerics-tripwire post-mortem artifact
+(``observability/numerics.py``): the failure context, the embedded
+recent health series as a table, and the ``numerics.*`` counters —
+how to read one is documented in README "Numerics health".
 
 ``check`` statically analyzes an app's pipeline DAG — shape/dtype
 propagation, the graph lints, and the static HBM plan (see
@@ -221,7 +227,9 @@ def main(argv=None) -> int:
         print("usage: python -m keystone_tpu <app> [--flags]\n"
               "       python -m keystone_tpu check <app>|--all\n"
               "       python -m keystone_tpu benchdiff BASE.json "
-              "CURRENT.json\n\napps:")
+              "CURRENT.json\n"
+              "       python -m keystone_tpu numerics "
+              "POSTMORTEM.json\n\napps:")
         for name in sorted(APPS):
             print(f"  {name}")
         return 0
@@ -233,6 +241,11 @@ def main(argv=None) -> int:
         from keystone_tpu.observability.benchdiff import main as bd_main
 
         return bd_main(rest)
+    if app == "numerics":
+        # device-free: renders a numerics post-mortem artifact
+        from keystone_tpu.observability.numerics import postmortem_report
+
+        return postmortem_report(rest)
     import os
 
     # Environments that import jax at interpreter start (device-plugin

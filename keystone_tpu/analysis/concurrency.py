@@ -69,20 +69,13 @@ CONCURRENCY_SCOPES = (
 #:   guarded-field-race / non-atomic-guarded-sequence:
 #:       "Class.method:field"
 #:   blocking-under-lock: "function_or_Class.method:callee_attr"
-#: (Empty from PR 7 when every surfaced true positive was FIXED —
-#: Histogram/Counter RMWs, the quarantine manifest write, the
-#: cast-cache double-create — rather than suppressed.)
-CONCURRENCY_ALLOWLIST: FrozenSet[str] = frozenset({
-    # capture_stats memoizes an AOT cost_analysis result: the read
-    # (cache check) and the write happen under separate lock holds
-    # because the compile in between must NOT run under the lock
-    # (blocking-under-lock). Two racing captures of the same signature
-    # both compute the SAME value-equal stats dict and the last write
-    # wins — identity is never relied on (unlike the _CAST_JIT_CACHE
-    # double-create, where the loser's distinct jit wrapper recompiled
-    # per chunk), so the lost update is harmless by construction.
-    "_JitSite.capture_stats:stats",
-})
+#: (Empty again from PR 10: every surfaced true positive has been
+#: FIXED rather than suppressed — the PR 7 batch [Histogram/Counter
+#: RMWs, the quarantine manifest write, the cast-cache double-create]
+#: and the PR 9 `_JitSite.capture_stats` lost update, whose blind
+#: stats-overwrite became an atomic setdefault-adopt under one lock
+#: hold. An entry here is a debt, not a convention.)
+CONCURRENCY_ALLOWLIST: FrozenSet[str] = frozenset()
 
 
 def _allowed(key: str, allowlist: Optional[Iterable[str]] = None) -> bool:

@@ -302,6 +302,76 @@ def swallow_all_handlers(tree) -> List[tuple]:
     return hits
 
 
+#: directories (under ``keystone_tpu/``) where NaN-suppressing code
+#: must be PAIRED with a recorded ``numerics.*`` event: the numeric
+#: compute trees are exactly where a ``nan_to_num`` or an
+#: ``np.errstate(...='ignore')`` turns a real breakdown into silently
+#: plausible numbers — the numerics plane (observability/numerics.py)
+#: exists so suppression is always accounted. tools/lint.py enforces.
+NAN_SILENCER_SCOPES = ("nodes", "ops", "parallel", "workflow")
+
+#: call names that count as recording into the numerics event funnel
+#: (observability/numerics.py — the one place sites report through)
+_NUMERICS_RECORDERS = frozenset({
+    "record_numerics_event", "record_solve_health", "record_block_health",
+})
+
+
+def _errstate_ignores(call) -> bool:
+    """True when an ``errstate(...)`` call actually SUPPRESSES — any
+    keyword whose value is the literal ``'ignore'``.
+    ``errstate(all='raise')`` is the opposite of suppression and never
+    fires the lint."""
+    return any(isinstance(kw.value, ast.Constant)
+               and kw.value.value == "ignore" for kw in call.keywords)
+
+
+def silent_nan_silencers(tree) -> List[tuple]:
+    """``(lineno, description)`` for NaN-suppressing calls with no
+    recorded numerics event in the same function scope — the
+    ``silent-nan-silencer`` rule. Per scope (nested defs are separate
+    scopes, like the cast-before-transfer rule), the co-occurrence of:
+
+    * a silencer — ``nan_to_num(...)`` (any receiver) or an
+      ``errstate(...)`` call with an ``='ignore'`` keyword, and
+    * NO recorder — a :data:`_NUMERICS_RECORDERS` call or a metric
+      factory call with a ``"numerics."``-prefixed literal name.
+
+    The rule does not ban suppression: replacing non-finites can be the
+    right recovery (the clamped-eigh fallback is exactly that). It bans
+    UNACCOUNTED suppression — pair the silencer with
+    ``record_numerics_event(...)`` so the event lands in
+    metrics/trace/flight-recorder and dashboards see the recovery
+    happen (README 'Numerics health')."""
+    hits = []
+    for fdef in ast.walk(tree):
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        silencers = []
+        recorded = False
+        for node in _own_scope_nodes(fdef):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            fname = (f.attr if isinstance(f, ast.Attribute)
+                     else getattr(f, "id", ""))
+            if fname == "nan_to_num":
+                silencers.append((node.lineno, "nan_to_num(...)"))
+            elif fname == "errstate" and _errstate_ignores(node):
+                silencers.append((node.lineno, "errstate(...='ignore')"))
+            elif fname in _NUMERICS_RECORDERS:
+                recorded = True
+            elif fname in _METRIC_FACTORIES and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str) \
+                        and arg.value.startswith("numerics."):
+                    recorded = True
+        if silencers and not recorded:
+            hits.extend(silencers)
+    return sorted(set(hits))
+
+
 #: metric-factory method names whose first argument is a metric name
 #: (``MetricsRegistry.counter/gauge/histogram/timer``)
 _METRIC_FACTORIES = frozenset({"counter", "gauge", "histogram", "timer"})

@@ -57,9 +57,15 @@ def _array_token(a):
         # forever) — the nan-ignoring moments keep the key stable AND
         # content-distinguishing, and a non-finite fitted array is
         # worth shouting about: a silently-NaN solve predicts a
-        # constant class.
+        # constant class. The warning also lands in the numerics event
+        # funnel (metrics/trace/flight-recorder), so dashboards see it
+        # even when nobody reads the log.
         import logging
 
+        from ...observability.numerics import record_numerics_event
+
+        record_numerics_event("nonfinite_model",
+                              shape=tuple(arr.shape), count=int(m[3]))
         logging.getLogger(__name__).warning(
             "fitted array %s contains %d non-finite values — the solve "
             "likely failed; check conditioning/lambda",
@@ -366,7 +372,8 @@ def _finalize_normal_equations_impl(G, C, sx, sy, n, lam):
         y_mean = sy / n
         Gc = G - n * jnp.outer(x_mean, x_mean)
         Cc = C - n * jnp.outer(x_mean, y_mean)
-        return x_mean, y_mean, linalg.ridge_cho_solve(Gc, Cc, lam)
+        return x_mean, y_mean, linalg.ridge_cho_solve(
+            Gc, Cc, lam, site="finalize_normal_equations")
 
 
 def _finalize_probe(d: int = 8, k: int = 3):
@@ -404,12 +411,20 @@ def _gram_bcd_impl(G, C, sx, sy, n, lam, bounds, num_iter):
         y_mean = sy / n
         Gc = G - n * jnp.outer(x_mean, x_mean)
         Cc = C - n * jnp.outer(x_mean, y_mean)
-        factors, oks = [], []
+        factors, oks, ratios = [], [], []
         for lo, hi in bounds:
             Gb = Gc[lo:hi, lo:hi] + lam * jnp.eye(hi - lo, dtype=dtype)
             L = jax.scipy.linalg.cho_factor(Gb, lower=True)
             factors.append(L)
-            oks.append(linalg._chol_healthy(L[0], Gb))
+            ok, ratio = linalg._chol_health(L[0], Gb)
+            oks.append(ok)
+            ratios.append(ratio)
+        # streamed BlockLS breakdowns land in the conditioning ledger
+        # exactly like the resident BCD's (one callback, all blocks)
+        from ...observability.numerics import record_block_health
+
+        record_block_health("gram_bcd", jnp.stack(oks),
+                            jnp.stack(ratios))
         W = jnp.zeros((G.shape[0], k), dtype)
         for _ in range(num_iter):
             for i, (lo, hi) in enumerate(bounds):

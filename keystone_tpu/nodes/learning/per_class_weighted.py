@@ -157,18 +157,32 @@ def _solve_all_classes(X, cls, mask, L, jfm, joint_label_mean, counts,
     from ...ops.linalg import solver_precision
 
     with solver_precision():
-        return jax.lax.map(body, jnp.arange(k)).T  # (d, k)
+        W_all, oks, ratios = jax.lax.map(body, jnp.arange(k))
+    # conditioning ledger: every class's per-block breakdown predicate
+    # and pivot ratio in ONE callback after the map (a per-iteration
+    # callback inside the map body would serialize it — the bcd_scan
+    # rule), so a class whose blocks took the eigh fallback is visible
+    from ...observability.numerics import record_block_health
+
+    record_block_health("per_class_bcd", oks.reshape(-1),
+                        ratios.reshape(-1))
+    return W_all.T  # (d, k)
 
 
 @functools.partial(jax.jit, static_argnames=("bounds", "num_iter"))
 def _solve_single_class(X, b, y, mu, lam, bounds, num_iter):
-    """BCD for one class (reference ReWeightedLeastSquares.scala:37-135)."""
-    from ...ops.linalg import _chol_healthy, _finite_or_eigh_solve
+    """BCD for one class (reference ReWeightedLeastSquares.scala:37-135).
+
+    Returns ``(W, oks, ratios)``: the stacked per-block breakdown
+    predicates and pivot ratios ride out of the ``lax.map`` so the
+    caller records them into the conditioning ledger in one callback."""
+    from ...ops.linalg import _chol_health, _finite_or_eigh_solve
 
     by = b * y
     Ws = [jnp.zeros((hi - lo,), X.dtype) for lo, hi in bounds]
     factors = []
     factor_ok = []
+    factor_ratio = []
     reg_fns = []  # rebuild A only inside a (rare) fallback branch
 
     def _make_reg(lo, hi):
@@ -185,7 +199,9 @@ def _solve_single_class(X, b, y, mu, lam, bounds, num_iter):
         factors.append(L)
         # shared collapsed-pivot gate: finite-but-garbage factors from
         # near-exact rank deficiency also take the eigh fallback
-        factor_ok.append(_chol_healthy(L[0], G))
+        ok, ratio = _chol_health(L[0], G)
+        factor_ok.append(ok)
+        factor_ratio.append(ratio)
         reg_fns.append(reg_fn)
     # residual r accumulates B .* (X_zm @ W)
     r = jnp.zeros_like(y)
@@ -201,4 +217,5 @@ def _solve_single_class(X, b, y, mu, lam, bounds, num_iter):
                 W_new, reg_fns[i], aTb, ok=factor_ok[i])
             r = r + b * (Xzm @ (W_new - Ws[i]))
             Ws[i] = W_new
-    return jnp.concatenate(Ws)
+    return (jnp.concatenate(Ws), jnp.stack(factor_ok),
+            jnp.stack(factor_ratio))

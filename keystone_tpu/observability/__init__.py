@@ -49,6 +49,17 @@ PR 9 added the hardware denominator:
 * :mod:`.utilization` — MFU / roofline accounting from per-executable
   ``cost_analysis()``/``memory_analysis()`` against a per-device-kind
   peak catalogue (``*_mfu`` / ``*_membw_util`` bench keys).
+
+PR 10 added the third plane — the NUMBERS, not the machine:
+
+* :mod:`.numerics` — on-device tensor-health words (finite/NaN/Inf
+  counts, bounds, moments) piggybacked on streamed chunks and traced
+  node outputs with a deferred D2H pull; :class:`NumericsError`
+  tripwires through post-mortems; the solver conditioning ledger
+  (``numerics.breakdown`` events, pivot-ratio/residual histograms);
+  and PSI distribution-drift scoring of apply-time inputs against a
+  fit-time feature sketch (:class:`DriftBaseline`,
+  :func:`score_drift`) that rides checkpoints and fitted models.
 """
 from .compilelog import (
     CompileObservatory,
@@ -60,6 +71,15 @@ from .compilelog import (
     watch_jit,
 )
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry, StepTimer
+from .numerics import (
+    DriftBaseline,
+    NumericsError,
+    health_word,
+    numerics_enabled,
+    numerics_suppressed,
+    record_numerics_event,
+    score_drift,
+)
 from .postmortem import attach_postmortem, dump_postmortem
 from .sampler import TelemetrySampler, serve_metrics
 from .timeline import (
@@ -100,4 +120,11 @@ __all__ = [
     "observed_jit",
     "reset_compile_observatory",
     "watch_jit",
+    "DriftBaseline",
+    "NumericsError",
+    "health_word",
+    "numerics_enabled",
+    "numerics_suppressed",
+    "record_numerics_event",
+    "score_drift",
 ]

@@ -63,8 +63,28 @@ BAND_MARGIN = 1.5
 
 #: metric-name markers for "lower is better" (errors, stalls, latency,
 #: byte counts — h2d_bytes_per_image shrinking is the PR 5 win, not a
-#: regression)
-_LOWER_BETTER_MARKERS = ("error", "stall", "_ms", "_latency", "_bytes")
+#: regression — and the PR 10 numerics-health keys: NaN/breakdown
+#: totals, the drift score, and the measured numerics overhead share
+#: are all failure/cost measures)
+_LOWER_BETTER_MARKERS = ("error", "stall", "_ms", "_latency", "_bytes",
+                         "_nan_total", "_breakdown_total", "drift_score",
+                         "overhead_share")
+
+#: metrics banded in ABSOLUTE units (plain difference, not
+#: percent-of-base): signed shares that hover at ~0, where a relative
+#: band explodes — numerics_overhead_share measures a few hundredths
+#: either side of zero on a quiet machine, so a noise flip from -0.04
+#: to +0.01 is a >100% "relative" move and a base of exactly 0.0 hits
+#: the new-baseline branch. The absolute floor is 0.02: two
+#: percentage points, the PERFORMANCE.md rule 12 <2% bar itself.
+_ABSOLUTE_BAND_MARKERS = ("overhead_share",)
+ABSOLUTE_BAND_FLOOR = 0.02
+
+
+def absolute_band(metric: str) -> bool:
+    """True when ``metric`` is banded/classified in absolute units."""
+    return any(m in metric for m in _ABSOLUTE_BAND_MARKERS)
+
 
 #: ``parsed`` summary keys that are metric metadata, never metrics
 _NON_METRIC_KEYS = frozenset({
@@ -215,8 +235,12 @@ def noise_band(metric: str, history: List[Artifact],
     alone."""
     values = [a.value(metric) for a in history
               if a.value(metric) is not None and not a.scaled(metric)]
-    deltas = [abs(cur - prev) / abs(prev)
-              for prev, cur in zip(values, values[1:]) if prev]
+    if absolute_band(metric):
+        deltas = [abs(cur - prev) for prev, cur in zip(values, values[1:])]
+        floor = ABSOLUTE_BAND_FLOOR
+    else:
+        deltas = [abs(cur - prev) / abs(prev)
+                  for prev, cur in zip(values, values[1:]) if prev]
     if not deltas:
         return floor, len(values)
     return max(floor, BAND_MARGIN * statistics.median(deltas)), len(values)
@@ -224,11 +248,17 @@ def noise_band(metric: str, history: List[Artifact],
 
 def classify(metric: str, base: float, current: float,
              band: float) -> Tuple[str, float]:
-    """``(classification, signed relative delta)`` where positive delta
-    always means "better" (direction-normalized)."""
-    if base == 0:
-        return ("in-band" if current == base else "new-baseline"), 0.0
-    delta = (current - base) / abs(base)
+    """``(classification, signed delta)`` where positive delta always
+    means "better" (direction-normalized). The delta is relative
+    (fraction of base) except for :func:`absolute_band` metrics, whose
+    delta — and band — are plain differences (a zero base is a
+    meaningful value for those, not a new baseline)."""
+    if absolute_band(metric):
+        delta = current - base
+    else:
+        if base == 0:
+            return ("in-band" if current == base else "new-baseline"), 0.0
+        delta = (current - base) / abs(base)
     if lower_is_better(metric):
         delta = -delta
     if delta > band:

@@ -388,10 +388,22 @@ class _JitSite:
             stats = executable_stats(compiled)
         except Exception:
             return None
-        if stats is not None:
-            with self._site_lock:
-                self.stats[sig_key] = stats
-        return stats
+        if stats is None:
+            return None
+        return self._adopt_stats(sig_key, stats)
+
+    def _adopt_stats(self, sig_key: Any,
+                     stats: Dict[str, float]) -> Dict[str, float]:
+        """Atomic publish of one signature's captured stats: the
+        check-then-store is ONE ``setdefault`` under ONE lock hold, so
+        two captures racing the same signature converge on the FIRST
+        writer's dict — the loser adopts it and every caller holds the
+        same object. (The pre-PR-10 blind ``stats[sig_key] = stats``
+        overwrite was a lost update: value-equal, but two callers could
+        hold two distinct dicts — allowlisted then, fixed now; the AOT
+        compile itself stays outside the lock, it can take seconds.)"""
+        with self._site_lock:
+            return self.stats.setdefault(sig_key, stats)
 
     def snapshot(self) -> Dict[str, Any]:
         with self._site_lock:

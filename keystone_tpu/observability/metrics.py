@@ -255,6 +255,11 @@ def _prometheus_name(name: str) -> str:
 
 def _prometheus_value(value: float) -> str:
     v = float(value)
+    if v != v or v in (float("inf"), float("-inf")):
+        # Prometheus exposition accepts NaN/+Inf/-Inf literals; a
+        # non-finite gauge (numerics observes the pathological cases
+        # by design) must not crash the scrape surface
+        return "NaN" if v != v else ("+Inf" if v > 0 else "-Inf")
     return str(int(v)) if v == int(v) and abs(v) < 1e15 else repr(v)
 
 

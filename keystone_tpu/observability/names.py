@@ -48,6 +48,21 @@ METRIC_NAMES: FrozenSet[str] = frozenset({
     "compile.count",
     "compile.wall_s",
     "compile.unexpected_total",
+    # observability/numerics.py — the data/math-health plane (PR 10).
+    # The event-counter family (`numerics.<event>`: nonfinite,
+    # breakdown, drift_warn, ...) rides the `numerics.` prefix below;
+    # these are the non-event scalars dashboards address directly.
+    "numerics.health_words",     # counter: chunk/node health words pulled
+    "numerics.nan_total",        # counter: non-finite values detected
+    "numerics.inf_total",
+    "numerics.solves_total",     # counter: instrumented solver solves
+    "numerics.breakdown_total",  # counter: Cholesky breakdowns (== eigh
+                                 # fallback recoveries taken)
+    "numerics.pivot_ratio",      # histogram: scale-free min L_ii/sqrt(G_ii)
+    "numerics.residual_rel",     # histogram: per-solve relative residual
+    "numerics.drift_score",      # gauge: latest apply-vs-fit PSI max
+    "numerics.health_age_s",     # gauge (sampler probe): seconds since
+                                 # the last health word was pulled
 })
 
 #: catalogued name FAMILIES: a dynamic metric name must start with one
@@ -56,6 +71,8 @@ METRIC_NAMES: FrozenSet[str] = frozenset({
 METRIC_PREFIXES: Tuple[str, ...] = (
     "resilience.",   # resilience/events.py: one counter per event kind
     "lock.wait_s.",  # utils/guarded.py: one histogram per traced lock
+    "numerics.",     # observability/numerics.py: one counter per
+                     # numerics event kind (record_numerics_event)
 )
 
 

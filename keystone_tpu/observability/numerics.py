@@ -1,0 +1,988 @@
+"""Numerics & data-health observatory: the third observability plane.
+
+The first two planes watch the MACHINE — wall time, HBM, compiles,
+lock contention (PR 1/8/9). Nothing watched the NUMBERS: an f32
+Cholesky breakdown recovers silently inside ``ops/linalg.py``, a NaN
+born in chunk 3 of a streamed fit only surfaces as garbage weights at
+finalize, and the continual-refit / serving roadmap items both need to
+know when apply-time inputs stop looking like fit-time inputs. This
+module is that plane, reusing every funnel the first two built
+(metrics registry, flight recorder, PipelineTrace, post-mortems):
+
+* **on-device health reductions** — :func:`health_word` computes, per
+  array leaf, one fused reduction word (finite/nan/inf counts,
+  min/max/abs-max, sum and sum-of-squares — mean/var via the same raw
+  moments the scaler machinery accumulates) inside one jitted program.
+  ``fit_streaming`` piggybacks it on the accumulate pass
+  (:class:`HealthMonitor`): the word is ONE extra small D2H per chunk,
+  and the pull is DEFERRED ``defer`` chunks (``KEYSTONE_NUMERICS_DEFER``,
+  default 8) so checking never inserts a sync bubble into the
+  ingest/compute overlap. The traced executor checks node outputs the
+  same way (:func:`check_node_output`).
+* **tripwires** — a non-finite health word raises :class:`NumericsError`
+  through ``attach_postmortem``, naming the node/chunk and embedding
+  the recent health series in the post-mortem artifact. Opt-out:
+  ``KEYSTONE_NUMERICS=0`` (process start) or the runtime
+  :func:`numerics_suppressed` context (bench A/B pairs).
+* **solver conditioning ledger** — ``ops/linalg.py``'s breakdown
+  predicate and ``L_ii/sqrt(G_ii)`` pivot ratio (already computed for
+  the eigh fallback) plus per-solve relative residual norms are
+  reported from inside the jitted solvers via
+  :func:`record_solve_health` / :func:`record_block_health`
+  (``jax.debug.callback`` — zero traced ops when numerics is disabled
+  at trace time). Every Cholesky breakdown — which is exactly when the
+  clamped-eigh recovery branch runs — lands as a ``numerics.breakdown``
+  event in metrics/trace/flight-recorder instead of vanishing inside
+  a ``lax.cond``.
+* **distribution-drift detection** — a mergeable fixed-bin feature
+  sketch (:class:`SketchTracker`) accumulates during the streamed fit,
+  rides the ``StreamCheckpoint`` snapshot (kill-and-resume keeps it
+  bit-identical) and the fitted model (``model.numerics_baseline``, a
+  :class:`DriftBaseline`, pickles with saved pipelines), and apply-time
+  inputs score against it with PSI (:func:`score_drift`) into the
+  ``numerics.drift_score`` gauge with a warn threshold
+  (``KEYSTONE_DRIFT_THRESHOLD``, default 0.2) — the primitive the
+  continual-refit drift scenario and serving health checks both need.
+
+Event funnel: :func:`record_numerics_event` mirrors
+``resilience/events.py`` — one ``numerics.<event>`` counter per kind,
+an instant on the flight-recorder timeline, and a structured
+``PipelineTrace.record_numerics`` entry. The ``silent-nan-silencer``
+lint (``analysis/diagnostics.py``) enforces that NaN-suppressing code
+(``nan_to_num``, ``np.errstate`` ignores) in scoped trees pairs with a
+recorded ``numerics.*`` event, so suppression is always accounted.
+
+Trace-time vs run-time gating: the solver callbacks and residual
+reductions are baked into jitted programs at TRACE time — flip
+``KEYSTONE_NUMERICS=0`` at process start to remove them entirely.
+:func:`numerics_suppressed` gates the RUN-time work (per-chunk health
+words, sketch updates, callback bodies) without recompiling, which is
+what the bench A/B overhead pair measures.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .metrics import MetricsRegistry
+from .timeline import record_instant
+from .trace import current_trace
+
+#: health-word column layout (per array leaf)
+_W_FINITE, _W_NAN, _W_INF, _W_MIN, _W_MAX, _W_ABSMAX, _W_SUM, _W_SUMSQ = \
+    range(8)
+
+#: drift-sketch geometry: per-feature fixed-bin histograms over at most
+#: MAX_COLS evenly spaced feature columns. 16 bins x 64 columns keeps
+#: the sketch (and its checkpoint payload) at 4 KiB while PSI over it
+#: separates a 1-sigma mean shift from replay noise by >10x (pinned in
+#: tests/test_numerics.py).
+SKETCH_BINS = 16
+SKETCH_MAX_COLS = 64
+
+#: PSI smoothing pseudo-count per bin (avoids log(0) on empty bins
+#: without drowning small samples)
+_PSI_ALPHA = 0.5
+
+
+class NumericsError(RuntimeError):
+    """A numerics tripwire fired: non-finite values were detected in a
+    streamed chunk, a traced node output, or fitted model weights. The
+    message names the chunk/node; ``exc.postmortem_path`` carries the
+    dumped artifact with the recent health series
+    (``python -m keystone_tpu numerics <artifact>`` renders it)."""
+
+
+# -- gating -------------------------------------------------------------------
+
+_SUPPRESS_DEPTH = 0
+
+
+def numerics_enabled() -> bool:
+    """The process-level switch (``KEYSTONE_NUMERICS=0`` disables).
+    Read at TRACE time by the solver instrumentation — flip it before
+    any jit traces to remove the callbacks/residual ops entirely."""
+    return os.environ.get("KEYSTONE_NUMERICS", "1") != "0"
+
+
+def numerics_active() -> bool:
+    """True when runtime numerics work should happen: enabled AND not
+    inside a :func:`numerics_suppressed` block."""
+    return _SUPPRESS_DEPTH == 0 and numerics_enabled()
+
+
+@contextlib.contextmanager
+def numerics_suppressed() -> Iterator[None]:
+    """Suspend runtime numerics work (health words, sketch updates,
+    drift scoring, callback bodies) for the enclosed block WITHOUT
+    recompiling anything — the bench A/B overhead pair runs its OFF leg
+    under this."""
+    global _SUPPRESS_DEPTH
+    _SUPPRESS_DEPTH += 1
+    try:
+        yield
+    finally:
+        _SUPPRESS_DEPTH -= 1
+
+
+def drift_threshold() -> float:
+    """PSI warn threshold (``KEYSTONE_DRIFT_THRESHOLD``, default 0.2 —
+    the classical 'significant population shift' PSI boundary)."""
+    raw = os.environ.get("KEYSTONE_DRIFT_THRESHOLD")
+    if not raw:
+        return 0.2
+    try:
+        value = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"KEYSTONE_DRIFT_THRESHOLD must be a float, got {raw!r}"
+        ) from None
+    if value <= 0:
+        raise ValueError("KEYSTONE_DRIFT_THRESHOLD must be > 0")
+    return value
+
+
+def _defer_depth() -> int:
+    raw = os.environ.get("KEYSTONE_NUMERICS_DEFER")
+    if not raw:
+        return 8
+    try:
+        depth = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"KEYSTONE_NUMERICS_DEFER must be an integer, got {raw!r}"
+        ) from None
+    if depth < 1:
+        raise ValueError("KEYSTONE_NUMERICS_DEFER must be >= 1")
+    return depth
+
+
+# -- the event funnel ---------------------------------------------------------
+
+def record_numerics_event(event: str, **fields: Any) -> None:
+    """One numerics event into all three funnels: the
+    ``numerics.<event>`` counter, an instant on the flight-recorder
+    timeline, and the active trace's numerics stream (mirrors
+    ``resilience.events.record_event`` — sites never talk to the sinks
+    directly, so the event vocabulary stays in one place:
+    ``nonfinite`` / ``nonfinite_model`` / ``breakdown`` /
+    ``drift_score`` / ``drift_warn`` / ``fit_baseline``)."""
+    MetricsRegistry.get_or_create().counter(f"numerics.{event}").inc()
+    record_instant(event, "numerics", args=fields or None)
+    trace = current_trace()
+    if trace is not None:
+        trace.record_numerics({"event": event, **fields})
+
+
+# -- lazily built device programs --------------------------------------------
+#
+# The jits are built on FIRST use (not import): this module must stay
+# importable without jax (tools/lint.py loads the observability package
+# for the metric-name catalogue), and every program is module-global so
+# refits and repeated epochs reuse one compiled executable per shape
+# family — a per-call jit would recompile per fit, exactly the
+# per-instance-memo bug class the compile observatory exists to catch.
+
+_PROGRAMS: Dict[str, Any] = {}
+_PROGRAM_LOCK = threading.Lock()
+
+
+def _program(name: str, build) -> Any:
+    fn = _PROGRAMS.get(name)
+    if fn is None:
+        with _PROGRAM_LOCK:
+            fn = _PROGRAMS.get(name)
+            if fn is None:
+                fn = _PROGRAMS[name] = build()
+    return fn
+
+
+def _health_program(masked: bool = False):
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from .compilelog import watch_jit
+
+        def leaf_word(x, live_rows=None):
+            # minimal-pass formulation (this runs once per chunk on the
+            # hot path): two predicate temps (isnan/isfinite), inf count
+            # DERIVED (size - finite - nan), absmax derived from the
+            # finite min/max instead of a max(|x|) pass — measured ~40%
+            # cheaper than the naive 8-reduction spelling on CPU.
+            # Counts accumulate in int32, NOT f32: summing >2^24 ones in
+            # f32 is inexact, and a rounded finite count would make the
+            # derived inf count nonzero on clean data — a spurious
+            # tripwire on any leaf past 16.7M elements. int32 is exact
+            # to 2^31 elements (an 8 GiB f32 leaf — past any chunk);
+            # the f32 cast at stack time keeps zero exactly zero and
+            # nonzero >= 1, which is all the tripwire predicate reads.
+            x32 = jnp.asarray(x).astype(jnp.float32)
+            nan = jnp.isnan(x32)
+            finite = jnp.isfinite(x32)
+            if live_rows is not None and x32.ndim >= 1 \
+                    and x32.shape[0] == live_rows.shape[0]:
+                # masked (padded-chunk) form: pad rows are excluded
+                # from EVERY statistic — a zero-padded ragged tail
+                # must not report min=0.0 / a diluted mean and point a
+                # post-mortem diagnosis the wrong way (the tripwire
+                # counts never cared: padding is finite zero). A leaf
+                # whose leading dim is not the row axis (shape decided
+                # at trace time) keeps the unmasked reduction.
+                live = (live_rows > 0).reshape(
+                    (-1,) + (1,) * (x32.ndim - 1))
+                nan = nan & live
+                finite = finite & live
+                per_row = x32.size // x32.shape[0] if x32.shape[0] else 0
+                n_total = jnp.sum(live_rows > 0,
+                                  dtype=jnp.int32) * per_row
+            else:
+                n_total = jnp.int32(x32.size)
+            n_nan = jnp.sum(nan, dtype=jnp.int32)
+            n_fin = jnp.sum(finite, dtype=jnp.int32)
+            z = jnp.where(finite, x32, 0.0)
+            lo = jnp.min(jnp.where(finite, x32, jnp.inf))
+            hi = jnp.max(jnp.where(finite, x32, -jnp.inf))
+            return jnp.stack([
+                n_fin.astype(jnp.float32),
+                n_nan.astype(jnp.float32),
+                (n_total - n_fin - n_nan).astype(jnp.float32),
+                lo,
+                hi,
+                jnp.where(n_fin > 0,
+                          jnp.maximum(jnp.abs(lo), jnp.abs(hi)), 0.0),
+                jnp.sum(z),
+                jnp.sum(z * z),
+            ])
+
+        if masked:
+            def word(tree, mask):
+                leaves = jax.tree_util.tree_leaves(tree)
+                return jnp.stack([leaf_word(x, mask) for x in leaves])
+        else:
+            def word(tree):
+                leaves = jax.tree_util.tree_leaves(tree)
+                return jnp.stack([leaf_word(x) for x in leaves])
+
+        return watch_jit(jax.jit(word),
+                         name="numerics_health_masked" if masked
+                         else "numerics_health")
+
+    return _program("health_masked" if masked else "health", build)
+
+
+def _ranges_program():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from .compilelog import watch_jit
+
+        def ranges(X, cols, mask):
+            Xs = X[:, cols].astype(jnp.float32)
+            live = (mask > 0)[:, None]
+            lo = jnp.min(jnp.where(live, Xs, jnp.inf), axis=0)
+            hi = jnp.max(jnp.where(live, Xs, -jnp.inf), axis=0)
+            return lo, hi
+
+        return watch_jit(jax.jit(ranges), name="numerics_ranges")
+
+    return _program("ranges", build)
+
+
+def _sketch_program():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        from .compilelog import watch_jit
+
+        def update(counts, start, step, cols, X, mask):
+            # counts: (F, B) replicated carry; start/step: (F,) uniform
+            # bin geometry (derived ONCE from the interior edges — see
+            # _bin_geometry — so fit- and apply-time histograms share
+            # bit-identical bins); X: (n, d) row-sharded chunk; mask:
+            # (n,). Bins are uniform by construction, so the bin index
+            # is O(n*F) arithmetic — no (n, F, B-1) edge-comparison
+            # pass. Out-of-range values clamp into the end bins, which
+            # is what makes a hard shift pile mass at the edges (big
+            # PSI); NaNs land in bin 0 (the tripwire owns them).
+            F, B = counts.shape
+            Xs = X[:, cols].astype(jnp.float32)
+            idx = jnp.floor((Xs - start[None, :]) / step[None, :])
+            idx = jnp.where(jnp.isnan(idx), 0.0, idx)
+            idx = jnp.clip(idx, 0, B - 1).astype(jnp.int32)
+            # dense one-hot + reduce beats a scatter-add here: XLA CPU
+            # serializes scatters (~40% slower measured), and on TPU
+            # the dense reduce is the native layout anyway
+            oh = jax.nn.one_hot(idx, B, dtype=jnp.float32) \
+                * mask.astype(jnp.float32)[:, None, None]
+            return counts + oh.sum(0)
+
+        return watch_jit(jax.jit(update), name="numerics_sketch")
+
+    return _program("sketch", build)
+
+
+# -- health words -------------------------------------------------------------
+
+def health_word(tree, mask=None) -> Any:
+    """Device health word of an array pytree: one ``(leaves, 8)`` f32
+    array — [finite, nan, inf, min, max, absmax, sum, sumsq] per leaf,
+    computed in ONE fused jitted reduction (module-global program; all
+    chunks of a fixed-shape stream share one executable). With ``mask``
+    (the ArrayDataset row mask), zero-pad rows are excluded from every
+    statistic — leaves whose leading dim doesn't match the mask keep
+    the unmasked reduction."""
+    if mask is None:
+        return _health_program()(tree)
+    return _health_program(masked=True)(tree, mask)
+
+
+def word_stats(word: np.ndarray) -> Dict[str, float]:
+    """Host summary of one (pulled) health word: aggregate counts and
+    bounds across leaves, mean/var from the raw moments."""
+    w = np.asarray(word, dtype=np.float64).reshape(-1, 8)
+    finite = float(w[:, _W_FINITE].sum())
+    nan = float(w[:, _W_NAN].sum())
+    inf = float(w[:, _W_INF].sum())
+    mean = float(w[:, _W_SUM].sum() / finite) if finite else 0.0
+    var = (max(float(w[:, _W_SUMSQ].sum() / finite) - mean * mean, 0.0)
+           if finite else 0.0)
+    return {
+        "finite": finite, "nan": nan, "inf": inf,
+        "min": float(w[:, _W_MIN].min()) if finite else 0.0,
+        "max": float(w[:, _W_MAX].max()) if finite else 0.0,
+        "absmax": float(w[:, _W_ABSMAX].max()),
+        "mean": mean, "var": var,
+    }
+
+
+#: recent pulled health entries (bounded; what post-mortems embed and
+#: ``recent_health`` serves). Plain lock: entries are appended from the
+#: driver thread and read by the post-mortem dumper on whatever thread
+#: crashed.
+_SERIES_CAP = 256
+_HEALTH_SERIES: deque = deque(maxlen=_SERIES_CAP)
+_SERIES_LOCK = threading.Lock()
+_LAST_HEALTH_TS: List[float] = [0.0]
+
+
+def _push_series(entry: Dict[str, Any]) -> None:
+    with _SERIES_LOCK:
+        _HEALTH_SERIES.append(entry)
+        _LAST_HEALTH_TS[0] = time.time()
+
+
+def recent_health(n: int = 64) -> List[Dict[str, Any]]:
+    """The most recent ``n`` pulled health entries (newest last)."""
+    with _SERIES_LOCK:
+        items = list(_HEALTH_SERIES)
+    return items[-n:]
+
+
+def last_health_age_s() -> float:
+    """Seconds since the last health word was pulled, or -1.0 when the
+    health plane has not run yet — a liveness gauge the telemetry
+    sampler publishes (``numerics.health_age_s``)."""
+    with _SERIES_LOCK:
+        ts = _LAST_HEALTH_TS[0]
+    return time.time() - ts if ts else -1.0
+
+
+def reset_health_series() -> None:
+    """Drop the module health series (tests)."""
+    with _SERIES_LOCK:
+        _HEALTH_SERIES.clear()
+        _LAST_HEALTH_TS[0] = 0.0
+
+
+def _tripwire(entry: Dict[str, Any], what: str,
+              context: Dict[str, Any]) -> NumericsError:
+    """Build the raise-ready tripwire error: counters, event, and a
+    post-mortem embedding the recent health series."""
+    from .postmortem import attach_postmortem
+
+    reg = MetricsRegistry.get_or_create()
+    reg.counter("numerics.nan_total").inc(entry["nan"])
+    reg.counter("numerics.inf_total").inc(entry["inf"])
+    record_numerics_event("nonfinite", **context,
+                          nan=entry["nan"], inf=entry["inf"])
+    exc = NumericsError(
+        f"non-finite values detected in {what}: nan={int(entry['nan'])} "
+        f"inf={int(entry['inf'])} (finite min={entry['min']:.4g} "
+        f"max={entry['max']:.4g}) — fix the producing stage or data; "
+        "the post-mortem carries the recent health series "
+        "(KEYSTONE_NUMERICS=0 disables the tripwire)")
+    return attach_postmortem(
+        exc, "numerics_tripwire",
+        {**context, "nan": entry["nan"], "inf": entry["inf"],
+         "recent_health": recent_health()})
+
+
+class HealthMonitor:
+    """Per-fit chunk-health bookkeeping for ``fit_streaming``: one
+    device health word per chunk, pulled to host ``defer`` chunks late
+    so the D2H never stalls the ingest/compute overlap (by the time a
+    word is pulled its chunk's compute has long retired). Driver-thread
+    only — the chunk loop is single-threaded."""
+
+    def __init__(self, source: str, defer: Optional[int] = None):
+        self.source = source
+        self.defer = _defer_depth() if defer is None else int(defer)
+        if self.defer < 1:
+            raise ValueError("defer must be >= 1")
+        self._pending: deque = deque()  # (chunk idx, device word)
+        self.checked = 0
+
+    def observe(self, chunk_idx: int, *trees: Any,
+                mask: Any = None) -> None:
+        """Queue one chunk's health word (device dispatch only); drains
+        words older than the defer window. ``mask`` is the chunk's row
+        mask: pad rows must not distort the series' min/mean/var."""
+        data = tuple(t for t in trees if t is not None)
+        if not data:
+            return
+        self._pending.append((chunk_idx, health_word(data, mask)))
+        while len(self._pending) > self.defer:
+            self._drain_one()
+
+    def _drain_one(self) -> None:
+        idx, word = self._pending.popleft()
+        entry = {"source": self.source, "chunk": idx,
+                 **word_stats(np.asarray(word))}
+        _push_series(entry)
+        self.checked += 1
+        MetricsRegistry.get_or_create().counter(
+            "numerics.health_words").inc()
+        if entry["nan"] or entry["inf"]:
+            raise _tripwire(
+                entry, f"chunk {idx} of stream {self.source!r}",
+                {"source": self.source, "chunk": idx})
+
+    def flush(self) -> None:
+        """Pull and check every pending word (end of the chunk loop,
+        and before each checkpoint save — a snapshot must never capture
+        a carry poisoned by a chunk whose word was still in flight)."""
+        while self._pending:
+            self._drain_one()
+
+
+def _float_leaves(value: Any) -> List[Any]:
+    """Array leaves worth health-checking in an arbitrary value:
+    the data tree of an ArrayDataset, a bare array, or the public
+    array attributes of a fitted transformer."""
+    import jax
+
+    tree = value
+    if hasattr(value, "data") and hasattr(value, "mask") \
+            and hasattr(value, "n"):
+        tree = value.data  # ArrayDataset shape without importing it
+    elif not hasattr(value, "dtype") and hasattr(value, "__dict__"):
+        tree = {k: v for k, v in vars(value).items()
+                if not k.startswith("_")}
+    out = []
+    for leaf in jax.tree_util.tree_leaves(tree):
+        dt = getattr(leaf, "dtype", None)
+        if dt is not None and np.issubdtype(np.dtype(dt), np.floating):
+            out.append(leaf)
+    return out
+
+
+def check_node_output(value: Any, node: str) -> Optional[Dict[str, Any]]:
+    """Traced-executor hook: health-check one node's output (called
+    after the executor has already blocked on the device result, so the
+    small pull costs no extra sync). Raises :class:`NumericsError`
+    through a post-mortem when non-finite; returns the health entry
+    (None when numerics is off or the value holds no float arrays)."""
+    if not numerics_active():
+        return None
+    try:
+        # an ArrayDataset-shaped value carries a row mask: its zero-pad
+        # rows must not distort the entry's min/mean/var
+        mask = (value.mask if hasattr(value, "data")
+                and hasattr(value, "mask") and hasattr(value, "n")
+                else None)
+        leaves = _float_leaves(value)
+        if not leaves:
+            return None
+        word = np.asarray(health_word(tuple(leaves), mask))
+    except NumericsError:
+        raise
+    except Exception:
+        return None  # exotic values must never break execution
+    entry = {"source": f"node:{node}", **word_stats(word)}
+    _push_series(entry)
+    MetricsRegistry.get_or_create().counter("numerics.health_words").inc()
+    if entry["nan"] or entry["inf"]:
+        raise _tripwire(entry, f"the output of pipeline node {node}",
+                        {"node": node})
+    return entry
+
+
+def check_fitted(model: Any, source: str) -> None:
+    """Tripwire over a freshly fitted model's float arrays (the
+    'garbage weights at finalize' failure, caught AT finalize): a
+    non-finite fitted array raises :class:`NumericsError` with a
+    post-mortem — the eigh/clamp recovery paths guarantee finite
+    weights, so this firing means a recovery path was bypassed."""
+    if not numerics_active():
+        return
+    try:
+        leaves = _float_leaves(model)
+        if not leaves:
+            return
+        word = np.asarray(health_word(tuple(leaves)))
+    except NumericsError:
+        raise
+    except Exception:
+        return
+    entry = {"source": f"fitted:{source}", **word_stats(word)}
+    _push_series(entry)
+    if entry["nan"] or entry["inf"]:
+        record_numerics_event("nonfinite_model", source=source,
+                              nan=entry["nan"], inf=entry["inf"])
+        raise _tripwire(
+            entry, f"the fitted model from {source!r}",
+            {"source": source, "phase": "finalize"})
+
+
+# -- solver conditioning ledger ----------------------------------------------
+
+def record_solve_health(site: str, ok, pivot_ratio, resid_rel=None) -> None:
+    """Call from INSIDE a jitted solver: reports one solve's breakdown
+    predicate, scale-free min pivot ratio, and (optionally) relative
+    residual into the ledger via ``jax.debug.callback``. Zero traced
+    ops when numerics is disabled at trace time; the callback body
+    re-checks :func:`numerics_active` so :func:`numerics_suppressed`
+    silences it at runtime without recompiling."""
+    if not numerics_enabled():
+        return
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    resid = jnp.float32(-1.0) if resid_rel is None else resid_rel
+    jax.debug.callback(functools.partial(_solve_cb, str(site)),
+                       ok, pivot_ratio, resid)
+
+
+def _solve_cb(site: str, ok, ratio, resid) -> None:
+    if not numerics_active():
+        return
+    reg = MetricsRegistry.get_or_create()
+    reg.counter("numerics.solves_total").inc()
+    ratio = float(np.asarray(ratio))
+    if np.isfinite(ratio):
+        # a NaN factor yields a NaN ratio — the breakdown event
+        # carries it verbatim, but a histogram mean/percentile must
+        # not be poisoned by it
+        reg.histogram("numerics.pivot_ratio").observe(ratio)
+    resid = float(np.asarray(resid))
+    if resid >= 0.0 and np.isfinite(resid):
+        reg.histogram("numerics.residual_rel").observe(resid)
+    if not bool(np.asarray(ok)):
+        # ok=False is exactly the predicate that routes the solve into
+        # the clamped-eigh recovery branch, so one breakdown event ==
+        # one fallback taken — the silent recovery, made visible. A
+        # NaN ratio (NaN factor) becomes None in the event args: the
+        # events land in JSON artifacts (trace/Perfetto/post-mortem),
+        # and a bare NaN token is invalid strict JSON — one NaN-factor
+        # breakdown must not corrupt the whole trace export
+        record_numerics_event(
+            "breakdown", site=site,
+            pivot_ratio=ratio if np.isfinite(ratio) else None,
+            **({"residual_rel": resid}
+               if resid >= 0.0 and np.isfinite(resid) else {}))
+        reg.counter("numerics.breakdown_total").inc()
+
+
+def record_block_health(site: str, oks, ratios) -> None:
+    """Blocked-solver form (BCD): one callback with the per-block
+    breakdown predicates and pivot ratios (stacked arrays)."""
+    if not numerics_enabled():
+        return
+    import functools
+
+    import jax
+
+    jax.debug.callback(functools.partial(_blocks_cb, str(site)),
+                       oks, ratios)
+
+
+def _blocks_cb(site: str, oks, ratios) -> None:
+    if not numerics_active():
+        return
+    oks = np.atleast_1d(np.asarray(oks))
+    ratios = np.atleast_1d(np.asarray(ratios))
+    reg = MetricsRegistry.get_or_create()
+    reg.counter("numerics.solves_total").inc(len(oks))
+    hist = reg.histogram("numerics.pivot_ratio")
+    for r in ratios:
+        if np.isfinite(r):  # same NaN-factor guard as _solve_cb
+            hist.observe(float(r))
+    for i, ok in enumerate(oks):
+        if not bool(ok):
+            reg.counter("numerics.breakdown_total").inc()
+            r = float(ratios[i])  # same NaN-in-JSON guard as _solve_cb
+            record_numerics_event("breakdown", site=site, block=i,
+                                  pivot_ratio=r if np.isfinite(r)
+                                  else None)
+
+
+# -- distribution-drift sketch -----------------------------------------------
+
+def _select_cols(d: int, max_cols: int) -> np.ndarray:
+    f = min(d, max_cols)
+    return (np.arange(f, dtype=np.int64) * d // f).astype(np.int32)
+
+
+def _bin_geometry(interior: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """``(start, step)`` of the uniform bin grid behind ``interior``
+    (the (F, B-1) interior edges are built uniformly — see
+    ``SketchTracker._init_edges``). Derived from the STORED edges, the
+    same way at fit and apply time, so both histogram passes bin
+    bit-identically; needs >= 2 interior edges (bins >= 3)."""
+    interior = np.asarray(interior, np.float32)
+    if interior.shape[1] < 2:
+        raise ValueError("sketch needs >= 3 bins (>= 2 interior edges)")
+    step = interior[:, 1] - interior[:, 0]
+    start = interior[:, 0] - step
+    return start.astype(np.float32), step.astype(np.float32)
+
+
+@dataclass
+class DriftBaseline:
+    """The frozen fit-time feature sketch: per-column fixed-bin counts
+    over ``cols`` (evenly spaced feature indices) with shared
+    ``interior`` bin boundaries. Plain numpy throughout, so it pickles
+    inside checkpoints and saved-pipeline artifacts unchanged."""
+
+    cols: np.ndarray       # (F,) int32 feature indices
+    interior: np.ndarray   # (F, B-1) f32 interior bin boundaries
+    counts: np.ndarray     # (F, B) f32 per-bin row counts
+    rows: float            # true (mask-weighted) row count
+    source: str = "fit"
+
+    def state(self) -> Dict[str, Any]:
+        return {"cols": self.cols, "interior": self.interior,
+                "counts": self.counts, "rows": self.rows,
+                "source": self.source}
+
+    @classmethod
+    def from_state(cls, state: Dict[str, Any]) -> "DriftBaseline":
+        return cls(cols=np.asarray(state["cols"], np.int32),
+                   interior=np.asarray(state["interior"], np.float32),
+                   counts=np.asarray(state["counts"], np.float32),
+                   rows=float(state["rows"]),
+                   source=str(state.get("source", "fit")))
+
+    def merge(self, other: "DriftBaseline") -> "DriftBaseline":
+        """Fixed bins make the sketch mergeable: per-host / per-shard
+        sketches with identical geometry sum (the tree-reduce shape
+        multi-host ingest needs)."""
+        if (not np.array_equal(self.cols, other.cols)
+                or not np.array_equal(self.interior, other.interior)):
+            raise ValueError(
+                "cannot merge drift sketches with different geometry "
+                "(columns/bin edges must match — build both from one "
+                "baseline's edges)")
+        return DriftBaseline(
+            cols=self.cols, interior=self.interior,
+            counts=self.counts + other.counts,
+            rows=self.rows + other.rows, source=self.source)
+
+    def psi(self, counts: np.ndarray) -> np.ndarray:
+        """Per-column Population Stability Index of ``counts`` (same
+        geometry) against this baseline, with ``_PSI_ALPHA`` smoothing.
+        Both histograms normalize to their own mass — absolute row
+        counts never enter the statistic."""
+        b = self.counts.astype(np.float64) + _PSI_ALPHA
+        q = np.asarray(counts, np.float64) + _PSI_ALPHA
+        b /= b.sum(axis=1, keepdims=True)
+        q /= q.sum(axis=1, keepdims=True)
+        return np.sum((q - b) * np.log(q / b), axis=1)
+
+
+class SketchTracker:
+    """Accumulates the fit-time feature sketch chunk by chunk. Bin
+    edges are pinned from chunk 1's observed per-column ranges (padded
+    5% each side; later out-of-range values clamp into the end bins),
+    so every later chunk's update is ONE fixed-shape jitted program —
+    zero compiles after warmup, per the fit fence. Eligible data is a
+    single 2-D float leaf (the least-squares chunk shape); anything
+    else disables the tracker for the fit (baseline None, never an
+    error)."""
+
+    def __init__(self, bins: int = SKETCH_BINS,
+                 max_cols: int = SKETCH_MAX_COLS, source: str = "fit"):
+        if bins < 3:
+            raise ValueError("bins must be >= 3 (the uniform-grid "
+                             "geometry is derived from 2+ interior edges)")
+        self.bins = int(bins)
+        self.max_cols = int(max_cols)
+        self.source = source
+        self.cols: Optional[np.ndarray] = None
+        self.interior: Optional[np.ndarray] = None
+        self._cols_dev = None
+        self._start_dev = None
+        self._step_dev = None
+        self._counts = None  # device (F, B), replicated on the mesh
+        self.rows = 0.0
+        self.disabled = False
+
+    def _eligible_leaf(self, chunk) -> Optional[Any]:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(chunk.data)
+        if len(leaves) != 1:
+            return None
+        x = leaves[0]
+        if getattr(x, "ndim", 0) != 2:
+            return None
+        if not np.issubdtype(np.dtype(x.dtype), np.floating):
+            return None
+        return x
+
+    def _init_edges(self, X, mask, mesh) -> None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import replicated_zeros
+
+        d = int(X.shape[1])
+        self.cols = _select_cols(d, self.max_cols)
+        lo, hi = self._ranges(X, mask)
+        span = np.where(np.isfinite(hi - lo), hi - lo, 1.0)
+        lo = np.where(np.isfinite(lo), lo, 0.0)
+        pad = 0.05 * span + 1e-6
+        start, width = lo - pad, span + 2 * pad
+        steps = np.arange(1, self.bins, dtype=np.float32) / self.bins
+        self.interior = (start[:, None]
+                         + width[:, None] * steps[None, :]).astype(
+                             np.float32)
+        # committed replicated constants + a replicated zero carry: the
+        # update program's input shardings are then stable from call 1
+        # (the gram-carry recompile lesson — a SingleDeviceSharded init
+        # would recompile the update at chunk 2 and trip the fit fence).
+        # start/step are DERIVED from the stored interior (not the
+        # locals above) so every consumer of a baseline bins identically
+        rep = NamedSharding(mesh, P())
+        g_start, g_step = _bin_geometry(self.interior)
+        self._cols_dev = jax.device_put(self.cols, rep)
+        self._start_dev = jax.device_put(g_start, rep)
+        self._step_dev = jax.device_put(g_step, rep)
+        (self._counts,) = replicated_zeros(
+            mesh, ((len(self.cols), self.bins),))
+
+    def _ranges(self, X, mask) -> Tuple[np.ndarray, np.ndarray]:
+        lo, hi = _ranges_program()(X, np.asarray(self.cols), mask)
+        return (np.asarray(lo, np.float64), np.asarray(hi, np.float64))
+
+    def update(self, chunk) -> None:
+        """Fold one chunk (an ArrayDataset with the zero-pad/mask
+        invariant) into the sketch; chunk 1 pins the bin edges (one
+        small host pull, before the fit fence arms)."""
+        if self.disabled:
+            return
+        X = self._eligible_leaf(chunk)
+        if X is None:
+            self.disabled = True
+            return
+        if self.cols is None:
+            self._init_edges(X, chunk.mask, chunk.mesh)
+        self._counts = _sketch_program()(
+            self._counts, self._start_dev, self._step_dev,
+            self._cols_dev, X, chunk.mask)
+        self.rows += float(chunk.n)
+
+    # -- checkpoint/resume ---------------------------------------------------
+    def state(self) -> Optional[Dict[str, Any]]:
+        """Host snapshot (rides the StreamCheckpoint payload); None
+        when the tracker never saw an eligible chunk."""
+        if self.disabled or self.cols is None:
+            return None
+        return {"cols": self.cols, "interior": self.interior,
+                "counts": np.asarray(self._counts), "rows": self.rows,
+                "bins": self.bins, "source": self.source}
+
+    def restore(self, state: Optional[Dict[str, Any]], mesh) -> None:
+        """Resume from a checkpointed snapshot: counts return to the
+        device REPLICATED (the steady-state sharding), so the first
+        resumed update hits the warm executable instead of recompiling."""
+        if not state:
+            return
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rep = NamedSharding(mesh, P())
+        self.bins = int(state["bins"])
+        self.cols = np.asarray(state["cols"], np.int32)
+        self.interior = np.asarray(state["interior"], np.float32)
+        g_start, g_step = _bin_geometry(self.interior)
+        self._cols_dev = jax.device_put(self.cols, rep)
+        self._start_dev = jax.device_put(g_start, rep)
+        self._step_dev = jax.device_put(g_step, rep)
+        self._counts = jax.device_put(
+            np.asarray(state["counts"], np.float32), rep)
+        self.rows = float(state["rows"])
+        self.source = str(state.get("source", self.source))
+
+    def baseline(self) -> Optional[DriftBaseline]:
+        if self.disabled or self.cols is None:
+            return None
+        return DriftBaseline(
+            cols=self.cols, interior=self.interior,
+            counts=np.asarray(self._counts, np.float32),
+            rows=self.rows, source=self.source)
+
+
+def _sketch_counts(baseline: DriftBaseline, data) -> Tuple[np.ndarray,
+                                                           float]:
+    """Histogram ``data`` with the BASELINE's geometry (the comparable
+    half of a PSI pair). ``data``: an ArrayDataset, a StreamingDataset
+    (consumed chunk-wise), or a host array."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.dataset import ArrayDataset
+    from ..parallel.streaming import StreamingDataset
+
+    if isinstance(data, np.ndarray):
+        data = ArrayDataset.from_numpy(np.asarray(data, np.float32))
+    chunks = (data.chunks() if isinstance(data, StreamingDataset)
+              else [data])
+    counts = None
+    rows = 0.0
+    start_dev = step_dev = cols_dev = None
+    for chunk in chunks:
+        leaves = jax.tree_util.tree_leaves(chunk.data)
+        if len(leaves) != 1 or getattr(leaves[0], "ndim", 0) != 2:
+            raise ValueError(
+                "drift scoring needs a single 2-D feature leaf (the "
+                "shape the baseline was built from)")
+        X = leaves[0]
+        if int(X.shape[1]) <= int(baseline.cols.max()):
+            # jax's gather CLAMPS out-of-bounds column indices instead
+            # of raising, so a narrower apply-time matrix would silently
+            # score every tail column against the last in-range one's
+            # histogram — a bogus PSI verdict with no error
+            raise ValueError(
+                f"drift scoring: data has {int(X.shape[1])} feature "
+                f"column(s) but the baseline sketches column "
+                f"{int(baseline.cols.max())} — the apply-time input is "
+                "not the feature space this baseline was built from")
+        if counts is None:
+            rep = NamedSharding(chunk.mesh, P())
+            from ..parallel.mesh import replicated_zeros
+
+            (counts,) = replicated_zeros(
+                chunk.mesh, (baseline.counts.shape,))
+            # same derivation as the fit-time tracker: bins are
+            # bit-identical on both sides of the PSI pair
+            g_start, g_step = _bin_geometry(baseline.interior)
+            start_dev = jax.device_put(g_start, rep)
+            step_dev = jax.device_put(g_step, rep)
+            cols_dev = jax.device_put(baseline.cols, rep)
+        counts = _sketch_program()(counts, start_dev, step_dev,
+                                   cols_dev, X, chunk.mask)
+        rows += float(chunk.n)
+    if counts is None:
+        raise ValueError("empty dataset: nothing to score")
+    return np.asarray(counts, np.float32), rows
+
+
+def score_drift(baseline: DriftBaseline, data,
+                threshold: Optional[float] = None) -> Dict[str, Any]:
+    """Score apply-time ``data`` against a fit-time baseline: PSI per
+    sketched column, the max published as the ``numerics.drift_score``
+    gauge, and a ``numerics.drift_warn`` event when it crosses the
+    threshold (``KEYSTONE_DRIFT_THRESHOLD``, default 0.2). Returns
+    ``{psi_max, psi_mean, warned, threshold, rows, per_col}``."""
+    if baseline is None:
+        raise ValueError(
+            "no drift baseline: the fit did not build a feature sketch "
+            "(non-2-D data, or numerics disabled during the fit)")
+    threshold = drift_threshold() if threshold is None else float(threshold)
+    counts, rows = _sketch_counts(baseline, data)
+    per_col = baseline.psi(counts)
+    psi_max = float(per_col.max())
+    psi_mean = float(per_col.mean())
+    warned = psi_max > threshold
+    if numerics_active():
+        reg = MetricsRegistry.get_or_create()
+        reg.gauge("numerics.drift_score").set(psi_max)
+        record_numerics_event("drift_score", score=psi_max,
+                              mean=psi_mean, rows=rows,
+                              source=baseline.source)
+        if warned:
+            record_numerics_event(
+                "drift_warn", score=psi_max, threshold=threshold,
+                worst_col=int(baseline.cols[int(per_col.argmax())]),
+                source=baseline.source)
+    return {"psi_max": psi_max, "psi_mean": psi_mean, "warned": warned,
+            "threshold": threshold, "rows": rows,
+            "per_col": per_col.tolist()}
+
+
+# -- post-mortem support ------------------------------------------------------
+
+def health_snapshot() -> Dict[str, Any]:
+    """What a crash dump embeds: the recent health series plus the
+    plane's enablement state (``observability/postmortem.py`` calls
+    this best-effort)."""
+    return {"enabled": numerics_enabled(),
+            "recent_health": recent_health(),
+            "last_health_age_s": last_health_age_s()}
+
+
+def postmortem_report(argv: Sequence[str]) -> int:
+    """``python -m keystone_tpu numerics <postmortem.json>``: render a
+    health post-mortem — reason/context, the embedded health series as
+    a table, and the numerics counters from the metrics snapshot (the
+    README 'Numerics health' section documents how to read it)."""
+    argv = [a for a in argv if not a.startswith("-")]
+    if len(argv) != 1:
+        print("usage: python -m keystone_tpu numerics POSTMORTEM.json")
+        return 1
+    try:
+        with open(argv[0]) as f:
+            blob = json.load(f)
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"numerics: cannot load {argv[0]!r}: {exc}")
+        return 1
+    print(f"post-mortem: {blob.get('reason')} (pid {blob.get('pid')})")
+    ctx = blob.get("context") or {}
+    series = ctx.pop("recent_health", None) or (
+        blob.get("numerics") or {}).get("recent_health") or []
+    for k, v in sorted(ctx.items()):
+        print(f"  {k}: {v}")
+    counters = (blob.get("metrics") or {}).get("counters") or {}
+    numeric = {k: v for k, v in counters.items()
+               if k.startswith("numerics.")}
+    if numeric:
+        print("numerics counters: " + " ".join(
+            f"{k.split('.', 1)[1]}={v:g}" for k, v in sorted(
+                numeric.items())))
+    if series:
+        print(f"health series (last {len(series)}):")
+        print(f"{'source':<28} {'chunk':>6} {'nan':>8} {'inf':>8} "
+              f"{'min':>11} {'max':>11} {'mean':>11}")
+        for e in series:
+            print(f"{str(e.get('source', '?'))[:28]:<28} "
+                  f"{str(e.get('chunk', '-')):>6} "
+                  f"{e.get('nan', 0):>8.0f} {e.get('inf', 0):>8.0f} "
+                  f"{e.get('min', 0):>11.4g} {e.get('max', 0):>11.4g} "
+                  f"{e.get('mean', 0):>11.4g}")
+    else:
+        print("no health series in this artifact")
+    return 0

@@ -88,6 +88,16 @@ def dump_postmortem(reason: str,
             executables = executable_table(capture=capture_executables)
         except Exception:
             executables = []  # evidence collection must not mask the crash
+        try:
+            # the numerics plane's recent health series: for a NaN
+            # tripwire this is the primary evidence (which chunk went
+            # bad, how fast), and for machine-plane crashes it answers
+            # "were the numbers still healthy when the machine died?"
+            from .numerics import health_snapshot
+
+            numerics = health_snapshot()
+        except Exception:
+            numerics = None
         blob = {
             "reason": reason,
             "time_unix": time.time(),
@@ -97,6 +107,7 @@ def dump_postmortem(reason: str,
             "flight_recorder": rec.to_chrome_trace(),
             "compiles": compile_observatory().snapshot(),
             "executables": executables,
+            "numerics": numerics,
         }
         tmp = path.with_name(path.name + f".tmp{os.getpid()}")
         with open(tmp, "w") as f:

@@ -84,10 +84,21 @@ def _h2d_pool_queue_depth() -> float:
         return 0.0
 
 
+def _numerics_health_age_s() -> float:
+    """Seconds since the numerics plane last pulled a health word
+    (-1.0 before the first pull) — the liveness gauge for the
+    data-health plane: a long-running fit whose health age keeps
+    growing has silently stopped checking its numbers."""
+    from .numerics import last_health_age_s
+
+    return last_health_age_s()
+
+
 #: default probes installed on every sampler (name -> zero-arg float fn)
 DEFAULT_PROBES: Dict[str, Callable[[], float]] = {
     "process.rss_bytes": _rss_bytes,
     "h2d.pool_queue_depth": _h2d_pool_queue_depth,
+    "numerics.health_age_s": _numerics_health_age_s,
 }
 
 

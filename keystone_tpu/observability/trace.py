@@ -110,6 +110,7 @@ class _Frame:
 @guarded_by("_resilience_lock", "resilience", "resilience_stats")
 @guarded_by("_lock_wait_lock", "lock_waits")
 @guarded_by("_compile_lock", "compiles", "compile_stats")
+@guarded_by("_numerics_lock", "numerics", "numerics_stats")
 class PipelineTrace:
     """Collects one run's execution telemetry; see module docstring.
 
@@ -169,6 +170,15 @@ class PipelineTrace:
         self.compile_stats: Dict[str, float] = {
             "count": 0, "wall_s": 0.0, "unexpected": 0}
         self._compile_lock = threading.Lock()
+        #: numerics events (observability/numerics.py): solver
+        #: breakdowns, non-finite tripwires, drift scores/warnings —
+        #: same bounded-tail-plus-exact-counts shape as ``resilience``.
+        #: Solver-ledger events arrive from jax debug-callback threads,
+        #: hence the lock (a TracedLock: its contention reports into
+        #: metrics/recorder/lock_waits, never back into this stream).
+        self.numerics: List[Dict[str, Any]] = []
+        self.numerics_stats: Dict[str, float] = {}
+        self._numerics_lock = TracedLock("trace.numerics")
         #: contended-lock wait table fed by TracedLock while this trace
         #: is active: {lock name: {"count": n, "wait_s": total}}. Its
         #: own guard is a PLAIN lock — TracedLock reports in here, so a
@@ -333,6 +343,27 @@ class PipelineTrace:
             if len(self.compiles) > self.COMPILE_TAIL:
                 del self.compiles[: len(self.compiles) - self.COMPILE_TAIL]
 
+    #: raw numerics entries retained (per-event counts in
+    #: ``numerics_stats`` stay exact)
+    NUMERICS_TAIL = 512
+
+    def record_numerics(self, entry: Dict[str, Any]) -> None:
+        """One numerics event (:mod:`keystone_tpu.observability.\
+numerics`): ``entry["event"]`` is the kind (nonfinite /
+        nonfinite_model / breakdown / drift_score / drift_warn /
+        fit_baseline), the rest is site context — solver site and pivot
+        ratio for breakdowns, source/chunk for tripwires, PSI score for
+        drift. May fire from jax debug-callback threads (the solver
+        ledger), hence the lock."""
+        event = str(entry.get("event", "other"))
+        with self._numerics_lock:
+            self.numerics_stats[event] = (
+                self.numerics_stats.get(event, 0) + 1)
+            self.numerics.append(entry)
+            if len(self.numerics) > self.NUMERICS_TAIL:
+                del self.numerics[: len(self.numerics)
+                                  - self.NUMERICS_TAIL]
+
     def record_lock_wait(self, name: str, wait_s: float) -> None:
         """One contended :class:`~keystone_tpu.utils.guarded.TracedLock`
         acquire while this trace was active (called from whichever
@@ -382,6 +413,8 @@ class PipelineTrace:
             "resilience_stats": dict(self.resilience_stats),
             "compiles": list(self.compiles),
             "compile_stats": dict(self.compile_stats),
+            "numerics": list(self.numerics),
+            "numerics_stats": dict(self.numerics_stats),
             "lock_waits": {k: dict(v)
                            for k, v in self.lock_waits.items()},
         }
@@ -437,6 +470,12 @@ class PipelineTrace:
             }
         if cstats is not None:
             tr.compile_stats = dict(cstats)
+        tr.numerics = list(data.get("numerics", []))
+        tr.numerics_stats = dict(data.get("numerics_stats", {}))
+        if not tr.numerics_stats and tr.numerics:  # older artifact
+            for e in tr.numerics:
+                ev = str(e.get("event", "other"))
+                tr.numerics_stats[ev] = tr.numerics_stats.get(ev, 0) + 1
         tr.lock_waits = {k: dict(v) for k, v in
                          data.get("lock_waits", {}).items()}
         return tr
@@ -519,6 +558,11 @@ class PipelineTrace:
                 f"{k}={int(v)}" for k, v in sorted(
                     self.resilience_stats.items()))
             lines.append(f"resilience events: {counts}")
+        if self.numerics_stats:
+            counts = " ".join(
+                f"{k}={int(v)}" for k, v in sorted(
+                    self.numerics_stats.items()))
+            lines.append(f"numerics events: {counts}")
         if self.lock_waits:
             top = sorted(self.lock_waits.items(),
                          key=lambda kv: -kv[1].get("wait_s", 0.0))[:3]

@@ -153,15 +153,20 @@ def cross(A: jax.Array, B: jax.Array, preferred: Optional[jnp.dtype] = None) -> 
 _PIVOT_TAU = 1e-3
 
 
-def _chol_healthy(L: jax.Array, G: jax.Array) -> jax.Array:
-    """Factor-level success predicate for the breakdown fallback: the
-    factor is finite AND no pivot collapsed relative to its own column
-    scale (min_i L_ii / sqrt(G_ii) > _PIVOT_TAU). Near-exact rank
-    deficiency (e.g. duplicate feature columns with lam ~ 0) can hand
-    back a FINITE factor whose last pivot is pure rounding noise — the
-    raw solve then returns finite but wildly oversized weights that
-    bypass a pure isfinite gate (ADVICE r2), a regime the reference's
-    f64 solver handled accurately.
+def _chol_health(L: jax.Array, G: jax.Array):
+    """``(ok, min_ratio)``: the factor-level success predicate for the
+    breakdown fallback plus the SCALE-FREE min pivot ratio it is built
+    from (min_i L_ii / sqrt(G_ii) — each pivot against its own column
+    mass, so badly-scaled but well-conditioned Grams never misfire).
+    ``ok`` requires the factor finite AND no collapsed pivot
+    (ratio > _PIVOT_TAU). Near-exact rank deficiency (e.g. duplicate
+    feature columns with lam ~ 0) can hand back a FINITE factor whose
+    last pivot is pure rounding noise — the raw solve then returns
+    finite but wildly oversized weights that bypass a pure isfinite
+    gate (ADVICE r2), a regime the reference's f64 solver handled
+    accurately. The ratio also feeds the numerics conditioning ledger
+    (``observability/numerics.py``: ``numerics.pivot_ratio`` histogram,
+    ``numerics.breakdown`` events).
 
     Scope note (measured): for smoothly ill-conditioned spectra the f32
     pivots saturate near sqrt(eps) relative scale rather than
@@ -173,11 +178,19 @@ def _chol_healthy(L: jax.Array, G: jax.Array) -> jax.Array:
     dL = jnp.abs(jnp.diagonal(L, axis1=-2, axis2=-1))
     dG = jnp.sqrt(jnp.maximum(
         jnp.abs(jnp.diagonal(G, axis1=-2, axis2=-1)), 1e-30))
-    cond_ok = jnp.min(dL / dG, axis=-1) > _PIVOT_TAU
-    return jnp.all(jnp.isfinite(L)) & jnp.all(cond_ok)
+    ratio = jnp.min(dL / dG)
+    ok = jnp.all(jnp.isfinite(L)) & (ratio > _PIVOT_TAU)
+    return ok, ratio
 
 
-def ridge_cho_solve(AtA: jax.Array, Atb: jax.Array, lam: float) -> jax.Array:
+def _chol_healthy(L: jax.Array, G: jax.Array) -> jax.Array:
+    """Predicate-only view of :func:`_chol_health` (call sites that do
+    their own ledger recording, or none)."""
+    return _chol_health(L, G)[0]
+
+
+def ridge_cho_solve(AtA: jax.Array, Atb: jax.Array, lam: float,
+                    site: str = "ridge_cho_solve") -> jax.Array:
     """Solve (AtA + lam*I) W = Atb by Cholesky (replicated on all chips).
 
     When f32 Cholesky breaks down or comes within a whisker of it
@@ -185,14 +198,29 @@ def ridge_cho_solve(AtA: jax.Array, Atb: jax.Array, lam: float) -> jax.Array:
     a collapsed pivot — the regime the reference's f64 solver
     survived), an eigendecomposition with clamped eigenvalues recovers a
     finite, more-strongly-regularized solution instead of silently
-    returning NaN/garbage weights that predict a constant class."""
+    returning NaN/garbage weights that predict a constant class.
+
+    The recovery is no longer silent: the breakdown predicate, the min
+    pivot ratio, and (numerics enabled) the relative solve residual are
+    reported into the conditioning ledger under ``site`` — one
+    ``numerics.breakdown`` event per fallback taken."""
+    from ..observability.numerics import numerics_enabled, record_solve_health
+
     d = AtA.shape[0]
     reg = AtA + lam * jnp.eye(d, dtype=AtA.dtype)
     factor = jax.scipy.linalg.cho_factor(reg, lower=True)
     W = jax.scipy.linalg.cho_solve(factor, Atb)
-    return _finite_or_eigh_solve(
-        W, lambda: reg, Atb,
-        ok=_chol_healthy(factor[0], reg) & jnp.all(jnp.isfinite(W)))
+    ok, ratio = _chol_health(factor[0], reg)
+    ok = ok & jnp.all(jnp.isfinite(W))
+    resid = None
+    if numerics_enabled():
+        # relative residual of the RAW solve (d^2*k flops — trivial
+        # next to the d^3/3 factorization; traced only when the plane
+        # is enabled at trace time)
+        resid = jnp.linalg.norm(reg @ W - Atb) / (
+            jnp.linalg.norm(Atb) + 1e-30)
+    record_solve_health(site, ok, ratio, resid)
+    return _finite_or_eigh_solve(W, lambda: reg, Atb, ok=ok)
 
 
 def clamped_eigh(reg: jax.Array):
@@ -280,15 +308,18 @@ def local_least_squares_dual(A: jax.Array, Y: jax.Array, lam: float) -> jax.Arra
 
 @observed_jit
 def _dual_solve_jit(A, Y, lam):
+    from ..observability.numerics import record_solve_health
+
     with solver_precision():
         n = A.shape[0]
         K = A @ A.T + lam * jnp.eye(n, dtype=A.dtype)
         factor = jax.scipy.linalg.cho_factor(K, lower=True)
         alpha = jax.scipy.linalg.cho_solve(factor, Y)
         # same f32 breakdown/near-breakdown recovery as ridge_cho_solve
-        alpha = _finite_or_eigh_solve(
-            alpha, lambda: K, Y,
-            ok=_chol_healthy(factor[0], K) & jnp.all(jnp.isfinite(alpha)))
+        ok, ratio = _chol_health(factor[0], K)
+        ok = ok & jnp.all(jnp.isfinite(alpha))
+        record_solve_health("dual_solve", ok, ratio)
+        alpha = _finite_or_eigh_solve(alpha, lambda: K, Y, ok=ok)
         return A.T @ alpha
 
 
@@ -391,10 +422,17 @@ def _bcd_scan_body(blocks, Y, lam, *, num_passes: int):
     def factor_one(_, i):
         G = gram(block_at(i)) + eye
         L, lower = jax.scipy.linalg.cho_factor(G, lower=True)
-        return None, (L, _chol_healthy(L, G))
+        ok, ratio = _chol_health(L, G)
+        return None, (L, ok, ratio)
 
     idx = jnp.arange(B)
-    _, (Ls, oks) = jax.lax.scan(factor_one, None, idx)
+    _, (Ls, oks, ratios) = jax.lax.scan(factor_one, None, idx)
+    # the conditioning ledger sees every block's predicate + pivot
+    # ratio in one callback (recorded AFTER the scan, not per step —
+    # a per-iteration callback inside the scan body would serialize it)
+    from ..observability.numerics import record_block_health
+
+    record_block_health("bcd_scan", oks, ratios)
 
     def block_step(carry, xs):
         pred = carry
@@ -445,11 +483,18 @@ def _bcd_core_body(blocks, Y, lam, *, num_passes: int):
     # the exceptional path, and healthy blocks carry no extra buffers.
     factors = []
     factor_ok = []
+    factor_ratio = []
     for A in blocks:
         G = gram(A) + lam * jnp.eye(A.shape[1], dtype=dtype)
         L = jax.scipy.linalg.cho_factor(G, lower=True)
         factors.append(L)
-        factor_ok.append(_chol_healthy(L[0], G))
+        ok, ratio = _chol_health(L[0], G)
+        factor_ok.append(ok)
+        factor_ratio.append(ratio)
+    from ..observability.numerics import record_block_health
+
+    record_block_health("bcd_core", jnp.stack(factor_ok),
+                        jnp.stack(factor_ratio))
     Ws = [jnp.zeros((A.shape[1], k), dtype) for A in blocks]
     pred = jnp.zeros_like(Y)
     for _ in range(num_passes):

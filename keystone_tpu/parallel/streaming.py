@@ -78,8 +78,15 @@ from ..observability.postmortem import attach_postmortem, dump_postmortem
 from ..observability.timeline import record_span
 from ..observability.trace import current_trace
 from ..utils.guarded import TracedLock, TracedSemaphore, guarded_by
+from ..observability.numerics import (
+    HealthMonitor,
+    SketchTracker,
+    check_fitted,
+    numerics_active,
+    record_numerics_event,
+)
 from ..resilience.events import record_event
-from ..resilience.faults import inject
+from ..resilience.faults import corrupt, inject
 from ..resilience.retry import (
     IngestTimeoutError,
     RetryPolicy,
@@ -441,6 +448,11 @@ class StreamingDataset(Dataset):
         retry under the stream's :class:`RetryPolicy` (the
         ``ingest.stage`` fault-injection site lives inside the
         attempt)."""
+        # value-corruption fault site (kind="corrupt" FaultSpecs): the
+        # numerics-gate tests poison exactly one chunk's data here to
+        # prove the NaN tripwire names the right chunk — a no-op (one
+        # global read) without an active FaultPlan
+        raw = corrupt("ingest.stage", raw, context=self.tag or "stream")
         leaves, treedef = jax.tree_util.tree_flatten(raw)
         if not leaves:
             raise ValueError("empty chunk from source")
@@ -1082,6 +1094,7 @@ def fit_streaming(estimator: Any, data: StreamingDataset,
     fingerprint = None
     start_chunk = 0
     carry = None
+    numerics_state = None
     if checkpoint_dir is not None:
         from ..resilience.stream_checkpoint import (
             StreamCheckpoint,
@@ -1100,11 +1113,24 @@ def fit_streaming(estimator: Any, data: StreamingDataset,
             carry = snap["carry"]
             if quarantine is not None and snap.get("quarantine"):
                 quarantine.restore(snap["quarantine"])
+            numerics_state = snap.get("numerics")
     takes_labels = labels is not None
     chunks_seen = 0
     idx = -1
     reg = MetricsRegistry.get_or_create()
     tag = data.tag or "stream"
+    # the numerics plane (observability/numerics.py): one fused health
+    # word per chunk (deferred D2H, tripwire on non-finite) and the
+    # drift-baseline feature sketch, both riding the accumulate pass —
+    # no extra data pass, and their programs compile during chunk 1,
+    # before the fit fence arms. KEYSTONE_NUMERICS=0 disables both.
+    monitor = HealthMonitor(tag) if numerics_active() else None
+    sketch = SketchTracker(source=tag) if numerics_active() else None
+    if sketch is not None and numerics_state is not None:
+        # resume: the restored sketch makes kill-and-resume baselines
+        # bit-identical with an uninterrupted fit (replayed chunks are
+        # skipped below, exactly like the carry)
+        sketch.restore(numerics_state, data.mesh)
     from ..observability.compilelog import compile_observatory, is_device_oom
 
     obs = compile_observatory()
@@ -1137,6 +1163,18 @@ def fit_streaming(estimator: Any, data: StreamingDataset,
             # past it, which is exactly the overlap the lanes show)
             record_span(f"accumulate:{tag}", "compute", t_acc,
                         time.perf_counter() - t_acc, args={"chunk": idx})
+            if monitor is not None:
+                # one small device reduction per chunk; the host pull
+                # is deferred `monitor.defer` chunks so it never stalls
+                # the ingest/compute overlap. Raises NumericsError
+                # (with a post-mortem) on a non-finite chunk. The mask
+                # keeps a zero-padded ragged tail out of the series'
+                # min/mean/var.
+                monitor.observe(idx, chunk.data,
+                                None if lchunk is None else lchunk.data,
+                                mask=chunk.mask)
+            if sketch is not None:
+                sketch.update(chunk)
             reg.gauge("streaming.carry_bytes").set(sum(
                 float(getattr(leaf, "nbytes", 0) or 0)
                 for leaf in jax.tree_util.tree_leaves(carry)))
@@ -1155,9 +1193,17 @@ def fit_streaming(estimator: Any, data: StreamingDataset,
                          "hbm_budget": hbm_budget, "chunk": chunks_seen},
                         capture_executables=True)
             if ckpt is not None and (idx + 1) % checkpoint_every == 0:
+                if monitor is not None:
+                    # drain pending health words first: a snapshot must
+                    # never capture a carry poisoned by a chunk whose
+                    # word was still in flight (the save syncs the
+                    # carry to host anyway, so this adds no new bubble)
+                    monitor.flush()
                 ckpt.save(fingerprint, idx + 1, carry,
                           None if quarantine is None
-                          else quarantine.state())
+                          else quarantine.state(),
+                          numerics=None if sketch is None
+                          else sketch.state())
             if chunks_seen == 1 and not fence_armed:
                 # per-chunk compile fence: every later chunk shares this
                 # chunk's padded shape, so steady state must compile
@@ -1170,9 +1216,30 @@ def fit_streaming(estimator: Any, data: StreamingDataset,
     finally:
         if fence_armed:
             obs.disarm_fence()
+    if monitor is not None:
+        # the tail of the deferred window: a NaN born in the last few
+        # chunks must trip HERE, before finalize turns it into
+        # plausible-looking garbage weights
+        monitor.flush()
     if carry is None:
         raise ValueError("empty stream: nothing to fit")
     model = estimator.finalize(carry)
+    # finalize-side tripwire: the solver recovery paths guarantee
+    # finite weights, so a non-finite fitted array here is always a bug
+    # worth a post-mortem (the 'garbage weights at finalize' failure)
+    check_fitted(model, tag)
+    if sketch is not None:
+        baseline = sketch.baseline()
+        if baseline is not None:
+            try:
+                # rides the fitted model into saved-pipeline artifacts:
+                # apply-time drift scoring needs the fit-time sketch
+                model.numerics_baseline = baseline
+            except (AttributeError, TypeError):
+                pass  # __slots__ transformer: no attach surface
+            record_numerics_event(
+                "fit_baseline", source=tag, rows=baseline.rows,
+                cols=int(len(baseline.cols)))
     if ckpt is not None:
         ckpt.clear()
     trace = current_trace()

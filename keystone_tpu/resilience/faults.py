@@ -24,7 +24,14 @@ site consults the plan's specs:
   ``error=`` for corrupt-record or fatal flavors),
 * ``kind="latency"`` sleeps ``delay_s`` (an I/O latency spike),
 * ``kind="hang"`` blocks until the plan exits, the caller's ``abort``
-  callback goes true, or ``delay_s`` elapses — a hung producer.
+  callback goes true, or ``delay_s`` elapses — a hung producer,
+* ``kind="corrupt"`` MUTATES the value flowing through a
+  value-carrying site (:func:`corrupt`, wired at ``ingest.stage`` in
+  the streaming prefetcher): the default mutation poisons the first
+  element of the first float leaf with NaN — the exact "NaN born in
+  chunk k" failure the numerics tripwire
+  (:mod:`keystone_tpu.observability.numerics`) exists to catch; pass
+  ``mutate=`` for other corruptions.
 
 Injection is deterministic: ``rate`` draws come from the plan's seeded
 RNG, and ``after``/``count`` give exact "fail once, after the k-th
@@ -54,14 +61,37 @@ class FaultSpec:
     """One injection rule at one site."""
 
     site: str
-    kind: str = "error"          # error | latency | hang
+    kind: str = "error"          # error | latency | hang | corrupt
     rate: float = 1.0            # per-visit injection probability
     after: int = 0               # skip the first `after` visits entirely
     count: Optional[int] = None  # at most this many injections
     error: Optional[Callable[[str], BaseException]] = None
     delay_s: float = 0.05        # latency duration / hang cap
+    mutate: Optional[Callable[[Any], Any]] = None  # corrupt transform
     visits: int = field(default=0, compare=False)
     injected: int = field(default=0, compare=False)
+
+
+def _poison_nan(value: Any) -> Any:
+    """Default ``kind="corrupt"`` mutation: NaN into the first element
+    of the first FLOAT leaf (copies the leaf — sources may hand out
+    views of long-lived host buffers). Integer-only values pass through
+    unchanged: an integer wire cannot carry NaN, which is also why the
+    numerics gate streams f32."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(value)
+    out = []
+    poisoned = False
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        if not poisoned and np.issubdtype(arr.dtype, np.floating) \
+                and arr.size:
+            arr = arr.copy()
+            arr.reshape(-1)[0] = np.nan
+            poisoned = True
+        out.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 _ACTIVE: Optional["FaultPlan"] = None
@@ -92,13 +122,15 @@ class FaultPlan:
     def add(self, site: str, kind: str = "error", rate: float = 1.0,
             after: int = 0, count: Optional[int] = None,
             error: Optional[Callable[[str], BaseException]] = None,
-            delay_s: float = 0.05) -> "FaultPlan":
-        if kind not in ("error", "latency", "hang"):
+            delay_s: float = 0.05,
+            mutate: Optional[Callable[[Any], Any]] = None) -> "FaultPlan":
+        if kind not in ("error", "latency", "hang", "corrupt"):
             raise ValueError(f"unknown fault kind {kind!r}")
         if not 0.0 < rate <= 1.0:
             raise ValueError("rate must be in (0, 1]")
         spec = FaultSpec(site=site, kind=kind, rate=rate, after=int(after),
-                         count=count, error=error, delay_s=float(delay_s))
+                         count=count, error=error, delay_s=float(delay_s),
+                         mutate=mutate)
         self._specs.setdefault(site, []).append(spec)
         return self
 
@@ -131,6 +163,8 @@ class FaultPlan:
         if not specs:
             return
         for spec in specs:
+            if spec.kind == "corrupt":
+                continue  # value-carrying rule: fires via corrupt()
             with self._lock:
                 spec.visits += 1
                 if spec.visits <= spec.after:
@@ -160,6 +194,33 @@ class FaultPlan:
                 raise exc
 
 
+    def mutate_value(self, site: str, value: Any, context: Any) -> Any:
+        """Apply this plan's ``kind="corrupt"`` rules at a
+        value-carrying site (same visit/after/count/rate gating as
+        :meth:`fire`, same seeded RNG)."""
+        specs = self._specs.get(site)
+        if not specs:
+            return value
+        for spec in specs:
+            if spec.kind != "corrupt":
+                continue
+            with self._lock:
+                spec.visits += 1
+                if spec.visits <= spec.after:
+                    continue
+                if spec.count is not None and spec.injected >= spec.count:
+                    continue
+                if spec.rate < 1.0 and float(self._rng.rand()) >= spec.rate:
+                    continue
+                spec.injected += 1
+                self.log.append({"site": site, "kind": "corrupt",
+                                 "context": context})
+            record_event("fault_injected", site=site, kind="corrupt",
+                         context=str(context))
+            value = (spec.mutate or _poison_nan)(value)
+        return value
+
+
 def inject(site: str, context: Any = None,
            abort: Optional[Callable[[], bool]] = None) -> None:
     """The per-site hook: a no-op (one global read) unless a
@@ -169,3 +230,15 @@ def inject(site: str, context: Any = None,
     plan = _ACTIVE
     if plan is not None:
         plan.fire(site, context, abort)
+
+
+def corrupt(site: str, value: Any, context: Any = None) -> Any:
+    """The value-carrying injection hook (``kind="corrupt"`` rules):
+    returns ``value`` untouched — one global read — unless an active
+    plan has a corrupt rule at ``site``. Wired at the streaming
+    ``ingest.stage`` site (the host chunk, BEFORE any wire narrowing,
+    so a poisoned NaN actually survives to the device)."""
+    plan = _ACTIVE
+    if plan is None:
+        return value
+    return plan.mutate_value(site, value, context)

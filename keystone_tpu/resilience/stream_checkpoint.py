@@ -170,12 +170,19 @@ class StreamCheckpoint:
         self.path = os.path.join(directory, f"{name}.ckpt")
 
     def save(self, fingerprint: str, cursor: int, carry: Any,
-             quarantine_state: Optional[Dict[str, Any]] = None) -> None:
+             quarantine_state: Optional[Dict[str, Any]] = None,
+             numerics: Optional[Dict[str, Any]] = None) -> None:
         """Snapshot after chunk ``cursor - 1``: carry leaves move to
         host (blocks on the device result — the checkpoint must not
         capture an in-flight accumulation) and the file replaces the
         previous snapshot atomically, so a kill mid-write leaves the
-        LAST complete snapshot, never a torn one."""
+        LAST complete snapshot, never a torn one.
+
+        ``numerics`` is the drift-sketch state
+        (``observability.numerics.SketchTracker.state()``): it rides
+        the snapshot so a resumed fit's drift baseline is bit-identical
+        with an uninterrupted one. Optional and absent from older
+        snapshots — ``load`` hands back whatever the file holds."""
         import jax
 
         host_carry = jax.tree_util.tree_map(np.asarray, carry)
@@ -183,6 +190,7 @@ class StreamCheckpoint:
             "magic": self.MAGIC, "version": self.VERSION,
             "fingerprint": fingerprint, "cursor": int(cursor),
             "carry": host_carry, "quarantine": quarantine_state,
+            "numerics": numerics,
         }, self.path)
         record_event("checkpoint_save", path=self.path, cursor=int(cursor))
 

@@ -23,6 +23,7 @@ from typing import Dict, FrozenSet, Optional
 
 from ..observability.compilelog import compile_context
 from ..observability.metrics import MetricsRegistry
+from ..observability.numerics import check_node_output
 from ..observability.timeline import record_span
 from ..observability.trace import NodeRecord, current_trace, metrics_suppressed
 from .env import PipelineEnv
@@ -136,6 +137,13 @@ def _traced_thunk(orig, node_id: int, label: str, kind: str):
         # nested node spans overflow to sub-lanes at export time
         record_span(scope, "node", t0, record.total_s,
                     args={"node_id": node_id, "kind": kind})
+        # numerics tripwire over the node's float output (AFTER the
+        # timer: the health reduction is the plane's cost, not the
+        # node's; the executor already blocked on the device result, so
+        # the small word pull adds no new sync). Raises NumericsError
+        # with a post-mortem naming this node on non-finite values —
+        # traced runs only, like every observer here.
+        check_node_output(value, scope)
         return value
 
     run._keystone_traced = True

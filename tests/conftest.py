@@ -54,6 +54,7 @@ def fresh_env():
         reset_compile_observatory,
     )
     from keystone_tpu.observability.metrics import MetricsRegistry
+    from keystone_tpu.observability.numerics import reset_health_series
     from keystone_tpu.observability.timeline import reset_flight_recorder
     from keystone_tpu.workflow.env import PipelineEnv
 
@@ -61,12 +62,14 @@ def fresh_env():
     MetricsRegistry.reset()
     reset_flight_recorder()
     reset_compile_observatory()
+    reset_health_series()
     clear_calibration_cache()
     yield
     PipelineEnv.reset()
     MetricsRegistry.reset()
     reset_flight_recorder()
     reset_compile_observatory()
+    reset_health_series()
     clear_calibration_cache()
 
 

@@ -1,0 +1,41 @@
+"""Synthetic offender for the ``silent-nan-silencer`` pass
+(``analysis.diagnostics.silent_nan_silencers``): NaN-suppressing calls
+with no recorded ``numerics.*`` event in scope. Parsed by tests, never
+imported."""
+
+import numpy as np
+
+from keystone_tpu.observability.metrics import MetricsRegistry
+from keystone_tpu.observability.numerics import record_numerics_event
+
+
+def silent_patch(x):
+    # offender: non-finites replaced, nobody ever learns they existed
+    return np.nan_to_num(x, nan=0.0)
+
+
+def silent_errstate(a, b):
+    # offender: divide-by-zero warnings suppressed with no event
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return a / b
+
+
+def accounted_patch(x):
+    # fine: the suppression is recorded into the numerics funnel
+    bad = int(np.sum(~np.isfinite(x)))
+    if bad:
+        record_numerics_event("nonfinite", count=bad)
+    return np.nan_to_num(x, nan=0.0)
+
+
+def accounted_via_counter(x):
+    # fine: a numerics.* counter in scope counts as accounting
+    reg = MetricsRegistry.get_or_create()
+    reg.counter("numerics.nan_total").inc(int(np.isnan(x).sum()))
+    return np.nan_to_num(x)
+
+
+def raising_errstate(a, b):
+    # fine: errstate(all='raise') is the OPPOSITE of suppression
+    with np.errstate(all="raise"):
+        return a / b

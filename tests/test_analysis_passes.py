@@ -138,6 +138,54 @@ def test_metric_catalogue_matches_registry_usage():
     assert not is_catalogued_prefix("")            # bare f-string head
 
 
+# -- silent-nan-silencer (PR 10 satellite) -----------------------------------
+
+def test_nan_silencer_fires_on_offender():
+    """The fixture's two silent suppressions fire; the accounted
+    spellings (record_numerics_event in scope, a numerics.* counter in
+    scope) and errstate(all='raise') do not."""
+    from keystone_tpu.analysis.diagnostics import silent_nan_silencers
+
+    hits = silent_nan_silencers(_tree("nan_silencer_offender"))
+    assert len(hits) == 2, hits
+    whats = {w for _, w in hits}
+    assert whats == {"nan_to_num(...)", "errstate(...='ignore')"}
+
+
+def test_nan_silencer_scoped_tree_is_clean():
+    """The numeric compute trees ship with zero unaccounted NaN
+    suppressions (the scopes tools/lint.py enforces)."""
+    from keystone_tpu.analysis.diagnostics import (
+        NAN_SILENCER_SCOPES,
+        silent_nan_silencers,
+    )
+
+    hits = []
+    for scope in NAN_SILENCER_SCOPES:
+        for path in sorted((REPO / "keystone_tpu" / scope).rglob("*.py")):
+            for lineno, what in silent_nan_silencers(
+                    ast.parse(path.read_text())):
+                hits.append(f"{path}:{lineno}: {what}")
+    assert hits == [], hits
+
+
+def test_nan_silencer_nested_defs_are_separate_scopes():
+    # a recorder in the outer body must not bless a silencer inside a
+    # nested def (and vice versa) — same scope rule as cast-before-
+    # transfer: false co-occurrence across closures is worse than a
+    # missed split pattern
+    from keystone_tpu.analysis.diagnostics import silent_nan_silencers
+
+    src = (
+        "def outer(x):\n"
+        "    record_numerics_event('nonfinite', count=1)\n"
+        "    def inner(y):\n"
+        "        return np.nan_to_num(y)\n"
+        "    return inner(x)\n")
+    hits = silent_nan_silencers(ast.parse(src))
+    assert [w for _, w in hits] == ["nan_to_num(...)"]
+
+
 # -- the whole tree is clean -------------------------------------------------
 
 @pytest.mark.parametrize(

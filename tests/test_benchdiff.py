@@ -12,6 +12,8 @@ of PERFORMANCE.md's hand argument.
 import json
 import pathlib
 
+import pytest
+
 from keystone_tpu.observability.benchdiff import (
     DEFAULT_BAND,
     compare,
@@ -119,6 +121,38 @@ def test_direction_markers():
     assert lower_is_better("ingest_stall_share")
     assert not lower_is_better("voc_map")
     assert not lower_is_better("widgets_per_sec")
+    # the PR 10 numerics-health keys are failure/cost measures
+    assert lower_is_better("streamed_nan_total")
+    assert lower_is_better("solver_breakdown_total")
+    assert lower_is_better("numerics_drift_score")
+    assert lower_is_better("numerics_overhead_share")
+
+
+def test_overhead_share_bands_absolutely(tmp_path):
+    """A signed share hovering at ~0 cannot use percent-of-base bands:
+    a noise flip from -0.037 to +0.01 is a >100% relative move, and a
+    base of exactly 0.0 is a meaningful value, not a new baseline."""
+    from keystone_tpu.observability.benchdiff import (
+        ABSOLUTE_BAND_FLOOR,
+        classify,
+    )
+
+    m = "numerics_overhead_share"
+    band, n = noise_band(m, [])
+    assert band == ABSOLUTE_BAND_FLOOR and n == 0
+    # zero base classifies normally (absolute delta), never new-baseline
+    assert classify(m, 0.0, 0.01, band) == ("in-band", -0.01)
+    # a genuine overhead jump past the 2-point bar regresses
+    cls, delta = classify(m, 0.0, 0.1, band)
+    assert cls == "regressed" and delta == pytest.approx(-0.1)
+    # the band learns machine noise in ABSOLUTE units: swings of
+    # 4/3 points -> median 3.5 x 1.5 = 5.25 points, so the -0.03 ->
+    # +0.01 flip that a relative band called a 127% regression is noise
+    arts = [load_artifact(str(p)) for p in
+            _history(tmp_path, [-0.03, 0.01, -0.02], metric=m)]
+    band, _ = noise_band(m, arts)
+    assert band == pytest.approx(1.5 * 0.035)
+    assert classify(m, -0.03, 0.01, band)[0] == "in-band"
 
 
 # -- classification + exit codes ---------------------------------------------

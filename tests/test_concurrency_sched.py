@@ -460,6 +460,100 @@ def test_cast_program_build_race_yields_one_program():
     assert got["a"] is got["b"]
 
 
+# -- this PR's fix: _JitSite.capture_stats lost update (PR 9 allowlist) ------
+
+class _FakeJitted:
+    """Stands in for the site's jitted callable: ``lower().compile()``
+    parks INSIDE the capture window — the cache check is done, the
+    publish has not happened — which is exactly where the pre-PR-10
+    blind overwrite raced."""
+
+    def __init__(self, sched):
+        self._sched = sched
+
+    def lower(self, *args, **kwargs):
+        return self
+
+    def compile(self):
+        self._sched.yield_point("aot.compile")
+        return object()
+
+
+def _unfixed_capture(site, sig_key):
+    """The pre-PR-10 publication: blind ``stats[sig_key] = stats``
+    overwrite after the compile — value-equal, but two racing captures
+    end up holding two DISTINCT dicts and the first writer's is
+    orphaned (the allowlisted lost update, now fixed by the
+    setdefault-adopt in ``_JitSite._adopt_stats``)."""
+    from keystone_tpu.observability import compilelog
+
+    with site._site_lock:
+        cached = site.stats.get(sig_key)
+        lower = site.avals.get(sig_key)
+    if cached is not None:
+        return cached
+    la, lk = lower
+    compiled = site.jitted.lower(*la, **lk).compile()
+    stats = compilelog.executable_stats(compiled)
+    with site._site_lock:
+        site.stats[sig_key] = stats
+    return stats
+
+
+def _drive_capture_race(fixed, monkeypatch, picks=None, seed=0,
+                        names=("a", "b")):
+    from keystone_tpu.observability import compilelog
+    from keystone_tpu.observability.compilelog import _JitSite
+
+    sched = (DeterministicScheduler(picks=list(picks))
+             if picks is not None else DeterministicScheduler(seed=seed))
+    site = _JitSite("race-site", _FakeJitted(sched))
+    site.avals["sig"] = ((), {})
+    # fresh value-equal dict per capture, like a real executable_stats
+    monkeypatch.setattr(compilelog, "executable_stats",
+                        lambda compiled: {"flops": 1.0})
+    got = {}
+
+    def run(name):
+        got[name] = (site.capture_stats("sig") if fixed
+                     else _unfixed_capture(site, "sig"))
+
+    for name in names:
+        sched.spawn(run, name, name=name)
+    with sched:
+        sched.run()
+    return got, site
+
+
+def test_capture_stats_lost_update_reproduces_on_unfixed_copy(monkeypatch):
+    got, site = _drive_capture_race(False, monkeypatch,
+                                    picks=["a", "b"] * 8)
+    published = site.stats["sig"]
+    # value-equal, but the loser's dict was orphaned by the overwrite:
+    # exactly one caller holds the published object
+    assert got["a"] == got["b"]
+    assert sum(got[n] is published for n in ("a", "b")) == 1
+
+
+def test_capture_stats_single_identity_on_head(monkeypatch):
+    # same schedule, same racy window — the setdefault-adopt under one
+    # lock hold makes every caller hold THE published dict
+    got, site = _drive_capture_race(True, monkeypatch,
+                                    picks=["a", "b"] * 8)
+    published = site.stats["sig"]
+    assert got["a"] is published and got["b"] is published
+
+
+def test_capture_stats_fix_survives_seeded_schedules(monkeypatch):
+    for seed in range(20):
+        got, site = _drive_capture_race(
+            True, monkeypatch, seed=seed, names=("a", "b", "c"))
+        published = site.stats["sig"]
+        assert all(got[n] is published for n in ("a", "b", "c")), \
+            f"seed {seed}"
+        assert len(site.stats) == 1
+
+
 def test_metrics_registry_singleton_survives_thread_hammer():
     MetricsRegistry.reset()
     seen = []

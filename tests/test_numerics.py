@@ -1,0 +1,619 @@
+"""Numerics & data-health observatory (PR 10): on-device health words
+with the deferred-D2H tripwire, the solver conditioning ledger, and
+PSI distribution-drift detection.
+
+Acceptance pins: an injected-NaN streamed fit raises ``NumericsError``
+naming chunk+stream with a post-mortem carrying the health series; the
+drift scenario passes both directions (shifted trips, unshifted replay
+does not) with the baseline sketch surviving checkpoint/resume
+bit-identically; health reductions add zero post-warmup compiles (the
+PR 9 fence stays clean); breakdown events round-trip through trace
+JSON and appear in the Prometheus exposition."""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu import Pipeline, PipelineTrace, Transformer
+from keystone_tpu.nodes.learning.linear import LinearMapEstimator
+from keystone_tpu.observability import MetricsRegistry
+from keystone_tpu.observability.numerics import (
+    DriftBaseline,
+    HealthMonitor,
+    NumericsError,
+    SketchTracker,
+    check_fitted,
+    check_node_output,
+    drift_threshold,
+    health_word,
+    last_health_age_s,
+    numerics_active,
+    numerics_suppressed,
+    postmortem_report,
+    recent_health,
+    score_drift,
+    word_stats,
+)
+from keystone_tpu.parallel.streaming import StreamingDataset, fit_streaming
+from keystone_tpu.resilience.faults import FaultPlan
+
+
+def _xy(n=256, d=16, k=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, d).astype(np.float32)
+    y = rng.randint(0, k, n)
+    Y = (-np.ones((n, k)) + 2.0 * np.eye(k)[y]).astype(np.float32)
+    return X, Y
+
+
+# -- health words -------------------------------------------------------------
+
+def test_health_word_counts_and_moments():
+    x = np.array([1.0, -3.0, np.nan, np.inf, 2.0], np.float32)
+    s = word_stats(np.asarray(health_word((x,))))
+    assert s["finite"] == 3 and s["nan"] == 1 and s["inf"] == 1
+    assert s["min"] == -3.0 and s["max"] == 2.0 and s["absmax"] == 3.0
+    fin = np.array([1.0, -3.0, 2.0])
+    assert s["mean"] == pytest.approx(fin.mean())
+    assert s["var"] == pytest.approx(fin.var(), rel=1e-5)
+
+
+def test_health_word_multi_leaf_aggregates():
+    a = np.ones((4, 4), np.float32)
+    b = np.full((3,), np.nan, np.float32)
+    s = word_stats(np.asarray(health_word((a, b))))
+    assert s["finite"] == 16 and s["nan"] == 3 and s["inf"] == 0
+
+
+def test_health_word_nothing_finite():
+    s = word_stats(np.asarray(health_word(
+        (np.full((4,), np.nan, np.float32),))))
+    assert s["finite"] == 0 and s["nan"] == 4
+    assert s["min"] == 0.0 and s["max"] == 0.0  # guarded display values
+
+
+def test_health_word_counts_exact_past_f32_precision():
+    # summing >2^24 ones in f32 is inexact, and a rounded finite count
+    # would make the DERIVED inf count nonzero — a spurious tripwire on
+    # clean data. The counts accumulate in int32, so a 2^24+3-element
+    # leaf reports exactly zero non-finites.
+    n = (1 << 24) + 3
+    s = word_stats(np.asarray(health_word((np.ones(n, np.float32),))))
+    assert s["nan"] == 0 and s["inf"] == 0
+    assert s["finite"] == pytest.approx(n, rel=1e-6)
+
+
+def test_health_word_mask_excludes_pad_rows():
+    """Zero-pad rows (the ArrayDataset ragged-tail invariant) must not
+    distort the diagnostic stats: README tells users to read the
+    post-mortem series' min/mean trend, and a spurious min=0.0 on the
+    padded chunk before a failure points the diagnosis the wrong way."""
+    X = np.full((6, 4), 2.5, np.float32)
+    X[4:] = 0.0  # pad rows
+    mask = np.array([1, 1, 1, 1, 0, 0], np.float32)
+    s = word_stats(np.asarray(health_word((X,), mask)))
+    assert s["finite"] == 16  # 4 live rows x 4 cols
+    assert s["min"] == 2.5 and s["max"] == 2.5 and s["mean"] == 2.5
+    assert s["var"] == pytest.approx(0.0)
+    # a NaN in a PAD row is synthetic, never a tripwire
+    X[5, 0] = np.nan
+    s = word_stats(np.asarray(health_word((X,), mask)))
+    assert s["nan"] == 0
+    # ...but a NaN in a LIVE row still counts
+    X[0, 0] = np.nan
+    s = word_stats(np.asarray(health_word((X,), mask)))
+    assert s["nan"] == 1
+    # a leaf whose leading dim is not the row axis keeps the unmasked
+    # reduction (trace-time shape decision, no crash)
+    s = word_stats(np.asarray(health_word(
+        (np.ones((3,), np.float32),), mask)))
+    assert s["finite"] == 3
+
+
+def test_streamed_ragged_tail_series_is_mask_weighted():
+    # 300 rows of strictly-positive data AND labels in 64-row chunks:
+    # the last chunk pads 20 rows with zeros, which must not show up
+    # as min=0 in that chunk's series entry
+    X = np.full((300, 8), 3.0, np.float32)
+    Y = np.full((300, 4), 2.0, np.float32)
+    fit_streaming(
+        LinearMapEstimator(lam=0.1),
+        StreamingDataset.from_numpy(X, chunk_size=64, tag="ragged"), Y)
+    series = [e for e in recent_health() if e.get("source") == "ragged"]
+    assert len(series) == 5
+    tail = series[-1]
+    assert tail["min"] > 0.0  # data leaf's live min, not the pad's 0.0
+    assert tail["finite"] < series[0]["finite"]  # fewer live rows
+
+
+def test_monitor_defers_the_pull():
+    m = HealthMonitor("s", defer=3)
+    clean = np.ones((8,), np.float32)
+    for i in range(3):
+        m.observe(i, clean)
+    assert m.checked == 0  # all words still in flight
+    m.observe(3, clean)
+    assert m.checked == 1  # the window overflowed by one
+    m.flush()
+    assert m.checked == 4
+    snap = MetricsRegistry.get_or_create().snapshot()
+    assert snap["counters"]["numerics.health_words"] == 4
+    assert last_health_age_s() >= 0.0
+
+
+def test_monitor_tripwire_names_chunk_and_source():
+    m = HealthMonitor("bad-stream", defer=2)
+    m.observe(0, np.ones((4,), np.float32))
+    m.observe(1, np.array([1.0, np.nan], np.float32))
+    with pytest.raises(NumericsError) as exc:
+        m.flush()
+    msg = str(exc.value)
+    assert "chunk 1" in msg and "bad-stream" in msg
+    path = exc.value.postmortem_path
+    assert path and os.path.exists(path)
+    with open(path) as f:
+        blob = json.load(f)
+    series = blob["context"]["recent_health"]
+    assert any(e.get("chunk") == 1 and e.get("nan") for e in series)
+    # the dump also carries the plane's own snapshot for machine-plane
+    # crashes ("were the numbers healthy when the machine died?")
+    assert blob["numerics"]["enabled"] is True
+
+
+# -- the injected-NaN streamed fit (acceptance) -------------------------------
+
+def test_streamed_fit_tripwire_names_chunk_with_postmortem():
+    X, Y = _xy(n=320, d=16)
+    with FaultPlan(seed=3).add("ingest.stage", kind="corrupt",
+                               after=1, count=1):
+        with pytest.raises(NumericsError) as exc:
+            fit_streaming(
+                LinearMapEstimator(lam=0.1),
+                StreamingDataset.from_numpy(X, chunk_size=64,
+                                            tag="poisoned"),
+                Y)
+    msg = str(exc.value)
+    assert "chunk 1" in msg and "poisoned" in msg
+    assert exc.value.postmortem_path
+    with open(exc.value.postmortem_path) as f:
+        blob = json.load(f)
+    assert any(e.get("chunk") == 1 and e.get("nan")
+               for e in blob["context"]["recent_health"])
+    snap = MetricsRegistry.get_or_create().snapshot()
+    assert snap["counters"]["numerics.nan_total"] >= 1
+    assert snap["counters"]["numerics.nonfinite"] >= 1
+
+
+def test_clean_streamed_fit_no_tripwire_no_postmortem(tmp_path,
+                                                      monkeypatch):
+    monkeypatch.setenv("KEYSTONE_POSTMORTEM_DIR", str(tmp_path))
+    X, Y = _xy(n=256, d=16)
+    model = fit_streaming(
+        LinearMapEstimator(lam=0.1),
+        StreamingDataset.from_numpy(X, chunk_size=64, tag="clean"), Y)
+    assert np.isfinite(np.asarray(model.weights)).all()
+    assert os.listdir(str(tmp_path)) == []
+    snap = MetricsRegistry.get_or_create().snapshot()
+    assert snap["counters"]["numerics.health_words"] >= 4
+
+
+def test_numerics_suppressed_fit_skips_the_plane():
+    X, Y = _xy(n=128, d=8)
+    with numerics_suppressed():
+        assert not numerics_active()
+        fit_streaming(
+            LinearMapEstimator(lam=0.1),
+            StreamingDataset.from_numpy(X, chunk_size=64, tag="off"), Y)
+    snap = MetricsRegistry.get_or_create().snapshot()
+    assert "numerics.health_words" not in snap["counters"]
+    assert recent_health() == []
+
+
+# -- traced-executor node tripwire --------------------------------------------
+
+class _MakeNaN(Transformer):
+    def apply(self, x):
+        return x * jnp.float32(np.inf) * 0.0  # inf * 0 -> NaN
+
+
+class _Identity(Transformer):
+    def apply(self, x):
+        return x
+
+
+def test_traced_node_output_tripwire_names_node():
+    pipe = _Identity().and_then(_MakeNaN())
+    x = np.ones((8, 4), np.float32)
+    with PipelineTrace("t"):
+        with pytest.raises(NumericsError) as exc:
+            pipe.apply(x).numpy()
+    assert "_MakeNaN" in str(exc.value)
+    assert exc.value.postmortem_path
+
+
+def test_untraced_run_is_unchecked():
+    # zero-overhead contract: without a trace the executor never
+    # health-checks, so the NaN flows through (the streamed/monitor
+    # paths are the always-on guards; node checks ride the trace)
+    pipe = _Identity().and_then(_MakeNaN())
+    out = np.asarray(pipe.apply(np.ones((4, 2), np.float32)).numpy())
+    assert np.isnan(out).all()
+
+
+def test_check_node_output_direct():
+    entry = check_node_output(np.ones((4,), np.float32), "n#1")
+    assert entry["finite"] == 4
+    with pytest.raises(NumericsError, match="n#2"):
+        check_node_output(np.array([np.nan], np.float32), "n#2")
+    assert check_node_output("not-an-array", "n#3") is None
+
+
+def test_check_fitted_raises_on_nonfinite_model():
+    class M:
+        def __init__(self):
+            self.weights = np.array([[1.0, np.nan]], np.float32)
+
+    with pytest.raises(NumericsError, match="fitted model"):
+        check_fitted(M(), "bad-fit")
+    snap = MetricsRegistry.get_or_create().snapshot()
+    assert snap["counters"]["numerics.nonfinite_model"] >= 1
+
+
+# -- solver conditioning ledger -----------------------------------------------
+
+def _singular_solve():
+    from keystone_tpu.ops.linalg import ridge_cho_solve
+
+    # duplicate feature columns with lam ~ 0: the near-exact rank
+    # deficiency regime — f32 Cholesky hands back a collapsed pivot and
+    # the clamped-eigh recovery branch runs (one breakdown event)
+    rng = np.random.RandomState(0)
+    A = rng.rand(32, 4).astype(np.float32)
+    A = np.concatenate([A, A], axis=1)  # exact duplicates
+    G = jnp.asarray(A.T @ A)
+    C = jnp.asarray((A.T @ rng.rand(32, 3)).astype(np.float32))
+    return ridge_cho_solve(G, C, 0.0, site="test_singular")
+
+
+def test_breakdown_lands_in_ledger_and_trace():
+    with PipelineTrace("t") as tr:
+        W = np.asarray(_singular_solve())
+    assert np.isfinite(W).all()  # the recovery still recovers
+    snap = MetricsRegistry.get_or_create().snapshot()
+    assert snap["counters"]["numerics.breakdown_total"] >= 1
+    assert snap["counters"]["numerics.solves_total"] >= 1
+    events = [e for e in tr.numerics if e["event"] == "breakdown"]
+    assert events and events[0]["site"] == "test_singular"
+    # collapsed pivot: a tiny ratio, or None when the factor itself
+    # went NaN (sanitized — a bare NaN token would corrupt the JSON
+    # artifacts the event lands in) — either way NOT a healthy value
+    ratio = events[0]["pivot_ratio"]
+    assert ratio is None or not (ratio >= 1e-3)
+    assert tr.numerics_stats["breakdown"] >= 1
+
+
+def test_nan_pivot_ratio_sanitized_in_events():
+    """A NaN Cholesky factor yields a NaN ratio; the breakdown event
+    must carry None instead — trace/Perfetto/post-mortem artifacts are
+    strict JSON and one bare NaN token would corrupt the whole file."""
+    from keystone_tpu.observability.numerics import _blocks_cb, _solve_cb
+
+    with PipelineTrace("t") as tr:
+        _solve_cb("nan-site", np.asarray(False), np.asarray(np.nan),
+                  np.asarray(-1.0))
+        _blocks_cb("nan-blocks", np.asarray([False]),
+                   np.asarray([np.nan]))
+    events = [e for e in tr.numerics if e["event"] == "breakdown"]
+    assert len(events) == 2
+    assert all(e["pivot_ratio"] is None for e in events)
+    # the serialized trace must parse as STRICT JSON (no NaN literals)
+    json.loads(tr.to_json(),
+               parse_constant=lambda s: pytest.fail(f"bare {s} token"))
+
+
+def test_healthy_solve_records_no_breakdown():
+    from keystone_tpu.ops.linalg import ridge_cho_solve
+
+    G = jnp.eye(8, dtype=jnp.float32) * 4.0
+    C = jnp.ones((8, 2), jnp.float32)
+    np.asarray(ridge_cho_solve(G, C, 0.1))
+    snap = MetricsRegistry.get_or_create().snapshot()
+    assert snap["counters"]["numerics.solves_total"] >= 1
+    assert "numerics.breakdown_total" not in snap["counters"]
+    # healthy solves report their pivot ratio and relative residual
+    assert snap["histograms"]["numerics.pivot_ratio"]["count"] >= 1
+    assert snap["histograms"]["numerics.pivot_ratio"]["min"] > 1e-3
+    assert snap["histograms"]["numerics.residual_rel"]["max"] < 1e-3
+
+
+def test_breakdown_trace_json_roundtrip_and_summary():
+    with PipelineTrace("t") as tr:
+        np.asarray(_singular_solve())
+    blob = json.loads(tr.to_json())
+    assert any(e["event"] == "breakdown" for e in blob["numerics"])
+    tr2 = PipelineTrace.from_json(json.dumps(blob))
+    assert tr2.numerics_stats == tr.numerics_stats
+    assert any(e["event"] == "breakdown" for e in tr2.numerics)
+    assert "numerics events" in tr2.summary()
+    # legacy artifact (no stats block): rebuilt from the tail
+    del blob["numerics_stats"]
+    tr3 = PipelineTrace.from_json(json.dumps(blob))
+    assert tr3.numerics_stats.get("breakdown", 0) >= 1
+
+
+def test_prometheus_exposition_carries_numerics():
+    from keystone_tpu.ops.linalg import ridge_cho_solve
+
+    np.asarray(_singular_solve())  # breakdown counter
+    np.asarray(ridge_cho_solve(  # healthy: pivot/residual histograms
+        jnp.eye(8, dtype=jnp.float32), jnp.ones((8, 2), jnp.float32),
+        0.1))
+    text = MetricsRegistry.get_or_create().to_prometheus()
+    assert "keystone_numerics_breakdown_total" in text
+    assert "keystone_numerics_pivot_ratio" in text
+    assert "keystone_numerics_solves_total" in text
+
+
+def test_per_class_weighted_solves_reach_ledger():
+    """The per-class reweighted BCD was the one recovery site outside
+    the conditioning ledger — every `_finite_or_eigh_solve` user must
+    report (one stacked callback after the lax.map, never per class).
+    Duplicate feature columns with lam=0 collapse a pivot in every
+    class's block, so the breakdown is visible."""
+    from keystone_tpu.nodes.learning.per_class_weighted import (
+        PerClassWeightedLeastSquaresEstimator,
+    )
+
+    rng = np.random.RandomState(0)
+    half = rng.randn(96, 4).astype(np.float32)
+    X = np.concatenate([half, half], axis=1)
+    y = rng.randint(0, 3, 96)
+    L = -np.ones((96, 3), np.float32)
+    L[np.arange(96), y] = 1.0
+    PerClassWeightedLeastSquaresEstimator(
+        block_size=8, num_iter=1, lam=0.0,
+        mixture_weight=0.5).fit_arrays(X, L)
+    snap = MetricsRegistry.get_or_create().snapshot()
+    assert snap["counters"]["numerics.breakdown_total"] >= 1
+    assert snap["counters"]["numerics.solves_total"] >= 3  # >= k blocks
+
+
+def test_streamed_blockls_breakdowns_reach_ledger():
+    # the streamed BlockLS finalize runs the gram-form BCD: duplicate
+    # columns inside one block put a breakdown on the gram_bcd site
+    from keystone_tpu.nodes.learning.linear import (
+        BlockLeastSquaresEstimator,
+    )
+
+    rng = np.random.RandomState(0)
+    half = rng.rand(256, 8).astype(np.float32)
+    X = np.concatenate([half, half], axis=1)
+    _, Y = _xy(n=256)
+    fit_streaming(
+        BlockLeastSquaresEstimator(16, 1, lam=0.0),
+        StreamingDataset.from_numpy(X, chunk_size=64, tag="dup"), Y)
+    snap = MetricsRegistry.get_or_create().snapshot()
+    assert snap["counters"]["numerics.breakdown_total"] >= 1
+
+
+# -- distribution drift -------------------------------------------------------
+
+def _fit_with_baseline(X, Y, tag="drift-fit", chunk=64):
+    return fit_streaming(
+        LinearMapEstimator(lam=0.1),
+        StreamingDataset.from_numpy(X, chunk_size=chunk, tag=tag), Y)
+
+
+def test_drift_scenario_both_directions():
+    """Acceptance: a mean/scale-shifted stream scores above the
+    threshold; an unshifted replay stays below."""
+    rng = np.random.RandomState(0)
+    X = rng.rand(1024, 32).astype(np.float32)
+    _, Y = _xy(n=1024)
+    model = _fit_with_baseline(X, Y)
+    base = model.numerics_baseline
+    assert isinstance(base, DriftBaseline) and base.rows == 1024
+
+    replay = score_drift(
+        base, StreamingDataset.from_numpy(
+            rng.rand(512, 32).astype(np.float32), chunk_size=64))
+    assert not replay["warned"]
+    assert replay["psi_max"] < drift_threshold()
+
+    shifted = score_drift(
+        base, StreamingDataset.from_numpy(
+            (rng.rand(512, 32) * 1.5 + 0.5).astype(np.float32),
+            chunk_size=64))
+    assert shifted["warned"]
+    assert shifted["psi_max"] > drift_threshold()
+    # separation is wide, not marginal: thresholds have headroom
+    assert shifted["psi_max"] > 10 * replay["psi_max"]
+    snap = MetricsRegistry.get_or_create().snapshot()
+    assert snap["gauges"]["numerics.drift_score"] == pytest.approx(
+        shifted["psi_max"])
+    assert snap["counters"]["numerics.drift_warn"] >= 1
+    assert snap["counters"]["numerics.fit_baseline"] == 1
+
+
+def test_drift_baseline_survives_checkpoint_resume_bit_identical(
+        tmp_path):
+    """Acceptance: kill-and-resume carries the baseline sketch
+    bit-identically — the resumed fit's counts/geometry EQUAL the
+    uninterrupted fit's, not merely approximate them."""
+    X, Y = _xy(n=320, d=16)
+
+    def stream():
+        return StreamingDataset.from_numpy(X, chunk_size=64, tag="kr")
+
+    base = fit_streaming(LinearMapEstimator(lam=0.1), stream(), Y)
+    ckdir = str(tmp_path / "ck")
+    with FaultPlan().add("ingest.produce", after=2, count=1,
+                         error=RuntimeError):
+        with pytest.raises(RuntimeError, match="injected fault"):
+            fit_streaming(LinearMapEstimator(lam=0.1), stream(), Y,
+                          checkpoint_dir=ckdir, checkpoint_every=1)
+    resumed = fit_streaming(LinearMapEstimator(lam=0.1), stream(), Y,
+                            checkpoint_dir=ckdir, checkpoint_every=1)
+    b0, b1 = base.numerics_baseline, resumed.numerics_baseline
+    assert np.array_equal(b0.counts, b1.counts)  # bit-identical
+    assert np.array_equal(b0.interior, b1.interior)
+    assert np.array_equal(b0.cols, b1.cols)
+    assert b0.rows == b1.rows
+    # and the restored sketch still scores identically
+    probe = np.random.RandomState(9).rand(128, 16).astype(np.float32)
+    assert score_drift(b0, probe)["psi_max"] == pytest.approx(
+        score_drift(b1, probe)["psi_max"])
+
+
+def test_baseline_merge_and_geometry_guard():
+    rng = np.random.RandomState(0)
+    X, Y = _xy(n=256, d=8)
+    b1 = _fit_with_baseline(X, Y, tag="m1").numerics_baseline
+    b2 = _fit_with_baseline(X, Y, tag="m2").numerics_baseline
+    # same data, same chunking -> same edges: mergeable, counts sum
+    merged = b1.merge(b2)
+    assert merged.rows == b1.rows + b2.rows
+    assert np.array_equal(merged.counts, b1.counts + b2.counts)
+    other = _fit_with_baseline(
+        rng.rand(256, 8).astype(np.float32) * 100.0, Y,
+        tag="m3").numerics_baseline
+    with pytest.raises(ValueError, match="geometry"):
+        b1.merge(other)
+
+
+def test_score_drift_requires_a_baseline_and_2d_data():
+    with pytest.raises(ValueError, match="no drift baseline"):
+        score_drift(None, np.ones((4, 2), np.float32))
+    X, Y = _xy(n=128, d=8)
+    base = _fit_with_baseline(X, Y, tag="req").numerics_baseline
+    with pytest.raises(ValueError, match="2-D"):
+        score_drift(base, np.ones((4, 2, 2), np.float32))
+
+
+def test_score_drift_rejects_narrower_feature_space():
+    """jax's gather CLAMPS out-of-bounds column indices instead of
+    raising, so scoring a narrower matrix would silently compare every
+    tail column against the last in-range column's histogram — it must
+    raise instead."""
+    X, Y = _xy(n=128, d=16)
+    base = _fit_with_baseline(X, Y, tag="dim").numerics_baseline
+    assert int(base.cols.max()) == 15
+    with pytest.raises(ValueError, match="feature"):
+        score_drift(base, np.ones((32, 8), np.float32))
+
+
+def test_sketch_disables_on_ineligible_data():
+    tr = SketchTracker(source="t")
+
+    class Chunk:
+        data = {"a": jnp.ones((4, 2)), "b": jnp.ones((4,))}
+        mask = jnp.ones(4)
+        n = 4
+
+    tr.update(Chunk)
+    assert tr.disabled and tr.baseline() is None and tr.state() is None
+
+
+def test_sketch_rejects_too_few_bins():
+    with pytest.raises(ValueError, match="bins"):
+        SketchTracker(bins=2)
+
+
+# -- gating & env knobs -------------------------------------------------------
+
+def test_numerics_disabled_fit_completes_with_garbage(monkeypatch):
+    # KEYSTONE_NUMERICS=0 documents the opt-out: the poisoned fit runs
+    # to completion (the pre-PR-10 behavior: garbage weights, silence)
+    monkeypatch.setenv("KEYSTONE_NUMERICS", "0")
+    X, Y = _xy(n=256, d=8)
+    with FaultPlan().add("ingest.stage", kind="corrupt", after=1,
+                         count=1):
+        model = fit_streaming(
+            LinearMapEstimator(lam=0.1),
+            StreamingDataset.from_numpy(X, chunk_size=64, tag="off"), Y)
+    assert not np.isfinite(np.asarray(model.weights)).all()
+    assert getattr(model, "numerics_baseline", None) is None
+
+
+def test_drift_threshold_env_validation(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_DRIFT_THRESHOLD", "0.5")
+    assert drift_threshold() == 0.5
+    monkeypatch.setenv("KEYSTONE_DRIFT_THRESHOLD", "nope")
+    with pytest.raises(ValueError, match="float"):
+        drift_threshold()
+    monkeypatch.setenv("KEYSTONE_DRIFT_THRESHOLD", "-1")
+    with pytest.raises(ValueError, match="> 0"):
+        drift_threshold()
+
+
+def test_defer_env_validation(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_NUMERICS_DEFER", "0")
+    with pytest.raises(ValueError, match=">= 1"):
+        HealthMonitor("s")
+    monkeypatch.setenv("KEYSTONE_NUMERICS_DEFER", "x")
+    with pytest.raises(ValueError, match="integer"):
+        HealthMonitor("s")
+
+
+# -- the fence stays clean (acceptance) ---------------------------------------
+
+def test_health_reductions_add_zero_post_warmup_compiles():
+    """The PR 9 fence: with numerics ON, a second epoch of a
+    fixed-shape streamed fit compiles NOTHING — the health word and
+    sketch programs are module-global and warm up during chunk 1 of
+    epoch 1, before the fit fence arms."""
+    from keystone_tpu.observability import (
+        compile_observatory,
+        expect_no_compiles,
+    )
+
+    X, Y = _xy(n=256, d=16)
+
+    def epoch():
+        return fit_streaming(
+            LinearMapEstimator(lam=0.1),
+            StreamingDataset.from_numpy(X, chunk_size=64, tag="fence"),
+            Y)
+
+    epoch()
+    obs = compile_observatory()
+    before = obs.unexpected_total()
+    with expect_no_compiles("numerics-fence-test"):
+        model = epoch()
+    assert obs.unexpected_total() == before
+    assert model.numerics_baseline is not None  # the plane really ran
+
+
+# -- post-mortem CLI + sampler probe ------------------------------------------
+
+def test_postmortem_report_renders_health_series(capsys):
+    m = HealthMonitor("cli-stream", defer=1)
+    m.observe(0, np.ones((4,), np.float32))
+    m.observe(1, np.array([np.nan, 1.0], np.float32))
+    with pytest.raises(NumericsError) as exc:
+        m.flush()
+    assert postmortem_report([exc.value.postmortem_path]) == 0
+    out = capsys.readouterr().out
+    assert "numerics_tripwire" in out
+    assert "health series" in out and "cli-stream" in out
+    assert "nan_total=1" in out
+
+
+def test_postmortem_report_bad_inputs(capsys):
+    assert postmortem_report([]) == 1
+    assert postmortem_report(["/nonexistent/x.json"]) == 1
+
+
+def test_sampler_publishes_health_age():
+    from keystone_tpu.observability.sampler import TelemetrySampler
+
+    values = TelemetrySampler(interval_s=0.1).sample_once()
+    assert values["numerics.health_age_s"] == -1.0  # plane not run yet
+    m = HealthMonitor("age", defer=1)
+    m.observe(0, np.ones((2,), np.float32))
+    m.flush()
+    values = TelemetrySampler(interval_s=0.1).sample_once()
+    assert 0.0 <= values["numerics.health_age_s"] < 60.0

@@ -42,6 +42,13 @@ Three layers, all hermetic (no data, no device buffers):
      the transfer ships 4x the bytes; ship the source dtype and let
      the device cast (``StreamingDataset`` ``wire_dtype`` /
      ``compute_dtype``).
+   - ``silent-nan-silencer`` (numeric compute trees — ``nodes/``,
+     ``ops/``, ``parallel/``, ``workflow/``): a ``nan_to_num`` or
+     ``np.errstate(...='ignore')`` suppression must pair with a
+     recorded ``numerics.*`` event in the same scope
+     (``record_numerics_event`` / the solver-ledger recorders) —
+     suppression can be the right recovery, but it must be ACCOUNTED
+     (observability/numerics.py, README 'Numerics health').
    - ``metric-name-drift`` (tree-wide): every
      ``counter/gauge/histogram/timer(...)`` call site must use a name
      (or f-string prefix) from the catalogue in
@@ -126,11 +133,13 @@ def _host_coercions_in(fdef: ast.FunctionDef):
 def run_ast_rules() -> int:
     from keystone_tpu.analysis.diagnostics import (
         CAST_BEFORE_TRANSFER_SCOPES,
+        NAN_SILENCER_SCOPES,
         SWALLOW_ALL_SCOPES,
         donation_hazards,
         float_casts_before_transfer,
         metric_name_drift,
         recompile_hazards,
+        silent_nan_silencers,
         swallow_all_handlers,
     )
 
@@ -171,6 +180,16 @@ def run_ast_rules() -> int:
                       "ingest/workflow code silently loses failures; "
                       "narrow the exception type, or route it through "
                       "the resilience layer (RetryPolicy/Quarantine)")
+                failures += 1
+        if rel.parts[:1] == ("keystone_tpu",) and \
+                rel.parts[1] in NAN_SILENCER_SCOPES:
+            for lineno, what in silent_nan_silencers(tree):
+                print(f"{rel}:{lineno}: silent-nan-silencer: {what} "
+                      "with no recorded numerics event in scope — "
+                      "suppressing non-finites without accounting hides "
+                      "real breakdowns; pair it with "
+                      "record_numerics_event(...) (observability/"
+                      "numerics.py, README 'Numerics health')")
                 failures += 1
         if rel.parts[:1] == ("keystone_tpu",) and \
                 rel.parts[1] in CAST_BEFORE_TRANSFER_SCOPES:
