@@ -30,6 +30,12 @@
 #                                  one fault-injected NaN chunk must raise
 #                                  NumericsError naming chunk+stream with
 #                                  a post-mortem carrying the health series
+#   2b''. elastic gate             tools/elastic_gate.py — a 2-process CPU
+#                                  dryrun streamed fit (jax.distributed +
+#                                  gloo); process 1 killed mid-stream by a
+#                                  host_death fault, world relaunched,
+#                                  resumed from the shared StreamCheckpoint;
+#                                  resumed weights must be bit-identical
 #   2c. bounded-seed stress        the deterministic-interleaving suite
 #                                  (tests/test_concurrency_sched.py):
 #                                  historical-race regression schedules +
@@ -104,6 +110,16 @@ if (( run_tests )); then
   # streamed path with a deterministic kind="corrupt" fault injection
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     "$PY" "$KEYSTONE_HOME/tools/numerics_gate.py"
+
+  echo "== ci: elastic gate (kill one host mid-fit, relaunch, resume) =="
+  # the dynamic pin for the elastic multi-host plane
+  # (tools/elastic_gate.py): a 2-process CPU dryrun streamed fit over
+  # real jax.distributed + gloo — process 1 is killed mid-stream by a
+  # host_death fault, the world relaunches, resumes from the shared
+  # StreamCheckpoint, and the resumed weights must be bit-identical to
+  # the uninterrupted run with the warmup fence clean throughout
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    "$PY" "$KEYSTONE_HOME/tools/elastic_gate.py"
 
   echo "== ci: bounded-seed concurrency stress (regression schedules + fuzz) =="
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \

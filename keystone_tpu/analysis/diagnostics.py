@@ -558,20 +558,41 @@ def non_streamable_fit_lint(analysis: Analysis) -> List[Diagnostic]:
         ]
         if not any(streamed):
             continue
+        # a process-shard-local source (stream_tar_shards) means the
+        # stream holds one HOST's share: name it, so the diagnostic
+        # (and the materialize() suggestion, which would materialize a
+        # fraction of the data) reads correctly on a multi-host graph
+        sharded = any(
+            isinstance(analysis.value(d), DatasetSpec)
+            and analysis.value(d).sharded
+            for d in deps
+        )
+        kind = "shard-local streaming" if sharded else "streaming"
         if not is_streamable(op):
+            hint = (
+                "Use a streamable estimator (LeastSquares family, "
+                "StandardScaler) or materialize() the stream "
+                "explicitly if it fits (fix-hint: README 'Streaming "
+                "ingest' / 'Resilience' document the streaming fit "
+                "and checkpoint/resume API)")
+            if sharded:
+                hint = (
+                    "Use a streamable estimator (LeastSquares family, "
+                    "StandardScaler): the elastic multi-host fit "
+                    "tree-reduces its carries across hosts, while "
+                    "materialize() would materialize only THIS host's "
+                    "shard (fix-hint: CLUSTER.md 'Elastic resume' / "
+                    "README 'Resilience' document the distributed "
+                    "streaming fit)")
             out.append(Diagnostic(
                 code="non-streamable-fit", severity=SEVERITY_ERROR,
                 node_id=n.id, operator=op.label(),
                 message=(
-                    f"estimator {op.label()!r} fits on a streaming "
+                    f"estimator {op.label()!r} fits on a {kind} "
                     "dataset but implements no accumulate(carry, chunk"
                     "[, labels])/finalize(carry) protocol; the fit "
                     "would have to materialize the whole stream in "
-                    "HBM. Use a streamable estimator (LeastSquares "
-                    "family, StandardScaler) or materialize() the "
-                    "stream explicitly if it fits (fix-hint: README "
-                    "'Streaming ingest' / 'Resilience' document the "
-                    "streaming fit and checkpoint/resume API)")))
+                    f"HBM. {hint}")))
         elif not streamed[0]:
             # streamable estimator, but only a NON-data dependency
             # (labels) streams: the chunk loop is driven by the data

@@ -94,6 +94,15 @@ class DatasetSpec(AbstractValue):
     (:class:`~keystone_tpu.analysis.resources.StreamGeometry`) the HBM
     planner folds into the pipeline plan; None for opaque sources whose
     chunk shape cannot be described without consuming the stream.
+
+    ``sharded`` marks a PROCESS-SHARD-LOCAL stream (built by e.g.
+    ``loaders.image_loader_utils.stream_tar_shards``): under a
+    multi-host world, ``n`` is THIS host's share of the records, not
+    the dataset size, and only the distributed ``fit_streaming`` mode
+    (which tree-reduces carries across hosts) fits it correctly — the
+    ``non-streamable-fit`` family reports the sharded provenance so a
+    diagnostic about a 2-host stream never reads like a single-host
+    one.
     """
 
     element: Any
@@ -103,9 +112,12 @@ class DatasetSpec(AbstractValue):
     streaming: bool = False
     wire_dtype: Optional[str] = None
     geometry: Optional[Any] = None
+    sharded: bool = False
 
     def __repr__(self) -> str:
         flag = ", streaming" if self.streaming else ""
+        if self.sharded:
+            flag += ", sharded"
         if self.wire_dtype is not None:
             flag += f", wire={self.wire_dtype}"
         return (f"DatasetSpec(n={self.n}, "
@@ -205,7 +217,8 @@ def dataset_spec(ds: Dataset) -> AbstractValue:
             element, n=ds.n, host=False,
             sparsity=None if element_has_unknown(element) else 1.0,
             streaming=True, wire_dtype=ds.wire_dtype_name(),
-            geometry=ds.plan_geometry())
+            geometry=ds.plan_geometry(),
+            sharded=bool(getattr(ds, "process_sharded", False)))
     if isinstance(ds, HostDataset):
         items = ds.items
         if not items:

@@ -375,6 +375,48 @@ def stream_tar_images(
         quarantine=quarantine, **stream_kw)
 
 
+def stream_tar_shards(data_path: str, chunk_size: int,
+                      **stream_kw):
+    """Per-host SHARD-LOCAL tar streaming: this process's
+    ``process_index``-strided share of the archives under ``data_path``
+    (:func:`list_archive_paths`) fed through :func:`stream_tar_images`
+    on the host-local mesh — the ingest half of the elastic multi-host
+    streamed fit (``parallel.distributed``; each host decodes only its
+    own shards, carries tree-reduce at finalize).
+
+    The returned stream is tagged ``tarshard:h<process>/<world>`` and
+    marked ``process_sharded`` (the static analyzer reports the flag,
+    and ``fit_streaming``'s distributed mode is the only fit that
+    understands a shard-local ``n``: the stream's row count is THIS
+    host's share, not the dataset's). Keyword arguments pass through to
+    :func:`stream_tar_images` (``prepare=``, ``wire_dtype=``,
+    ``quarantine=``, ...); the mesh defaults to
+    :func:`~keystone_tpu.parallel.mesh.local_mesh` so staging never
+    targets another host's devices. Single-process this degrades to a
+    plain full-listing tar stream.
+
+    An empty share raises at listing time
+    (:func:`list_archive_paths`): repack the data into at least
+    ``process_count`` archives — silent empty hosts would surface as a
+    collective hang far from the cause.
+    """
+    from ..parallel.distributed import process_count, process_index
+    from ..parallel.mesh import local_mesh
+
+    paths = list_archive_paths(data_path, process_shard=True)
+    pid, nproc = process_index(), process_count()
+    if "mesh" not in stream_kw and nproc > 1:
+        stream_kw["mesh"] = local_mesh()
+    stream = stream_tar_images(paths, chunk_size, **stream_kw)
+    stream.tag = f"tarshard:h{pid}/{nproc}"
+    #: consumed by analysis.spec.dataset_spec: the stream's n (when it
+    #: pins) is a PER-HOST share, and the non-streamable-fit family
+    #: reports the sharded provenance in its diagnostics
+    stream.process_sharded = True
+    stream.shard_archives = list(paths)
+    return stream
+
+
 def load_tar_files(
     archive_paths: Sequence[str],
     labels_map: Callable[[str], object],

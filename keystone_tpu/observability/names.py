@@ -63,6 +63,13 @@ METRIC_NAMES: FrozenSet[str] = frozenset({
     "numerics.drift_score",      # gauge: latest apply-vs-fit PSI max
     "numerics.health_age_s",     # gauge (sampler probe): seconds since
                                  # the last health word was pulled
+    # parallel/distributed.py — cross-host chunk-step coordination
+    # (PR 11): the elastic multi-host streamed-fit plane
+    "coord.world_size",      # gauge: jax process count of the live world
+    "coord.rounds_total",    # counter: coordination rounds completed
+    "coord.barrier_wait_s",  # histogram: time spent waiting for peers
+                             # at a round boundary / named barrier — a
+                             # persistently hot host here is a straggler
 })
 
 #: catalogued name FAMILIES: a dynamic metric name must start with one

@@ -1,4 +1,5 @@
-"""Distributed-collection substrate: mesh, datasets, streaming ingest."""
+"""Distributed-collection substrate: mesh, datasets, streaming ingest,
+and elastic multi-process coordination (:mod:`.distributed`)."""
 from .dataset import (
     ArrayDataset,
     Dataset,
@@ -8,17 +9,29 @@ from .dataset import (
     ensure_array,
     to_numpy,
 )
+from .distributed import (
+    DryrunWorld,
+    WorldCoordinator,
+    is_distributed,
+    process_count,
+    process_index,
+)
 from .streaming import StreamingDataset, fit_streaming, is_streamable
 
 __all__ = [
     "ArrayDataset",
     "Dataset",
+    "DryrunWorld",
     "HostDataset",
     "StreamingDataset",
+    "WorldCoordinator",
     "as_dataset",
     "device_nbytes",
     "ensure_array",
     "fit_streaming",
+    "is_distributed",
     "is_streamable",
+    "process_count",
+    "process_index",
     "to_numpy",
 ]

@@ -1,0 +1,386 @@
+"""Elastic multi-process coordination for streamed fits, and the
+CPU dryrun launcher that gives CI a real ``jax.distributed`` world.
+
+The reference framework inherited its cluster story from Spark: RDD
+lineage recomputes a lost executor's partitions, so a KeystoneML fit
+survives node loss without ever naming the mechanism (Zaharia et al.,
+NSDI'12). The TPU port's SPMD runtime is gang-scheduled — a lost host
+kills the step — so elasticity has to be built from the pieces PR 4
+already proved single-process: replayable sharded sources, additive
+carries, and the ``StreamCheckpoint`` cursor. This module supplies the
+cross-process half:
+
+* **world introspection** — :func:`process_index` /
+  :func:`process_count` / :func:`is_distributed` (all safe
+  single-process, where they report ``0 / 1 / False``);
+
+* :class:`WorldCoordinator` — the chunk-step coordination the
+  distributed ``fit_streaming`` loop runs on. Hosts accumulate their
+  shard-local chunks independently and meet at ROUND boundaries (every
+  ``checkpoint_every`` chunks): one fixed-shape allgather exchanges
+  ``(cursor, done)`` so every host executes the same round count — a
+  host whose shard exhausts early idles in the barrier instead of
+  leaving the others' collectives unmatched — and, at finalize, the
+  Gram/moment/sketch carries tree-reduce across hosts
+  (:meth:`WorldCoordinator.merge_carries`, the
+  ``DriftBaseline.merge()`` shape: gather once, sum in process order);
+
+* **the dryrun launcher** — :class:`DryrunWorld` spawns N CPU
+  processes (each with its own virtual-device count) wired through the
+  same ``--coordinator/--num-processes/--process-id`` contract
+  ``python -m keystone_tpu`` exposes, watches for a dead member (a
+  ``host_death`` fault injection, an organic crash), and can kill and
+  relaunch the world — which is exactly what the
+  kill-one-host-mid-fit resume tests and ``tools/elastic_gate.py``
+  drive. On CPU the collectives run over gloo
+  (:func:`~keystone_tpu.parallel.mesh.initialize_distributed` selects
+  it automatically).
+
+Coordination telemetry: ``coord.world_size`` gauge,
+``coord.rounds_total`` counter, and the ``coord.barrier_wait_s``
+histogram (time a host spent waiting for its peers at a round
+boundary — a persistently hot host here IS the straggler the
+``kind="straggler"`` fault simulates). Every coordination round is
+also a named fault-injection site (``coord.step``), so the host-level
+fault kinds (``host_death`` / ``partition`` / ``straggler``) exercise
+the real coordination path.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..observability.metrics import MetricsRegistry
+from ..observability.timeline import record_span
+from ..resilience.faults import HOST_DEATH_EXIT_CODE, inject
+
+
+def process_index() -> int:
+    """This process's SPMD index (0 when single-process)."""
+    import jax
+
+    try:
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+def process_count() -> int:
+    """World size (1 when ``jax.distributed`` was never initialized)."""
+    import jax
+
+    try:
+        return int(jax.process_count())
+    except Exception:
+        return 1
+
+
+def is_distributed() -> bool:
+    return process_count() > 1
+
+
+@dataclass(frozen=True)
+class WorldState:
+    """What one coordination round learned about the world."""
+
+    round: int
+    cursors: Tuple[int, ...]    # per-host local chunk cursor
+    dones: Tuple[bool, ...]     # per-host "my shard is exhausted"
+    carries: Tuple[bool, ...]   # per-host "I hold a (restored or
+                                # accumulated) carry" — lets every host
+                                # detect an empty peer shard TOGETHER
+                                # instead of one raising while the rest
+                                # wedge in the finalize collective
+    all_done: bool
+
+
+class WorldCoordinator:
+    """Round-based chunk-step coordination for one distributed
+    streamed fit. One instance per fit; every method is a COLLECTIVE —
+    all hosts must call it the same number of times in the same order
+    (the SPMD contract), which the ``fit_streaming`` round loop
+    guarantees by construction."""
+
+    def __init__(self, tag: str = "stream"):
+        self.pid = process_index()
+        self.nproc = process_count()
+        self.tag = tag
+        self.rounds = 0
+        MetricsRegistry.get_or_create().gauge(
+            "coord.world_size").set(self.nproc)
+
+    # -- the per-round collective ------------------------------------------
+    def step(self, cursor: int, done: bool,
+             has_carry: bool = True) -> WorldState:
+        """Exchange ``(cursor, done, has_carry)`` with every peer. The
+        allgather is fixed-shape ((3,) int64), so it compiles exactly
+        once — round 2 onward is collective-only, which is what lets
+        the PR 9 warmup fence stay armed across rounds on the
+        distributed path."""
+        inject("coord.step", context=f"{self.tag}:round{self.rounds}")
+        from jax.experimental.multihost_utils import process_allgather
+
+        t0 = time.perf_counter()
+        gathered = np.asarray(process_allgather(
+            np.array([int(cursor), 1 if done else 0,
+                      1 if has_carry else 0], np.int64)))
+        wait_s = time.perf_counter() - t0
+        reg = MetricsRegistry.get_or_create()
+        reg.histogram("coord.barrier_wait_s").observe(wait_s)
+        reg.counter("coord.rounds_total").inc()
+        record_span(f"coord:{self.tag}", "coord", t0, wait_s,
+                    args={"round": self.rounds, "cursor": int(cursor)})
+        state = WorldState(
+            round=self.rounds,
+            cursors=tuple(int(c) for c in gathered[:, 0]),
+            dones=tuple(bool(d) for d in gathered[:, 1]),
+            carries=tuple(bool(c) for c in gathered[:, 2]),
+            all_done=bool(gathered[:, 1].all()))
+        self.rounds += 1
+        return state
+
+    def barrier(self, name: str) -> None:
+        """A named world barrier. Names must come from a FIXED set per
+        call site (the underlying collective is one compiled program
+        reused across rounds — a per-round name would recompile and
+        trip the warmup fence)."""
+        from jax.experimental.multihost_utils import sync_global_devices
+
+        t0 = time.perf_counter()
+        sync_global_devices(f"keystone-{name}")
+        MetricsRegistry.get_or_create().histogram(
+            "coord.barrier_wait_s").observe(time.perf_counter() - t0)
+
+    # -- finalize-time reductions ------------------------------------------
+    def merge_carries(self, carry: Any,
+                      reducer: Optional[Callable[[List[Any]], Any]] = None
+                      ) -> Any:
+        """Tree-reduce the estimator carries across hosts (the
+        ``DriftBaseline.merge()`` shape): gather every host's carry
+        once, then fold in PROCESS ORDER — deterministic, so a resumed
+        world merges to bit-identical state. The default fold is a
+        per-leaf sum, correct for every additive carry in the tree
+        (Gram/cross/sums, moments); an estimator with a non-additive
+        carry supplies ``reducer(per_host_carries)``."""
+        import jax
+
+        from jax.experimental.multihost_utils import process_allgather
+
+        host_carry = jax.tree_util.tree_map(np.asarray, carry)
+        gathered = process_allgather(host_carry)
+        if reducer is not None:
+            per_host = [jax.tree_util.tree_map(lambda g, p=p: g[p], gathered)
+                        for p in range(self.nproc)]
+            return reducer(per_host)
+        return jax.tree_util.tree_map(lambda g: g.sum(axis=0), gathered)
+
+    def merge_baselines(self, baseline: Any) -> Any:
+        """Merge per-host drift sketches
+        (:class:`~keystone_tpu.observability.numerics.DriftBaseline`)
+        into one world baseline. Bin geometry is pinned per host from
+        its own chunk 1, so hosts whose observed ranges differ carry
+        incompatible edges; those fold as host 0's geometry with the
+        incompatible hosts SKIPPED and the shortfall recorded as a
+        ``numerics.drift_merge`` event (merged/hosts counts) — honest
+        partial coverage, never a silently wrong histogram sum. Every
+        host computes the identical merge from the same gathered
+        states, so the fitted baseline is replicated."""
+        from jax.experimental.multihost_utils import process_allgather
+
+        from ..observability.numerics import (
+            DriftBaseline,
+            record_numerics_event,
+        )
+
+        st = baseline.state()
+        gathered = process_allgather({
+            "cols": np.asarray(st["cols"]),
+            "interior": np.asarray(st["interior"]),
+            "counts": np.asarray(st["counts"]),
+            "rows": np.asarray(float(st["rows"])),
+        })
+        counts = np.array(gathered["counts"][0], np.float32)
+        rows = float(gathered["rows"][0])
+        merged = 1
+        for p in range(1, self.nproc):
+            if (np.array_equal(gathered["cols"][p], gathered["cols"][0])
+                    and np.array_equal(gathered["interior"][p],
+                                       gathered["interior"][0])):
+                counts += gathered["counts"][p]
+                rows += float(gathered["rows"][p])
+                merged += 1
+        record_numerics_event("drift_merge", source=self.tag,
+                              merged=merged, hosts=self.nproc)
+        return DriftBaseline(
+            cols=np.asarray(gathered["cols"][0], np.int32),
+            interior=np.asarray(gathered["interior"][0], np.float32),
+            counts=counts, rows=rows, source=baseline.source)
+
+
+# -- the dryrun launcher -----------------------------------------------------
+
+def free_coordinator_port() -> int:
+    """An OS-assigned free localhost port for the jax.distributed
+    coordinator (the dryrun worlds are all loopback)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+class DryrunWorld:
+    """Spawn, watch, kill, and relaunch an N-process CPU
+    ``jax.distributed`` world — the test/CI stand-in for a pod.
+
+    Every member runs the same command (SPMD) with the standard
+    positional contract ``<argv...> <process_id> <num_processes>
+    <coordinator_port>`` appended by :meth:`launch` (or, for apps, the
+    ``--coordinator/--num-processes/--process-id`` flags ``python -m
+    keystone_tpu`` already accepts — :meth:`launch_app`). Each member
+    gets ``devices_per_process`` virtual CPU devices via ``XLA_FLAGS``
+    and logs to its own file (no pipe deadlocks).
+
+    The watcher models gang scheduling: once ANY member exits, the
+    survivors are given ``grace_s`` to finish on their own (a clean
+    world drains within seconds) and are then terminated — a host loss
+    wedges its peers inside a collective, exactly like a real pod, and
+    the recovery story is relaunch-and-resume, not limping on.
+    """
+
+    def __init__(self, num_processes: int = 2, devices_per_process: int = 2,
+                 workdir: Optional[str] = None, grace_s: float = 20.0,
+                 env: Optional[dict] = None):
+        import tempfile
+
+        self.num_processes = int(num_processes)
+        self.devices_per_process = int(devices_per_process)
+        self.grace_s = float(grace_s)
+        self.workdir = workdir or tempfile.mkdtemp(prefix="keystone-dryrun-")
+        self.extra_env = dict(env or {})
+        self.port: Optional[int] = None
+        self.procs: List[subprocess.Popen] = []
+        self._log_paths: List[str] = []
+        self._launches = 0
+
+    # -- process management ------------------------------------------------
+    def _member_env(self) -> dict:
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                            f"{self.devices_per_process}")
+        root = _repo_root()
+        env["PYTHONPATH"] = root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        env.update(self.extra_env)
+        return env
+
+    def launch(self, argv: Sequence[str],
+               per_process_argv: Optional[Callable[[int, int, int],
+                                                   List[str]]] = None
+               ) -> "DryrunWorld":
+        """Start all members. ``argv`` is the common command prefix
+        (e.g. ``[sys.executable, "-m",
+        "keystone_tpu.parallel.dryrun_worker", ...flags...]``); each
+        member appends ``<process_id> <num_processes> <port>``. Pass
+        ``per_process_argv(pid, nproc, port) -> argv`` to build each
+        member's full command yourself instead (how :meth:`launch_app`
+        wires the CLI flags)."""
+        if self.procs and any(p.poll() is None for p in self.procs):
+            raise RuntimeError("world is already running; wait() or "
+                               "kill() it before relaunching")
+        self.port = free_coordinator_port()
+        self._launches += 1
+        env = self._member_env()
+        self.procs = []
+        self._log_paths = []
+        for pid in range(self.num_processes):
+            if per_process_argv is not None:
+                cmd = per_process_argv(pid, self.num_processes, self.port)
+            else:
+                cmd = list(argv) + [str(pid), str(self.num_processes),
+                                    str(self.port)]
+            log_path = os.path.join(
+                self.workdir, f"launch{self._launches}.p{pid}.log")
+            self._log_paths.append(log_path)
+            with open(log_path, "wb") as log:
+                self.procs.append(subprocess.Popen(
+                    cmd, stdout=log, stderr=subprocess.STDOUT, env=env))
+        return self
+
+    def launch_app(self, app: str, args: Sequence[str] = ()) -> "DryrunWorld":
+        """Launch a registered ``python -m keystone_tpu`` app across
+        the world through the CLI's own multi-host wiring."""
+        def per_process(pid: int, nproc: int, port: int) -> List[str]:
+            return [sys.executable, "-m", "keystone_tpu", app,
+                    "--coordinator", f"127.0.0.1:{port}",
+                    "--num-processes", str(nproc),
+                    "--process-id", str(pid), *args]
+
+        return self.launch([], per_process_argv=per_process)
+
+    def wait(self, timeout_s: float = 300.0) -> List[int]:
+        """Block until the world drains, applying gang semantics: after
+        the first member exits, survivors get ``grace_s`` before being
+        terminated (return code then reflects the termination). Returns
+        per-member exit codes."""
+        deadline = time.monotonic() + timeout_s
+        first_exit: Optional[float] = None
+        while True:
+            codes = [p.poll() for p in self.procs]
+            if all(c is not None for c in codes):
+                return [int(c) for c in codes]
+            now = time.monotonic()
+            if first_exit is None and any(c is not None for c in codes):
+                first_exit = now
+            if first_exit is not None and now - first_exit > self.grace_s:
+                self.kill()
+            if now > deadline:
+                self.kill()
+                raise TimeoutError(
+                    f"dryrun world did not drain in {timeout_s:g}s "
+                    f"(exit codes so far: {codes}; logs under "
+                    f"{self.workdir})")
+            time.sleep(0.1)
+
+    def kill(self) -> None:
+        """Terminate every still-running member (SIGKILL — the point is
+        simulating machine loss, not graceful shutdown)."""
+        for p in self.procs:
+            if p.poll() is None:
+                p.kill()
+        for p in self.procs:
+            try:
+                p.wait(timeout=10)
+            except (subprocess.TimeoutExpired, OSError):
+                pass  # reaped best-effort; poll() callers see the truth
+
+    # -- results -----------------------------------------------------------
+    def output(self, pid: int) -> str:
+        if not self._log_paths:
+            return ""
+        with open(self._log_paths[pid], "rb") as f:
+            return f.read().decode(errors="replace")
+
+    def host_death_exits(self, codes: Sequence[int]) -> List[int]:
+        """Which members died of an injected ``host_death``
+        (:data:`~keystone_tpu.resilience.faults.HOST_DEATH_EXIT_CODE`)."""
+        return [i for i, c in enumerate(codes)
+                if c == HOST_DEATH_EXIT_CODE]
+
+    def __enter__(self) -> "DryrunWorld":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.kill()

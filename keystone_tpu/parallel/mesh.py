@@ -271,6 +271,23 @@ def shard_put(arr, sharding: NamedSharding, pool=None):
         arr.shape, sharding, shards)
 
 
+def local_mesh(model: int = 1) -> Mesh:
+    """A ('data', 'model') mesh over THIS process's addressable devices.
+
+    The multi-host streamed-ingest path
+    (:mod:`keystone_tpu.parallel.distributed`) is
+    shard-local-accumulate / cross-host-reduce-at-finalize: each host
+    stages only its own chunks, so the stream's mesh must contain only
+    devices this host can ``device_put`` to. A mesh over the GLOBAL
+    ``jax.devices()`` view (what :func:`get_mesh` lazily builds once
+    ``jax.distributed`` is live) would make every staging call try to
+    feed remote devices. Single-process, this is exactly the default
+    mesh."""
+    import jax
+
+    return make_mesh(jax.local_devices(), model=model)
+
+
 def initialize_distributed(coordinator_address=None, num_processes=None,
                            process_id=None):
     """Multi-host initialization (the DCN scale-out entry point): wires
@@ -281,11 +298,34 @@ def initialize_distributed(coordinator_address=None, num_processes=None,
     The reference's analogue is Spark cluster attach
     (``bin/run-pipeline.sh`` spark-submit); here every host runs the same
     program (SPMD) and the mesh spans the pod.
+
+    On the CPU backend (the dryrun harness, CI) cross-process
+    collectives need an explicit implementation — XLA's default CPU
+    client refuses multi-process computations outright — so this
+    selects ``gloo`` before the backend initializes unless the operator
+    pinned ``jax_cpu_collectives_implementation`` themselves.
     """
     import jax
 
     if getattr(jax.distributed, "is_initialized", lambda: False)():
         return
+    plat = (os.environ.get("JAX_PLATFORMS")
+            or jax.config.read("jax_platforms") or "")
+    # Select gloo when the platform is pinned to CPU, AND when it is
+    # unpinned (an unpinned CPU-only machine still defaults to the CPU
+    # backend, and would otherwise hit XLA's "multi-process
+    # computations aren't implemented" at the first collective). The
+    # knob only parameterizes CPU-client construction, so setting it
+    # under an accelerator backend is inert — but an explicit non-cpu
+    # pin is respected as the operator knowing better.
+    if not plat or "cpu" in str(plat):
+        try:
+            if jax.config.read(
+                    "jax_cpu_collectives_implementation") in (None, "none"):
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+        except (AttributeError, KeyError, ValueError):
+            pass  # older/newer jaxlib without the knob: leave defaults
     if coordinator_address is None:
         jax.distributed.initialize()  # env-driven (TPU pods)
     else:
@@ -294,3 +334,9 @@ def initialize_distributed(coordinator_address=None, num_processes=None,
             num_processes=num_processes,
             process_id=process_id,
         )
+    if jax.process_count() > 1:
+        # every resilience event now carries which HOST it fired on
+        # (announcement keeps the event funnel itself device-free)
+        from ..resilience.events import set_process_dimension
+
+        set_process_dimension(jax.process_index())

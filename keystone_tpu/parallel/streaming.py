@@ -100,6 +100,7 @@ from .mesh import (
     h2d_pool as _h2d_pool,
     h2d_workers,
     num_data_shards,
+    replicated_sharding,
     replication_factor,
     shard_put,
 )
@@ -351,6 +352,20 @@ class StreamingDataset(Dataset):
         if prefetch_depth < 1:
             raise ValueError("prefetch_depth must be >= 1")
         self.mesh = mesh or get_mesh()
+        if jax.process_count() > 1 and any(
+                d.process_index != jax.process_index()
+                for d in self.mesh.devices.flat):
+            # multi-host ingest is shard-local: every chunk this host
+            # stages must land on devices this host owns. A global mesh
+            # here means the caller skipped the distributed recipe.
+            raise ValueError(
+                "StreamingDataset mesh contains devices owned by other "
+                "processes: multi-host streamed ingest is shard-local — "
+                "each host stages only its own chunks onto its own "
+                "devices and fit_streaming tree-reduces the carries at "
+                "finalize. Build the stream under "
+                "parallel.mesh.local_mesh() (see CLUSTER.md 'Elastic "
+                "resume').")
         # every chunk pads to one fixed shape: a shard-divisible row
         # count means ONE compiled program per chain serves all chunks
         self.chunk_size = _round_up(int(chunk_size),
@@ -406,6 +421,11 @@ class StreamingDataset(Dataset):
         # the static plan follows the shared ledger: a derived view's
         # residency IS the root's prefetch pipeline
         out.__dict__["_plan_geometry"] = self.plan_geometry
+        if getattr(self, "process_sharded", False):
+            # a featurized view of a shard-local source is still
+            # shard-local (the analyzer reports the flag; n stays a
+            # per-host share)
+            out.process_sharded = True
         return out
 
     def map(self, fn: Callable[[Any], Any]) -> "StreamingDataset":
@@ -1022,7 +1042,38 @@ def _paired_chunks(data: StreamingDataset,
         raise ValueError(
             f"misaligned labels: the data stream yielded {off} rows but "
             f"len(labels)={host.shape[0]} — refusing to silently "
-            "truncate")
+            "truncate. If the stream shrank because corrupt records "
+            "were quarantined (check stream.quarantine.summary()), drop "
+            "the matching label rows first with "
+            "resilience.quarantine.drop_quarantined_rows(labels, "
+            "record_keys, stream.quarantine); otherwise pair the stream "
+            "with labels derived from the same decode pass")
+
+
+def _restore_carry(host_carry: Any, mesh: Mesh) -> Any:
+    """Put a checkpoint's host-side carry back EXACTLY where a live
+    carry sits: array leaves replicated on the chunk mesh (the same
+    ``NamedSharding(mesh, P())`` the zero inits use), 0-d leaves back
+    to host scalars. jax's jit cache keys on input shardings, so a
+    resumed fit whose first accumulate saw a raw numpy carry would
+    compile a SECOND program — one unexpected compile under the warmup
+    fence, on every resume (the same placement discipline
+    ``SketchTracker.restore`` already applies to the drift counts)."""
+    sh = replicated_sharding(mesh)
+
+    def put(leaf):
+        arr = np.asarray(leaf)
+        if arr.ndim == 0 and np.issubdtype(arr.dtype, np.integer):
+            # the host int (n) the driver loop reads — live carries
+            # keep it a Python int. A 0-d FLOAT leaf stays a device
+            # array: collapsing it to a weak-typed Python float would
+            # change the resumed accumulate's jit signature (and its
+            # promotion semantics), exactly the miss this helper
+            # prevents.
+            return arr.item()
+        return jax.device_put(arr, sh)
+
+    return jax.tree_util.tree_map(put, host_carry)
 
 
 def fit_streaming(estimator: Any, data: StreamingDataset,
@@ -1061,6 +1112,22 @@ def fit_streaming(estimator: Any, data: StreamingDataset,
     checkpoint save copies the carry to HOST (``np.asarray``) before
     the next accumulate donates it, which is what keeps kill-and-resume
     bit-identical with donation on.
+
+    **Elastic multi-host mode** (engaged automatically under a live
+    ``jax.distributed`` world, :mod:`keystone_tpu.parallel.distributed`):
+    ``data`` is this host's SHARD-LOCAL stream on a
+    :func:`~keystone_tpu.parallel.mesh.local_mesh` (each host decodes
+    and stages only its own shards), hosts meet every
+    ``checkpoint_every`` chunks in a fixed-shape coordination round —
+    same round count on every host, coordinated snapshots written as
+    per-host sidecars folded by host 0 into ONE world snapshot in the
+    (shared) ``checkpoint_dir`` — and at finalize the carries
+    tree-reduce across hosts so every host solves the same merged
+    carry into bit-identical weights. A killed world relaunched at the
+    SAME size resumes each host from its recorded cursor
+    (bit-identical with the uninterrupted run); a different world size
+    raises ``CheckpointMismatchError``. CLUSTER.md "Elastic resume"
+    is the runbook.
     """
     if not is_streamable(estimator):
         raise _non_streamable_error(estimator)
@@ -1090,6 +1157,19 @@ def fit_streaming(estimator: Any, data: StreamingDataset,
         # a stream built by a quarantining loader carries its own
         # (stream_tar_images); use it so checkpoints keep the accounting
         quarantine = getattr(data, "quarantine", None)
+    tag = data.tag or "stream"
+    # elastic multi-host mode (parallel.distributed): under a live
+    # jax.distributed world each host accumulates its SHARD-LOCAL
+    # stream and the hosts meet at round boundaries — coordinated
+    # checkpoints, same round count everywhere, carries tree-reduced
+    # at finalize (CLUSTER.md "Elastic resume")
+    world = None
+    from .distributed import is_distributed
+
+    if is_distributed():
+        from .distributed import WorldCoordinator
+
+        world = WorldCoordinator(tag=tag)
     ckpt = None
     fingerprint = None
     start_chunk = 0
@@ -1107,10 +1187,12 @@ def fit_streaming(estimator: Any, data: StreamingDataset,
             raise ValueError("checkpoint_every must be >= 1")
         fingerprint = fit_fingerprint(estimator, data, labels)
         ckpt = StreamCheckpoint(checkpoint_dir)
-        snap = ckpt.load(fingerprint)
+        snap = (ckpt.load(fingerprint) if world is None
+                else ckpt.load_world(fingerprint, world.pid, world.nproc))
         if snap is not None:
             start_chunk = int(snap["cursor"])
-            carry = snap["carry"]
+            carry = (None if snap["carry"] is None
+                     else _restore_carry(snap["carry"], data.mesh))
             if quarantine is not None and snap.get("quarantine"):
                 quarantine.restore(snap["quarantine"])
             numerics_state = snap.get("numerics")
@@ -1118,7 +1200,6 @@ def fit_streaming(estimator: Any, data: StreamingDataset,
     chunks_seen = 0
     idx = -1
     reg = MetricsRegistry.get_or_create()
-    tag = data.tag or "stream"
     # the numerics plane (observability/numerics.py): one fused health
     # word per chunk (deferred D2H, tripwire on non-finite) and the
     # drift-baseline feature sketch, both riding the accumulate pass —
@@ -1135,84 +1216,171 @@ def fit_streaming(estimator: Any, data: StreamingDataset,
 
     obs = compile_observatory()
     fence_armed = False
+
+    def accumulate_one(chunk, lchunk):
+        """Fold one chunk into the carry: the shared per-chunk body of
+        the single-process loop and the distributed round loop."""
+        nonlocal carry, chunks_seen
+        t_acc = time.perf_counter()
+        try:
+            if takes_labels:
+                carry = estimator.accumulate(carry, chunk, lchunk)
+            else:
+                carry = estimator.accumulate(carry, chunk)
+        except Exception as exc:
+            if is_device_oom(exc):
+                # the allocator failed mid-accumulate: the dump must
+                # say WHICH executables' argument/output/temp bytes
+                # held HBM, so resolve per-executable
+                # memory_analysis tables into it (AOT, no execution)
+                raise attach_postmortem(
+                    exc, "device_oom",
+                    {"source": tag, "phase": "accumulate",
+                     "chunk": idx},
+                    capture_executables=True)
+            raise
+        # the compute lane of a streamed fit's flight timeline (host
+        # wall of the accumulate dispatch — jax async work continues
+        # past it, which is exactly the overlap the lanes show)
+        record_span(f"accumulate:{tag}", "compute", t_acc,
+                    time.perf_counter() - t_acc, args={"chunk": idx})
+        if monitor is not None:
+            # one small device reduction per chunk; the host pull
+            # is deferred `monitor.defer` chunks so it never stalls
+            # the ingest/compute overlap. Raises NumericsError
+            # (with a post-mortem) on a non-finite chunk. The mask
+            # keeps a zero-padded ragged tail out of the series'
+            # min/mean/var.
+            monitor.observe(idx, chunk.data,
+                            None if lchunk is None else lchunk.data,
+                            mask=chunk.mask)
+        if sketch is not None:
+            sketch.update(chunk)
+        reg.gauge("streaming.carry_bytes").set(sum(
+            float(getattr(leaf, "nbytes", 0) or 0)
+            for leaf in jax.tree_util.tree_leaves(carry)))
+        chunks_seen += 1
+        if hbm_budget is not None:
+            resident = data.buffered_nbytes()
+            if resident > hbm_budget:
+                raise attach_postmortem(MemoryError(
+                    f"streamed fit exceeded its HBM budget: "
+                    f"{resident:.0f} B resident > {hbm_budget:.0f} B "
+                    f"(chunk {chunks_seen}; shrink chunk_size or "
+                    "prefetch_depth)"),
+                    "hbm_budget",
+                    {"source": tag, "phase": "runtime",
+                     "resident_nbytes": resident,
+                     "hbm_budget": hbm_budget, "chunk": chunks_seen},
+                    capture_executables=True)
+
+    def snapshot_states():
+        if monitor is not None:
+            # drain pending health words first: a snapshot must
+            # never capture a carry poisoned by a chunk whose
+            # word was still in flight (the save syncs the
+            # carry to host anyway, so this adds no new bubble)
+            monitor.flush()
+        return (None if quarantine is None else quarantine.state(),
+                None if sketch is None else sketch.state())
+
     try:
-        for chunk, lchunk in _paired_chunks(data, labels):
-            idx += 1
-            if idx < start_chunk:
-                continue  # resume replay: already folded into the carry
-            t_acc = time.perf_counter()
-            try:
-                if takes_labels:
-                    carry = estimator.accumulate(carry, chunk, lchunk)
-                else:
-                    carry = estimator.accumulate(carry, chunk)
-            except Exception as exc:
-                if is_device_oom(exc):
-                    # the allocator failed mid-accumulate: the dump must
-                    # say WHICH executables' argument/output/temp bytes
-                    # held HBM, so resolve per-executable
-                    # memory_analysis tables into it (AOT, no execution)
-                    raise attach_postmortem(
-                        exc, "device_oom",
-                        {"source": tag, "phase": "accumulate",
-                         "chunk": idx},
-                        capture_executables=True)
-                raise
-            # the compute lane of a streamed fit's flight timeline (host
-            # wall of the accumulate dispatch — jax async work continues
-            # past it, which is exactly the overlap the lanes show)
-            record_span(f"accumulate:{tag}", "compute", t_acc,
-                        time.perf_counter() - t_acc, args={"chunk": idx})
-            if monitor is not None:
-                # one small device reduction per chunk; the host pull
-                # is deferred `monitor.defer` chunks so it never stalls
-                # the ingest/compute overlap. Raises NumericsError
-                # (with a post-mortem) on a non-finite chunk. The mask
-                # keeps a zero-padded ragged tail out of the series'
-                # min/mean/var.
-                monitor.observe(idx, chunk.data,
-                                None if lchunk is None else lchunk.data,
-                                mask=chunk.mask)
-            if sketch is not None:
-                sketch.update(chunk)
-            reg.gauge("streaming.carry_bytes").set(sum(
-                float(getattr(leaf, "nbytes", 0) or 0)
-                for leaf in jax.tree_util.tree_leaves(carry)))
-            chunks_seen += 1
-            if hbm_budget is not None:
-                resident = data.buffered_nbytes()
-                if resident > hbm_budget:
-                    raise attach_postmortem(MemoryError(
-                        f"streamed fit exceeded its HBM budget: "
-                        f"{resident:.0f} B resident > {hbm_budget:.0f} B "
-                        f"(chunk {chunks_seen}; shrink chunk_size or "
-                        "prefetch_depth)"),
-                        "hbm_budget",
-                        {"source": tag, "phase": "runtime",
-                         "resident_nbytes": resident,
-                         "hbm_budget": hbm_budget, "chunk": chunks_seen},
-                        capture_executables=True)
-            if ckpt is not None and (idx + 1) % checkpoint_every == 0:
-                if monitor is not None:
-                    # drain pending health words first: a snapshot must
-                    # never capture a carry poisoned by a chunk whose
-                    # word was still in flight (the save syncs the
-                    # carry to host anyway, so this adds no new bubble)
-                    monitor.flush()
-                ckpt.save(fingerprint, idx + 1, carry,
-                          None if quarantine is None
-                          else quarantine.state(),
-                          numerics=None if sketch is None
-                          else sketch.state())
-            if chunks_seen == 1 and not fence_armed:
-                # per-chunk compile fence: every later chunk shares this
-                # chunk's padded shape, so steady state must compile
-                # NOTHING (the PR 3 zero-recompile invariant, asserted
-                # dynamically) — any compile recorded from here to the
-                # last chunk is classified unexpected, named with its
-                # signature delta
-                obs.arm_fence(f"fit_streaming:{tag}")
-                fence_armed = True
+        if world is None:
+            for chunk, lchunk in _paired_chunks(data, labels):
+                idx += 1
+                if idx < start_chunk:
+                    continue  # resume replay: already folded in
+                accumulate_one(chunk, lchunk)
+                if ckpt is not None and (idx + 1) % checkpoint_every == 0:
+                    q_state, n_state = snapshot_states()
+                    ckpt.save(fingerprint, idx + 1, carry, q_state,
+                              numerics=n_state)
+                if chunks_seen == 1 and not fence_armed:
+                    # per-chunk compile fence: every later chunk shares
+                    # this chunk's padded shape, so steady state must
+                    # compile NOTHING (the PR 3 zero-recompile
+                    # invariant, asserted dynamically) — any compile
+                    # recorded from here to the last chunk is
+                    # classified unexpected, named with its signature
+                    # delta
+                    obs.arm_fence(f"fit_streaming:{tag}")
+                    fence_armed = True
+        else:
+            # the distributed round loop: every host folds up to
+            # round_len shard-local chunks, then ALL hosts meet in one
+            # fixed-shape coordination collective — so every host runs
+            # the same round count (a host whose shard exhausts early
+            # idles at the barrier) and the collectives always match
+            # up. Coordinated checkpoints happen at round boundaries:
+            # sidecar per host, barrier, world snapshot by host 0,
+            # barrier — a consistent cut a relaunched world resumes
+            # from.
+            round_len = (16 if checkpoint_every is None
+                         else int(checkpoint_every))
+            chunk_iter = _paired_chunks(data, labels)
+            local_done = False
+            last_world_cursors = None  # cursors at the last snapshot
+            last_saved_cursor = None   # THIS host's last sidecar write
+            final_state = None
+            while True:
+                in_round = 0
+                while in_round < round_len and not local_done:
+                    try:
+                        chunk, lchunk = next(chunk_iter)
+                    except StopIteration:
+                        local_done = True
+                        break
+                    idx += 1
+                    if idx < start_chunk:
+                        continue  # resume replay: already folded in
+                    accumulate_one(chunk, lchunk)
+                    in_round += 1
+                state = world.step(cursor=idx + 1, done=local_done,
+                                   has_carry=carry is not None)
+                # a checkpoint round runs only when SOME host made
+                # progress since the last snapshot — every host decides
+                # from the same gathered cursors, so the barriers below
+                # stay matched; an already-done host rejoins them
+                # without re-pickling its unchanged state to shared
+                # storage every round its straggling peers keep working
+                if ckpt is not None and state.cursors != last_world_cursors:
+                    if last_saved_cursor != idx + 1:
+                        q_state, n_state = snapshot_states()
+                        ckpt.save_host(fingerprint, world.pid, idx + 1,
+                                       carry, q_state, numerics=n_state)
+                        last_saved_cursor = idx + 1
+                    world.barrier("ckpt-sidecars")
+                    if world.pid == 0:
+                        ckpt.merge_hosts(world.nproc)
+                    world.barrier("ckpt-world")
+                    last_world_cursors = state.cursors
+                if not fence_armed and chunks_seen >= 1:
+                    # the distributed fence arms after the FIRST round:
+                    # by then the per-chunk programs AND the
+                    # fixed-shape coordination collectives (step
+                    # allgather, checkpoint barriers) have all
+                    # compiled, so every later round must compile
+                    # nothing — the PR 9 invariant, now held across
+                    # process boundaries
+                    obs.arm_fence(f"fit_streaming:{tag}")
+                    fence_armed = True
+                if state.all_done:
+                    final_state = state
+                    break
+            if not all(final_state.carries):
+                # an empty peer shard: every host learned it from the
+                # same step exchange, so every host raises the SAME
+                # error here — one host raising unilaterally would
+                # leave its peers wedged in the finalize collective
+                empty = [p for p, c in enumerate(final_state.carries)
+                         if not c]
+                raise ValueError(
+                    f"empty stream: host(s) {empty} of {world.nproc} "
+                    f"produced no chunks for {tag!r} — every host must "
+                    "own at least one chunk (repack the data into >= "
+                    "process_count shards, or shrink the world; "
+                    "loaders.image_loader_utils.list_archive_paths "
+                    "raises the same condition at listing time)")
     finally:
         if fence_armed:
             obs.disarm_fence()
@@ -1222,7 +1390,20 @@ def fit_streaming(estimator: Any, data: StreamingDataset,
         # plausible-looking garbage weights
         monitor.flush()
     if carry is None:
+        # world mode already raised the collective empty-shard error
+        # above (every host together, from the same step exchange)
         raise ValueError("empty stream: nothing to fit")
+    if world is not None:
+        # the cross-host tree-reduce (the DriftBaseline.merge() shape,
+        # ROADMAP item 2): gather every host's shard-local carry once
+        # and fold in process order — Gram/cross/moment carries are
+        # additive, so the merged carry equals the one a single host
+        # would have accumulated over the whole dataset (to f32
+        # rounding), and every host finalizes the SAME merged carry
+        # into bit-identical weights. Estimators with non-additive
+        # carries provide merge_carries(per_host_carries).
+        carry = world.merge_carries(
+            carry, reducer=getattr(estimator, "merge_carries", None))
     model = estimator.finalize(carry)
     # finalize-side tripwire: the solver recovery paths guarantee
     # finite weights, so a non-finite fitted array here is always a bug
@@ -1231,6 +1412,13 @@ def fit_streaming(estimator: Any, data: StreamingDataset,
     if sketch is not None:
         baseline = sketch.baseline()
         if baseline is not None:
+            if world is not None:
+                # per-host sketches fold into one world baseline where
+                # bin geometries agree (they were pinned per host from
+                # local chunk 1); incompatible hosts are skipped with
+                # the shortfall recorded — see
+                # WorldCoordinator.merge_baselines
+                baseline = world.merge_baselines(baseline)
             try:
                 # rides the fitted model into saved-pipeline artifacts:
                 # apply-time drift scoring needs the fit-time sketch
@@ -1241,7 +1429,15 @@ def fit_streaming(estimator: Any, data: StreamingDataset,
                 "fit_baseline", source=tag, rows=baseline.rows,
                 cols=int(len(baseline.cols)))
     if ckpt is not None:
-        ckpt.clear()
+        if world is not None:
+            # all hosts must be past their finalize before the shared
+            # snapshot disappears (a host crashing here would otherwise
+            # find nothing to resume); host 0 owns the shared files
+            world.barrier("finalize-clear")
+            if world.pid == 0:
+                ckpt.clear()
+        else:
+            ckpt.clear()
     trace = current_trace()
     if trace is not None:
         # close the plan-vs-measured loop: the static plan rides the
@@ -1253,5 +1449,6 @@ def fit_streaming(estimator: Any, data: StreamingDataset,
             "static_plan_nbytes": static_plan,
             "peak_device_nbytes": float(data.peak_device_nbytes),
             "hbm_budget": hbm_budget,
+            "processes": 1 if world is None else world.nproc,
         })
     return model

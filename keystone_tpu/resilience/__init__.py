@@ -21,18 +21,30 @@ surface:
   (cursor, carry, quarantine) state, bit-comparable with an
   uninterrupted run; mismatched config fingerprints refuse to resume.
 * :mod:`.faults` — :class:`FaultPlan`/:func:`inject`: a seeded,
-  deterministic fault-injection harness at named ingest sites, so every
-  guarantee above has a test that exercises the real code path.
+  deterministic fault-injection harness at named ingest sites — record
+  and chunk kinds plus the HOST-LEVEL kinds (``host_death`` /
+  ``partition`` / ``straggler``, gated per ``process_id``) the elastic
+  multi-host dryrun harness (:mod:`keystone_tpu.parallel.distributed`)
+  kills worlds with — so every guarantee above has a test that
+  exercises the real code path.
 
 All events flow through :mod:`.events` into ``resilience.*`` metrics
 counters and the active :class:`~keystone_tpu.observability.PipelineTrace`.
 """
-from .events import record_event
-from .faults import FaultPlan, FaultSpec, InjectedFaultError, inject
+from .events import record_event, set_process_dimension
+from .faults import (
+    HOST_DEATH_EXIT_CODE,
+    FaultPlan,
+    FaultSpec,
+    InjectedFaultError,
+    PartitionError,
+    inject,
+)
 from .quarantine import (
     CorruptRecordError,
     Quarantine,
     QuarantineBudgetExceededError,
+    drop_quarantined_rows,
 )
 from .retry import (
     AttemptTimeoutError,
@@ -51,6 +63,7 @@ from .stream_checkpoint import (
 
 __all__ = [
     "AttemptTimeoutError",
+    "HOST_DEATH_EXIT_CODE",
     "CheckpointCorruptError",
     "CheckpointMismatchError",
     "CorruptRecordError",
@@ -58,8 +71,11 @@ __all__ = [
     "FaultSpec",
     "IngestTimeoutError",
     "InjectedFaultError",
+    "PartitionError",
     "Quarantine",
     "QuarantineBudgetExceededError",
+    "drop_quarantined_rows",
+    "set_process_dimension",
     "RetryExhaustedError",
     "RetryPolicy",
     "StreamCheckpoint",

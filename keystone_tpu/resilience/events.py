@@ -30,9 +30,34 @@ def record_event(event: str, **fields: Any) -> None:
     """Count ``resilience.<event>``, mark it on the flight recorder's
     timeline (an instant event on whichever thread it fired from — a
     retry storm or watchdog trip lands next to the ingest spans it
-    interrupted), and trace the structured entry."""
+    interrupted), and trace the structured entry.
+
+    Under a live ``jax.distributed`` world every event additionally
+    carries a ``process_id`` dimension (which HOST retried / died /
+    checkpointed — N hosts funnel into one post-mortem narrative, so
+    unattributed events are useless there). Single-process events stay
+    exactly as before: no field, no lookup cost beyond one cached
+    read."""
+    if _PROCESS_ID is not None and "process_id" not in fields:
+        fields["process_id"] = _PROCESS_ID
     MetricsRegistry.get_or_create().counter(f"resilience.{event}").inc()
     record_instant(event, "resilience", args=fields or None)
     trace = current_trace()
     if trace is not None:
         trace.record_resilience({"event": event, **fields})
+
+
+#: set once by parallel.mesh.initialize_distributed when a real world
+#: comes up (announcement, not lookup: consulting jax.process_count()
+#: here would drag backend initialization into a metrics funnel that
+#: must stay device-free); None = single-process, no field emitted
+_PROCESS_ID = None
+
+
+def set_process_dimension(process_id) -> None:
+    """Declare this process's SPMD index so every later resilience
+    event carries ``process_id``. Called by ``initialize_distributed``
+    after ``jax.distributed`` wires the world; pass None to clear
+    (tests)."""
+    global _PROCESS_ID
+    _PROCESS_ID = None if process_id is None else int(process_id)

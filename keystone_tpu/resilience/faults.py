@@ -13,6 +13,8 @@ site                  where
 ``ingest.decode``     per image decode attempt (tar decode pool)
 ``ingest.stage``      per chunk ``device_put`` staging (prefetcher)
 ``ingest.produce``    per chunk in the prefetch producer loop
+``coord.step``        per cross-host coordination round
+                      (``parallel.distributed.WorldCoordinator.step``)
 ====================  =====================================================
 
 ``inject`` is a single global read when no plan is active — zero cost
@@ -32,6 +34,23 @@ site consults the plan's specs:
   chunk k" failure the numerics tripwire
   (:mod:`keystone_tpu.observability.numerics`) exists to catch; pass
   ``mutate=`` for other corruptions.
+
+**Host-level (process-granular) kinds** — the elastic multi-host story
+(:mod:`keystone_tpu.parallel.distributed`) needs faults that take out a
+PROCESS, not a record. Every spec takes ``process_id=``: when set, the
+rule fires only on that ``jax.process_index()`` (None = every host),
+so one plan installed identically on every SPMD worker — the dryrun
+harness's contract — still kills exactly one host:
+
+* ``kind="host_death"`` hard-exits the process via ``os._exit``
+  (:data:`HOST_DEATH_EXIT_CODE`) — the SIGKILL-a-host simulation the
+  kill-one-host-mid-fit resume tests and ``tools/elastic_gate.py``
+  are built on; nothing is flushed, exactly like a real kill,
+* ``kind="partition"`` raises :class:`PartitionError` (a
+  ``ConnectionError`` flavor: retryable at ingest sites, fatal at a
+  coordination site — the surviving world relaunches and resumes),
+* ``kind="straggler"`` sleeps ``delay_s`` (default 0.25 s) per fired
+  visit — one slow host holding back every coordination barrier.
 
 Injection is deterministic: ``rate`` draws come from the plan's seeded
 RNG, and ``after``/``count`` give exact "fail once, after the k-th
@@ -56,18 +75,48 @@ class InjectedFaultError(TransientError):
     it. Pass ``error=`` to :meth:`FaultPlan.add` for other flavors."""
 
 
+class PartitionError(ConnectionError):
+    """An injected network partition (``kind="partition"``): the host
+    can run but cannot reach its peers. ``ConnectionError`` is in
+    ``DEFAULT_RETRYABLE``, so an ingest-site partition retries like a
+    flaky NFS mount; at a coordination site it kills the step and the
+    world recovers by relaunch-and-resume."""
+
+
+#: the exit status a ``kind="host_death"`` injection dies with — the
+#: dryrun launcher and the elastic gate assert on it to distinguish a
+#: deliberately killed host from an organic crash
+HOST_DEATH_EXIT_CODE = 117
+
+_KINDS = ("error", "latency", "hang", "corrupt",
+          "host_death", "partition", "straggler")
+
+
+def _process_index() -> int:
+    """This process's SPMD index (0 when jax.distributed was never
+    initialized — the single-process case)."""
+    import jax
+
+    try:
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
 @dataclass
 class FaultSpec:
     """One injection rule at one site."""
 
     site: str
     kind: str = "error"          # error | latency | hang | corrupt
+                                 # | host_death | partition | straggler
     rate: float = 1.0            # per-visit injection probability
     after: int = 0               # skip the first `after` visits entirely
     count: Optional[int] = None  # at most this many injections
     error: Optional[Callable[[str], BaseException]] = None
     delay_s: float = 0.05        # latency duration / hang cap
     mutate: Optional[Callable[[Any], Any]] = None  # corrupt transform
+    process_id: Optional[int] = None  # only this jax.process_index fires
     visits: int = field(default=0, compare=False)
     injected: int = field(default=0, compare=False)
 
@@ -122,15 +171,22 @@ class FaultPlan:
     def add(self, site: str, kind: str = "error", rate: float = 1.0,
             after: int = 0, count: Optional[int] = None,
             error: Optional[Callable[[str], BaseException]] = None,
-            delay_s: float = 0.05,
-            mutate: Optional[Callable[[Any], Any]] = None) -> "FaultPlan":
-        if kind not in ("error", "latency", "hang", "corrupt"):
+            delay_s: Optional[float] = None,
+            mutate: Optional[Callable[[Any], Any]] = None,
+            process_id: Optional[int] = None) -> "FaultPlan":
+        if kind not in _KINDS:
             raise ValueError(f"unknown fault kind {kind!r}")
         if not 0.0 < rate <= 1.0:
             raise ValueError("rate must be in (0, 1]")
+        if delay_s is None:
+            # a straggler is a sustained slowdown, not an I/O blip — a
+            # visibly larger default than the latency spike's 50 ms
+            delay_s = 0.25 if kind == "straggler" else 0.05
         spec = FaultSpec(site=site, kind=kind, rate=rate, after=int(after),
                          count=count, error=error, delay_s=float(delay_s),
-                         mutate=mutate)
+                         mutate=mutate,
+                         process_id=(None if process_id is None
+                                     else int(process_id)))
         self._specs.setdefault(site, []).append(spec)
         return self
 
@@ -165,6 +221,9 @@ class FaultPlan:
         for spec in specs:
             if spec.kind == "corrupt":
                 continue  # value-carrying rule: fires via corrupt()
+            if (spec.process_id is not None
+                    and spec.process_id != _process_index()):
+                continue  # host-gated rule, dormant on this process
             with self._lock:
                 spec.visits += 1
                 if spec.visits <= spec.after:
@@ -178,7 +237,7 @@ class FaultPlan:
                                  "context": context})
             record_event("fault_injected", site=site, kind=spec.kind,
                          context=str(context))
-            if spec.kind == "latency":
+            if spec.kind in ("latency", "straggler"):
                 time.sleep(spec.delay_s)
             elif spec.kind == "hang":
                 deadline = time.perf_counter() + spec.delay_s
@@ -186,6 +245,22 @@ class FaultPlan:
                        and not (abort is not None and abort())
                        and time.perf_counter() < deadline):
                     pass
+            elif spec.kind == "host_death":
+                # simulate SIGKILL of this host: no flushing, no exit
+                # handlers, no goodbye to the coordination service —
+                # the surviving world observes a dead peer exactly as
+                # it would for a real machine loss
+                import os as _os
+                import sys as _sys
+
+                print(f"FAULT host_death at {site} "
+                      f"(process {_process_index()}, {context})",
+                      file=_sys.stderr, flush=True)
+                _os._exit(HOST_DEATH_EXIT_CODE)
+            elif spec.kind == "partition":
+                raise PartitionError(
+                    f"injected network partition at {site} "
+                    f"(process {_process_index()}, {context})")
             else:
                 exc = (spec.error(f"injected fault at {site} ({context})")
                        if spec.error is not None else
@@ -204,6 +279,9 @@ class FaultPlan:
         for spec in specs:
             if spec.kind != "corrupt":
                 continue
+            if (spec.process_id is not None
+                    and spec.process_id != _process_index()):
+                continue  # host-gated rule, dormant on this process
             with self._lock:
                 spec.visits += 1
                 if spec.visits <= spec.after:

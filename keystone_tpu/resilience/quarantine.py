@@ -184,3 +184,49 @@ class Quarantine:
         return (f"quarantine[{self.label}]: {self.bad_count} bad / "
                 f"{self.seen()} seen "
                 f"(budget {self.max_bad_fraction:g})")
+
+    def quarantined_keys(self) -> set:
+        """The source identities quarantined so far (a snapshot)."""
+        with self._lock:
+            return set(self._keys)
+
+
+def drop_quarantined_rows(labels: Any, record_keys: Any,
+                          quarantine: "Quarantine") -> Any:
+    """Align resident labels with a quarantine-shrunk stream.
+
+    Quarantined records are SKIPPED by the ingest path, so a stream
+    backed by a tar with corrupt members yields fewer rows than labels
+    sized for the full record count — and ``fit_streaming`` then
+    (correctly) refuses with its misalignment error rather than
+    silently truncating, because nothing says WHICH rows went missing.
+    This helper says which: given the per-record source identities in
+    stream order (``record_keys``, e.g. ``f"{tar}::{member}"`` for
+    every member the labels were built for), it drops exactly the label
+    rows whose key sits in the quarantine manifest.
+
+    ``labels`` is a numpy-like ``(n, ...)`` array (or anything
+    ``np.asarray`` accepts) with one row per entry of ``record_keys``;
+    the return value keeps only rows whose record decoded::
+
+        stream = stream_tar_images([tar], chunk_size)
+        rows = sum(c.n for c in stream.chunks())   # quarantine filled
+        y = drop_quarantined_rows(y_full, keys, stream.quarantine)
+        model = fit_streaming(est, stream, y, quarantine=stream.quarantine)
+
+    The quarantine must already hold the bad records (run one pass, or
+    reuse a manifest restored via :meth:`Quarantine.restore`) — this is
+    a pure row filter, it never decodes anything itself.
+    """
+    import numpy as np
+
+    arr = np.asarray(labels)
+    keys = [str(k) for k in record_keys]
+    if arr.shape[0] != len(keys):
+        raise ValueError(
+            f"labels have {arr.shape[0]} rows but {len(keys)} record "
+            "keys were given — record_keys must name every record the "
+            "labels were built for, in stream order")
+    bad = quarantine.quarantined_keys()
+    keep = np.array([k not in bad for k in keys], dtype=bool)
+    return arr[keep]

@@ -62,7 +62,17 @@ class RetryExhaustedError(RuntimeError):
 class IngestTimeoutError(RuntimeError):
     """The streaming consumer's producer watchdog tripped: the source
     produced no chunk within its deadline (hung disk/decoder/producer).
-    Raised instead of blocking the fit forever."""
+    Raised instead of blocking the fit forever.
+
+    Defaults, for the operator reading this out of a post-mortem:
+    ``stall_timeout_s`` on :class:`~keystone_tpu.parallel.streaming.\
+StreamingDataset` defaults to **None = no deadline** — a hung-but-alive
+    source blocks like a plain queue (a DEAD producer thread still
+    raises immediately, deadline or not). Set it to ~10x the worst
+    healthy inter-chunk gap (the ``streaming.ingest_stall_s`` histogram
+    p99 is the evidence) to convert hangs into this error; the retry
+    layer's own per-attempt knob is ``attempt_timeout_s`` (also default
+    None) on the :class:`RetryPolicy` printed in the message."""
 
 
 #: worth retrying by default: explicit transients, timeouts, and generic
@@ -109,6 +119,18 @@ class RetryPolicy:
         # guarded (utils.guarded.GUARDED_FIELDS declares _rng -> _lock)
         self._rng = np.random.RandomState(seed)
         self._lock = TracedLock("retry.jitter")
+
+    def __repr__(self) -> str:
+        """One line naming the policy in force — post-mortems and logs
+        print retry policies, and an opaque ``<RetryPolicy object at
+        0x...>`` tells an operator nothing about why a fit waited
+        ~``backoff_s * multiplier^k`` between failures."""
+        timeout = ("none" if self.attempt_timeout_s is None
+                   else f"{self.attempt_timeout_s:g}s")
+        return (f"RetryPolicy(attempts={self.max_attempts}, "
+                f"backoff={self.backoff_s:g}s*{self.multiplier:g}^k"
+                f"<={self.max_backoff_s:g}s, jitter={self.jitter:g}, "
+                f"attempt_timeout={timeout})")
 
     # -- classification ----------------------------------------------------
     def is_retryable(self, exc: BaseException) -> bool:
@@ -182,7 +204,9 @@ class RetryPolicy:
             RetryExhaustedError(site, self.max_attempts, last),
             "retry_exhausted",
             {"site": site, "attempts": self.max_attempts,
-             "last_error": f"{type(last).__name__}: {last}"}) from last
+             "last_error": f"{type(last).__name__}: {last}",
+             # the one-line policy identity: which knobs were in force
+             "policy": repr(self)}) from last
 
 
 #: shared default policy: 3 attempts, 50 ms base backoff. Module-level

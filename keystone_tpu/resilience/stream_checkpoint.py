@@ -20,6 +20,18 @@ checkpointing it is cheap (O(d*(d+k)), not O(n)) and resume is exact:
   :class:`CheckpointMismatchError` instead of silently folding new
   chunks into a stale carry.
 
+**Distributed mode** (:mod:`keystone_tpu.parallel.distributed`): an
+N-process streamed fit checkpoints as one WORLD snapshot in a shared
+directory — each host atomically writes a per-host sidecar (its own
+cursor, carry, quarantine and drift-sketch state) at a coordination
+round boundary, a barrier makes every sidecar durable, then host 0
+folds them into the world snapshot (``save_host`` / ``merge_hosts`` /
+``load_world``). The snapshot records the process TOPOLOGY, and the
+fingerprint folds it too: a relaunched world resumes only at the SAME
+world size — a 2-host snapshot loaded by a 4-host (or single-process)
+fit raises :class:`CheckpointMismatchError` naming both sizes, because
+per-host cursors are meaningless under a different shard partition.
+
 Truncated/corrupt snapshot files raise :class:`CheckpointCorruptError`
 (shared with :mod:`keystone_tpu.utils.checkpoint`) naming the path.
 """
@@ -109,6 +121,14 @@ def fit_fingerprint(estimator: Any, data: Any,
     excluded: they change scheduling, not results, so a resume may
     tune them.
 
+    Under a live ``jax.distributed`` world the PROCESS TOPOLOGY is
+    part of the identity too: each host's snapshot cursor counts ITS
+    shard's chunks, so a resume at a different world size would replay
+    a different partition of the data against a carry accumulated
+    under the old one. The world size folds in here (and
+    ``StreamCheckpoint.load_world`` additionally checks the recorded
+    topology explicitly, so the refusal names both sizes).
+
     Honest limit: the fingerprint cannot see STREAM content without
     consuming the stream. Swapping the records behind an identical
     source tag / chunk size (or behind streamed labels) between kill
@@ -153,6 +173,10 @@ def fit_fingerprint(estimator: Any, data: Any,
         "compute_dtype": _policy_name("compute_dtype_name"),
         "labels": labels_key,
     }
+    from ..parallel.distributed import process_count
+
+    if process_count() > 1:
+        parts["topology"] = {"processes": process_count()}
     blob = json.dumps(parts, sort_keys=True, default=str)
     return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
@@ -194,6 +218,28 @@ class StreamCheckpoint:
         }, self.path)
         record_event("checkpoint_save", path=self.path, cursor=int(cursor))
 
+    def _read_blob(self, path: str) -> Dict[str, Any]:
+        """Read + format-validate one snapshot/sidecar file (shared by
+        the single-process and world load paths)."""
+        try:
+            with open(path, "rb") as f:
+                blob = pickle.load(f)
+        except Exception as exc:
+            raise CheckpointCorruptError(
+                f"stream checkpoint {path!r} is truncated or "
+                f"corrupt ({type(exc).__name__}: {exc}); delete it to "
+                "start the fit from scratch") from exc
+        if not (isinstance(blob, dict) and blob.get("magic") == self.MAGIC):
+            raise CheckpointCorruptError(
+                f"{path!r} is not a keystone stream checkpoint "
+                "(missing format header); delete it to start over")
+        if blob.get("version") != self.VERSION:
+            raise CheckpointCorruptError(
+                f"stream checkpoint {path!r} has format version "
+                f"{blob.get('version')!r}, this build reads "
+                f"{self.VERSION}; delete it to start over")
+        return blob
+
     def load(self, fingerprint: str) -> Optional[Dict[str, Any]]:
         """The last snapshot, or None when none exists. Corrupt files
         raise :class:`CheckpointCorruptError`; a fingerprint mismatch
@@ -201,23 +247,18 @@ class StreamCheckpoint:
         or resumes wrong state)."""
         if not os.path.exists(self.path):
             return None
-        try:
-            with open(self.path, "rb") as f:
-                blob = pickle.load(f)
-        except Exception as exc:
-            raise CheckpointCorruptError(
-                f"stream checkpoint {self.path!r} is truncated or "
-                f"corrupt ({type(exc).__name__}: {exc}); delete it to "
-                "start the fit from scratch") from exc
-        if not (isinstance(blob, dict) and blob.get("magic") == self.MAGIC):
-            raise CheckpointCorruptError(
-                f"{self.path!r} is not a keystone stream checkpoint "
-                "(missing format header); delete it to start over")
-        if blob.get("version") != self.VERSION:
-            raise CheckpointCorruptError(
-                f"stream checkpoint {self.path!r} has format version "
-                f"{blob.get('version')!r}, this build reads "
-                f"{self.VERSION}; delete it to start over")
+        blob = self._read_blob(self.path)
+        topo = blob.get("topology")
+        if topo is not None:
+            raise CheckpointMismatchError(
+                f"stream checkpoint {self.path!r} was written by a "
+                f"{topo.get('processes')}-process world; a "
+                "single-process fit cannot resume it — per-host "
+                "cursors only make sense under the original shard "
+                "partition. Relaunch at world size "
+                f"{topo.get('processes')} (CLUSTER.md 'Elastic "
+                "resume'), or delete the checkpoint directory to "
+                "start over")
         if blob.get("fingerprint") != fingerprint:
             raise CheckpointMismatchError(
                 f"stream checkpoint {self.path!r} was written by a "
@@ -230,10 +271,109 @@ class StreamCheckpoint:
                      cursor=int(blob["cursor"]))
         return blob
 
+    # -- distributed (world) snapshots -------------------------------------
+    def host_path(self, process_id: int) -> str:
+        """This host's sidecar file (same directory as the world
+        snapshot — the directory must be shared storage, which the
+        resume contract requires anyway)."""
+        base, ext = os.path.splitext(self.path)
+        return f"{base}.host{int(process_id)}{ext}"
+
+    def save_host(self, fingerprint: str, process_id: int, cursor: int,
+                  carry: Any,
+                  quarantine_state: Optional[Dict[str, Any]] = None,
+                  numerics: Optional[Dict[str, Any]] = None) -> None:
+        """One host's contribution to a coordinated snapshot: cursor +
+        carry + quarantine/drift state, written atomically to the
+        host's sidecar. The caller (the distributed ``fit_streaming``
+        round loop) barriers after every host has written, then host 0
+        folds the sidecars via :meth:`merge_hosts` — so the world
+        snapshot is always a CONSISTENT cut at a round boundary."""
+        import jax
+
+        host_carry = jax.tree_util.tree_map(np.asarray, carry)
+        atomic_pickle_dump({
+            "magic": self.MAGIC, "version": self.VERSION,
+            "fingerprint": fingerprint, "process_id": int(process_id),
+            "cursor": int(cursor), "carry": host_carry,
+            "quarantine": quarantine_state, "numerics": numerics,
+        }, self.host_path(process_id))
+        record_event("checkpoint_save", path=self.host_path(process_id),
+                     cursor=int(cursor))
+
+    def merge_hosts(self, processes: int) -> None:
+        """Fold every host sidecar into THE world snapshot (host 0
+        only, after the sidecar barrier). The snapshot holds per-host
+        cursors/carries/quarantine manifests plus the topology, so a
+        relaunched world restores each host's exact position — and a
+        DIFFERENT world size is refused before any state is touched."""
+        hosts = []
+        for p in range(int(processes)):
+            blob = self._read_blob(self.host_path(p))
+            hosts.append({k: blob.get(k) for k in
+                          ("fingerprint", "cursor", "carry", "quarantine",
+                           "numerics")})
+        atomic_pickle_dump({
+            "magic": self.MAGIC, "version": self.VERSION,
+            # no world-level fingerprint: hosts may legitimately differ
+            # (per-shard source tags), so identity is checked per host
+            # slice at load_world — a derived digest here would imply a
+            # cross-host-consistency check that doesn't exist
+            "topology": {"processes": int(processes)},
+            "hosts": hosts,
+        }, self.path)
+        record_event("checkpoint_save", path=self.path,
+                     cursor=min(int(h["cursor"]) for h in hosts),
+                     world=int(processes))
+
+    def load_world(self, fingerprint: str, process_id: int,
+                   processes: int) -> Optional[Dict[str, Any]]:
+        """This host's slice of the last world snapshot, or None when
+        none exists. Topology is checked FIRST: a snapshot from a
+        different world size (including a single-process one) raises
+        :class:`CheckpointMismatchError` naming both sizes."""
+        if not os.path.exists(self.path):
+            return None
+        blob = self._read_blob(self.path)
+        topo = blob.get("topology")
+        if topo is None:
+            raise CheckpointMismatchError(
+                f"stream checkpoint {self.path!r} was written by a "
+                f"single-process fit; a {int(processes)}-process world "
+                "cannot resume it — the shard partition differs. "
+                "Relaunch single-process, or delete the checkpoint "
+                "directory to start over")
+        if int(topo.get("processes", -1)) != int(processes):
+            raise CheckpointMismatchError(
+                f"stream checkpoint {self.path!r} was written by a "
+                f"{topo.get('processes')}-process world but this world "
+                f"has {int(processes)} processes; refusing to resume — "
+                "per-host cursors are only meaningful under the "
+                "original shard partition. Relaunch at world size "
+                f"{topo.get('processes')}, or delete the checkpoint "
+                "directory to start over (CLUSTER.md 'Elastic resume')")
+        host = blob["hosts"][int(process_id)]
+        if host.get("fingerprint") != fingerprint:
+            raise CheckpointMismatchError(
+                f"stream checkpoint {self.path!r} (host {process_id} "
+                f"slice) was written by a different fit configuration "
+                f"(fingerprint {host.get('fingerprint')!r} != "
+                f"{fingerprint!r}); refusing to resume. Delete the "
+                "checkpoint directory to start over, or restore the "
+                "original estimator/chunk-size/labels configuration")
+        record_event("checkpoint_restore", path=self.path,
+                     cursor=int(host["cursor"]))
+        return dict(host)
+
     def clear(self) -> None:
-        """Remove the snapshot after a successful finalize (a stale
-        snapshot must never seed an unrelated later fit)."""
-        try:
-            os.remove(self.path)
-        except FileNotFoundError:
-            pass
+        """Remove the snapshot (and any host sidecars) after a
+        successful finalize (a stale snapshot must never seed an
+        unrelated later fit)."""
+        import glob
+
+        base, ext = os.path.splitext(self.path)
+        for path in [self.path] + glob.glob(f"{base}.host*{ext}"):
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                pass

@@ -231,6 +231,7 @@ class TransformerOperator(Operator):
             # mapping a stream yields a stream (chunk-wise application)
             streaming=any(d.streaming for d in datasets),
             geometry=_shared_geometry(datasets),
+            sharded=any(d.sharded for d in datasets),
         )
 
 
@@ -316,7 +317,8 @@ class DelegatingOperator(Operator):
         return DatasetSpec(out, n=data[0].n, host=data[0].host,
                            sparsity=dense_sparsity(out),
                            streaming=data[0].streaming,
-                           geometry=_shared_geometry([data[0]]))
+                           geometry=_shared_geometry([data[0]]),
+                           sharded=data[0].sharded)
 
     def label(self) -> str:
         return "Delegate"

@@ -298,5 +298,6 @@ class HostTransformer(Transformer):
             # — at runtime this combination raises in apply_dataset
             return DatasetSpec(out.element, n=out.n, host=True,
                                sparsity=out.sparsity,
-                               streaming=out.streaming)
+                               streaming=out.streaming,
+                               sharded=out.sharded)
         return out

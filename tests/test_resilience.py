@@ -747,3 +747,84 @@ def test_ingest_and_workflow_tree_has_no_swallow_all_handlers():
             offenders += [(str(path), lineno, what)
                           for lineno, what in swallow_all_handlers(tree)]
     assert not offenders, offenders
+
+
+# -- quarantine/label alignment helper (ISSUE 11 satellite) ------------------
+
+def test_drop_quarantined_rows_pairs_corrupt_tar_with_full_labels(tmp_path):
+    """The PR 4 footgun, closed: a corrupt-member tar SHRINKS the
+    stream, so labels sized for the full member count (the natural way
+    to build them — one row per tar member) misalign. The misalignment
+    error now names drop_quarantined_rows; applying it makes the fit
+    succeed with exactly the surviving rows."""
+    from keystone_tpu.resilience import drop_quarantined_rows
+
+    n_images, corrupt_idx = 12, {4}
+    tar = _make_tar(tmp_path / "imgs.tar", n_images=n_images,
+                    corrupt=corrupt_idx)
+    # labels built for EVERY member, keyed the way the loader keys
+    # quarantine entries: "<tar>::<member>"
+    keys = [f"{tar}::img{i:03d}.png" for i in range(n_images)]
+    rng = np.random.RandomState(0)
+    y_full = rng.randn(n_images, 3).astype(np.float32)
+
+    def prepare(batch):
+        return np.stack([img for _, img in batch]).reshape(
+            len(batch), -1).astype(np.float32)
+
+    # pass 1: consume the stream so the quarantine fills, then prove
+    # the misalignment error points at the helper
+    stream = stream_tar_images([tar], chunk_size=4, prepare=prepare,
+                               quarantine=Quarantine(max_bad_fraction=0.5,
+                                                     min_records=1))
+    with pytest.raises(ValueError, match="drop_quarantined_rows"):
+        fit_streaming(LinearMapEstimator(lam=0.1), stream, y_full,
+                      quarantine=stream.quarantine)
+    assert stream.quarantine.bad_count == len(corrupt_idx)
+
+    # pass 2: drop the quarantined rows -> aligned fit succeeds
+    y_aligned = drop_quarantined_rows(y_full, keys, stream.quarantine)
+    assert y_aligned.shape[0] == n_images - len(corrupt_idx)
+    stream2 = stream_tar_images([tar], chunk_size=4, prepare=prepare,
+                                quarantine=stream.quarantine)
+    model = fit_streaming(LinearMapEstimator(lam=0.1), stream2, y_aligned,
+                          quarantine=stream2.quarantine)
+    assert np.isfinite(np.asarray(model.weights)).all()
+
+
+def test_drop_quarantined_rows_validates_key_count():
+    from keystone_tpu.resilience import drop_quarantined_rows
+
+    q = Quarantine()
+    with pytest.raises(ValueError, match="record keys"):
+        drop_quarantined_rows(np.zeros((4, 2)), ["a", "b"], q)
+
+
+# -- RetryPolicy repr (ISSUE 11 satellite) -----------------------------------
+
+def test_retry_policy_repr_names_the_policy_in_force():
+    """Post-mortems and logs print the policy; the repr must name the
+    effective attempts/backoff/timeout instead of an address."""
+    r = repr(RetryPolicy(max_attempts=5, backoff_s=0.1, multiplier=3.0,
+                         max_backoff_s=4.0, jitter=0.25,
+                         attempt_timeout_s=2.5))
+    assert "attempts=5" in r and "0.1s*3^k<=4s" in r
+    assert "jitter=0.25" in r and "attempt_timeout=2.5s" in r
+    assert "0x" not in r  # no memory addresses
+    assert "attempt_timeout=none" in repr(RetryPolicy())
+
+
+def test_retry_exhausted_postmortem_names_policy(tmp_path):
+    """The retry-exhausted post-mortem context carries the one-line
+    policy identity."""
+    policy = RetryPolicy(max_attempts=2, backoff_s=0.001)
+
+    def always_fails():
+        raise TransientError("nope")
+
+    with pytest.raises(RetryExhaustedError) as exc_info:
+        policy.call(always_fails, site="t")
+    pm = getattr(exc_info.value, "postmortem_path", None)
+    if pm:  # postmortem dumping enabled in this environment
+        blob = json.load(open(pm))
+        assert "attempts=2" in blob.get("context", {}).get("policy", "")
