@@ -5,16 +5,23 @@
 #   1. tools/lint.py --skip-apps   AST rules (host coercions, recompile
 #                                  hazards, donation safety, swallow-all,
 #                                  cast-before-transfer, the three
-#                                  concurrency pass families) + the
+#                                  concurrency pass families, the four
+#                                  SPMD-safety pass families:
+#                                  collective divergence, barrier/
+#                                  coordination-shape stability,
+#                                  collective axis bindings, world-
+#                                  checkpoint consistency) + the
 #                                  eval_shape donation shape gate (+ ruff
 #                                  if present)
 #   2. python -m keystone_tpu check --all --budget $KEYSTONE_CI_HBM_BUDGET
-#                                  abstract interpretation + graph lints +
+#                                  abstract interpretation + graph lints
+#                                  (incl. the sharding-flow lattice) +
 #                                  static HBM plans over every CHECK_APPS
 #                                  app + the concurrency scan + the
-#                                  metric-name-drift scan, device-free;
-#                                  exit 1 on diagnostics, exit 2 on a
-#                                  predicted budget violation
+#                                  metric-name-drift scan + the SPMD
+#                                  scan (the `spmd` key in --json),
+#                                  device-free; exit 1 on diagnostics,
+#                                  exit 2 on a predicted budget violation
 #   2a. benchdiff (ADVISORY)       classify the two newest artifacts of
 #                                  each family (BENCH_r*.json and
 #                                  MULTICHIP_r*.json) against per-metric

@@ -24,7 +24,10 @@ how to read one is documented in README "Numerics health".
 propagation, the graph lints, and the static HBM plan (see
 ``keystone_tpu/analysis``) — plus the tree-wide concurrency-safety
 scan (guarded-by races, lock-order cycles, blocking-under-lock;
-``analysis/concurrency.py``), without loading data or allocating a
+``analysis/concurrency.py``) and the tree-wide SPMD-safety scan
+(collective divergence, barrier/coordination-shape stability,
+collective axis bindings, world-checkpoint consistency;
+``analysis/spmd.py``), without loading data or allocating a
 device buffer, and exits non-zero if any diagnostic fires.
 ``--budget BYTES`` (``MiB``/``GiB`` suffixes accepted) gates each app
 on its planned fit-path peak and exits 2 on a predicted violation.
@@ -149,6 +152,7 @@ def check_main(rest) -> int:
 
     from keystone_tpu.analysis.concurrency import scan_package
     from keystone_tpu.analysis.diagnostics import scan_metric_names
+    from keystone_tpu.analysis.spmd import scan_package as scan_spmd
 
     pkg_root = pathlib.Path(__file__).resolve().parent
     concurrency = scan_package(pkg_root)
@@ -162,8 +166,17 @@ def check_main(rest) -> int:
     for hit in metrics_names:
         print(f"{hit['file']}:{hit['lineno']}: {hit['code']}: "
               f"{hit['message']}", file=sys.stderr)
+    # SPMD safety: collective divergence, barrier/coordination-shape
+    # stability, collective axis bindings, world-checkpoint
+    # consistency (analysis/spmd.py) — the multi-host runtime's
+    # correctness invariants, checked on every single-host CI run
+    spmd = scan_spmd(pkg_root)
+    for hit in spmd:
+        print(f"{hit['file']}:{hit['lineno']}: {hit['code']}: "
+              f"{hit['message']}", file=sys.stderr)
 
-    failed = (1 if concurrency else 0) + (1 if metrics_names else 0)
+    failed = ((1 if concurrency else 0) + (1 if metrics_names else 0)
+              + (1 if spmd else 0))
     over_budget = 0
     reports = []
     for build in builders:
@@ -196,6 +209,7 @@ def check_main(rest) -> int:
         print(f"{target.name}: {status}")
     print(f"concurrency: {'clean' if not concurrency else f'{len(concurrency)} diagnostic(s)'}")
     print(f"metrics names: {'clean' if not metrics_names else f'{len(metrics_names)} diagnostic(s)'}")
+    print(f"spmd: {'clean' if not spmd else f'{len(spmd)} diagnostic(s)'}")
     if json_out is not None:
         import json as _json
 
@@ -209,10 +223,12 @@ def check_main(rest) -> int:
             blob = _dump(reports[0])
             blob["concurrency"] = concurrency
             blob["metrics_names"] = metrics_names
+            blob["spmd"] = spmd
         else:
             blob = {"apps": [_dump(r) for r in reports],
                     "concurrency": concurrency,
-                    "metrics_names": metrics_names}
+                    "metrics_names": metrics_names,
+                    "spmd": spmd}
         with open(json_out, "w") as f:
             f.write(_json.dumps(blob, indent=2))
         print(f"report written to {json_out}", file=sys.stderr)

@@ -47,6 +47,14 @@ from .spec import (
     as_input_spec,
     spec_dataset,
 )
+from .spmd import (
+    barrier_stability,
+    collective_axis_bindings,
+    collective_divergence,
+    scan_package as scan_package_spmd,
+    sharding_flow_lint,
+    world_checkpoint_consistency,
+)
 
 __all__ = [
     "Analysis",
@@ -64,14 +72,20 @@ __all__ = [
     "analyze",
     "apply_body_host_coercions",
     "as_input_spec",
+    "barrier_stability",
     "blocking_under_lock",
     "check_graph",
     "check_pipeline",
+    "collective_axis_bindings",
+    "collective_divergence",
     "find_lock_cycles",
     "guarded_field_races",
     "guarded_sequence_hazards",
     "lock_order_edges",
     "plan_graph",
     "scan_package",
+    "scan_package_spmd",
+    "sharding_flow_lint",
     "spec_dataset",
+    "world_checkpoint_consistency",
 ]

@@ -763,7 +763,18 @@ def donation_hazards(tree) -> List[tuple]:
 #                               tools/lint.py so all recompile rules
 #                               share one home)
 
-_AMBIENT_MESH_READS = {"get_mesh"}
+# ``get_mesh`` is the in-module read; the rest are the exported
+# solver entry points that read the ambient mesh INTERNALLY (through
+# ``_class_spec`` / their per-mesh jit factories), so a module-level
+# jit in ANOTHER module that calls one of them bakes the first mesh's
+# sharding into its cached trace all the same — the cross-module form
+# of the same bug, found for real in `_block_solve` (the
+# dryrun_multichip(8) weighted-solver phase failure: an 8-device
+# sharding constraint replayed against 1-device arguments; fixed by
+# the `_block_solve_for` per-mesh factory, pinned by
+# tests/test_linear_solvers.py::test_block_least_squares_mesh_switch)
+_AMBIENT_MESH_READS = {"get_mesh", "bcd_core", "block_coordinate_descent",
+                       "solve_one_pass_l2", "tsqr_r"}
 
 
 def _function_call_names(fdef) -> set:
@@ -1077,6 +1088,9 @@ def check_graph(
     diagnostics += fusion_prefix_lint(graph)
     diagnostics += non_streamable_fit_lint(analysis)
     diagnostics += host_stage_on_stream_lint(analysis)
+    from .spmd import sharding_flow_lint
+
+    diagnostics += sharding_flow_lint(analysis)
     from .resources import plan_graph
 
     plan = plan_graph(analysis, name=name)

@@ -1,0 +1,962 @@
+"""SPMD-safety static passes: collective/barrier divergence, barrier
+and coordination-shape stability, sharding-flow, and world-checkpoint
+consistency.
+
+PR 11 gave the framework a real multi-host story; every one of its
+correctness invariants was enforced only by convention and by runtime
+deadlock. The reference got cross-process consistency for free from
+Spark's RDD lineage (SURVEY 2.14); this repo runs hand-written SPMD in
+the GSPMD model, where a single host-divergent branch around a
+collective is a silent distributed hang — the whole pod wedges in an
+unmatched all-reduce with no error anywhere. This module makes the
+SPMD contract a statically checked property, in the established
+textual-order-per-scope engine style (the PR 6 donation passes, the
+PR 7 concurrency passes) with the same one-call-hop budget and the
+same tradeoff: rules are conservative because a false positive breaks
+a CI gate, and every deliberate exception lives in the commented
+:data:`SPMD_ALLOWLIST`.
+
+Four pass families:
+
+* **collective-divergence** (``collective-divergence``) — a collective
+  or barrier site (``sync_global_devices``, ``process_allgather``,
+  ``WorldCoordinator.step/barrier/merge_carries/merge_baselines``,
+  ``psum``/``all_gather`` and friends) reachable under HOST-divergent
+  control flow: a branch or loop bound whose condition derives from
+  the divergence SEEDS — ``process_index()`` calls and the
+  ``process_id``/``pid`` spellings — or from any local a seed flows
+  into through assignments. Every host must reach every collective the
+  same number of times in the same order; one host skipping a barrier
+  wedges the rest forever. World-UNIFORM conditions
+  (``process_count() > 1``, replicated coordination-round results)
+  never taint. Honest limit: per-host state NOT derived from the
+  process index (a host's shard-local chunk count, a ``StopIteration``
+  -driven done flag) is beyond the static seeds — the dryrun
+  divergence reproduction (``tests/spmd_divergent_worker.py``) and the
+  fixed-round ``WorldCoordinator`` discipline cover that class
+  dynamically.
+* **unstable-barrier-name / non-fixed-coordination-shape** — a
+  ``sync_global_devices`` / ``.barrier(...)`` tag that is not a string
+  literal recompiles the barrier program per round and trips the PR 9
+  warmup fence (and two hosts computing different tags deadlock); a
+  ``process_allgather`` payload whose SHAPE derives from shard-local
+  data (a dynamically-sized list, a divergently-sized array) violates
+  the PR 11 fixed-shape ``(cursor, done)`` invariant — hosts whose
+  payload shapes differ crash or wedge inside the gather.
+* **sharding-flow** — the spec-level lattice seeded from
+  ``DatasetSpec.sharded`` (a process-shard-local stream holds ONE
+  host's records): ``cross-host-materialization`` when a consumer
+  collapses a sharded stream into a resident dataset or datum (the
+  "result" would be one host's fraction presented as the whole), and
+  ``implicit-replication`` when a consumer zips a sharded stream with
+  a non-sharded input (each host would pair its shard against the
+  same replicated rows). The AST half, ``unbound-collective-axis``,
+  checks that ``psum``/``all_gather``-style axis names inside
+  ``shard_map`` bodies are bound by a mesh axis in scope (an unbound
+  name fails at trace time on the first multi-host run — CI's
+  single-host path never executes it).
+* **world-checkpoint consistency** — host-0-only filesystem effects of
+  the coordinated snapshot (``merge_hosts``, snapshot ``clear``) must
+  be barrier-paired (``unbarriered-host0-effect``): ``merge_hosts``
+  reads every peer's sidecar, so a barrier must precede it (sidecars
+  durable) AND follow it (no peer proceeds past a half-merged world
+  snapshot); ``clear`` needs the preceding barrier only (every host
+  past finalize before the snapshot disappears). And a restored
+  checkpoint carry must re-enter the device through the replicated
+  ``_restore_carry`` discipline (``carry-restore-discipline``) — a raw
+  ``snap["carry"]`` fed back to accumulate changes the carry's jit
+  signature and recompiles on every resume (the PR 9 fence regression
+  the helper exists to prevent).
+
+``tools/lint.py`` enforces all four tree-wide; ``python -m
+keystone_tpu check [--json]`` folds :func:`scan_package` into its
+report (new ``spmd`` key, exit codes preserved); offender fixtures
+under ``tests/lint_fixtures/`` pin each rule's firing shape, and the
+divergent-collective hazard is reproduced for real by the dryrun
+worker variant in ``tests/spmd_divergent_worker.py`` (statically
+flagged here, dynamically deadlocked and reaped in
+``tests/test_elastic.py``).
+"""
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+# -- allowlist ---------------------------------------------------------------
+
+#: deliberate exceptions — every entry needs a comment saying WHY the
+#: flagged shape is safe (a bare entry in a review is a finding, not a
+#: suppression). Format: "function_or_Class.method:offender", where
+#: offender is the collective/barrier/effect name the rule reports.
+SPMD_ALLOWLIST: FrozenSet[str] = frozenset({
+    # WorldCoordinator.barrier is THE funnel every named world barrier
+    # routes through: its sync_global_devices tag is an f-string over
+    # the caller-supplied name ("keystone-{name}"), and literalness is
+    # enforced at the .barrier(...) CALL SITES by this same pass — the
+    # funnel itself is the one deliberate non-literal tag in the tree.
+    "WorldCoordinator.barrier:sync_global_devices",
+})
+
+
+def _allowed(key: str, allowlist: Optional[Iterable[str]] = None) -> bool:
+    return key in (SPMD_ALLOWLIST if allowlist is None
+                   else frozenset(allowlist))
+
+
+# -- what counts as a collective ---------------------------------------------
+
+#: direct cross-host collective / barrier call names: every host must
+#: execute the same sequence of these (the SPMD contract). jax.lax
+#: collectives are included because a shard_map body skipping one on a
+#: subset of hosts wedges the program exactly like a host-level barrier.
+_COLLECTIVE_CALLS = frozenset({
+    "sync_global_devices", "process_allgather",
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_reduce",
+    "all_to_all", "ppermute", "pshuffle",
+})
+
+#: WorldCoordinator methods that are collectives, recognized at
+#: cross-module call sites by the receiver-name convention (the round
+#: loop binds its coordinator as `world`/`coord`/`coordinator`)
+_COLLECTIVE_METHODS = frozenset({
+    "step", "barrier", "merge_carries", "merge_baselines",
+})
+
+_COORDINATOR_RECEIVERS = ("world", "coord")
+
+
+def _call_name(call: ast.Call) -> str:
+    f = call.func
+    return f.attr if isinstance(f, ast.Attribute) else getattr(f, "id", "")
+
+
+def _is_coordinator_receiver(call: ast.Call) -> bool:
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return False
+    recv = f.value
+    name = recv.id if isinstance(recv, ast.Name) else (
+        recv.attr if isinstance(recv, ast.Attribute) else "")
+    return any(name.startswith(p) for p in _COORDINATOR_RECEIVERS)
+
+
+def collective_call_name(call: ast.Call,
+                         one_hop: FrozenSet[str] = frozenset()
+                         ) -> Optional[str]:
+    """The collective this call performs, or None: a direct collective,
+    a ``world.<coordination method>`` call, or (one call hop) a
+    same-module function whose body performs one directly."""
+    name = _call_name(call)
+    if name in _COLLECTIVE_CALLS:
+        return name
+    if name in _COLLECTIVE_METHODS and _is_coordinator_receiver(call):
+        return name
+    if name in one_hop:
+        return name
+    return None
+
+
+def collective_carriers(tree: ast.Module) -> FrozenSet[str]:
+    """Names of module-level functions (and methods) whose body makes a
+    DIRECT collective call — the one-call-hop budget: calling one of
+    these under a divergent branch diverges the collective exactly as
+    if it were inlined (the same transitive budget the concurrency
+    passes use)."""
+    out: Set[str] = set()
+
+    def record(fdef):
+        for sub in ast.walk(fdef):
+            if isinstance(sub, ast.Call) and \
+                    _call_name(sub) in _COLLECTIVE_CALLS:
+                out.add(fdef.name)
+                return
+
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            record(node)
+        elif isinstance(node, ast.ClassDef):
+            for meth in node.body:
+                if isinstance(meth, ast.FunctionDef):
+                    record(meth)
+    return frozenset(out)
+
+
+# -- host-divergence taint ---------------------------------------------------
+
+#: calls whose RESULT differs per host (the taint seeds). process_count
+#: / is_distributed are deliberately absent: world size is UNIFORM —
+#: `if nproc > 1:` gates collectives on every host together, which is
+#: the safe idiom, not a hazard.
+_DIVERGENT_CALLS = frozenset({"process_index"})
+
+#: name/attribute spellings that carry a per-host value by convention
+#: (WorldCoordinator.pid, the worker argv process_id)
+_DIVERGENT_NAMES = frozenset({"process_id", "pid"})
+
+
+def _expr_divergent(node, tainted: Set[str]) -> bool:
+    """True when an expression's value can differ across hosts: it
+    reads a divergence seed or a tainted local."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            if _call_name(sub) in _DIVERGENT_CALLS:
+                return True
+        elif isinstance(sub, ast.Name):
+            if sub.id in tainted or sub.id in _DIVERGENT_NAMES:
+                return True
+        elif isinstance(sub, ast.Attribute):
+            if sub.attr in _DIVERGENT_NAMES:
+                return True
+    return False
+
+
+def _launders_divergence(node) -> bool:
+    """True when an expression routes through a collective: the RESULT
+    of ``world.step`` / ``process_allgather`` / ``merge_carries`` is
+    REPLICATED across hosts by construction — exchanging per-host
+    values for the world view is what those calls are for — so an
+    assignment from one is world-uniform even when its arguments were
+    per-host. (Re-indexing a gathered array with a per-host index
+    re-diverges, and the seed scan catches that read directly.)"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and (
+                _call_name(sub) in _COLLECTIVE_CALLS
+                or (_call_name(sub) in _COLLECTIVE_METHODS
+                    and _is_coordinator_receiver(sub))):
+            return True
+    return False
+
+
+def _store_names(target) -> List[str]:
+    return [n.id for n in ast.walk(target)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)]
+
+
+def _assign_taint(stmt: ast.Assign, tainted: Set[str]) -> None:
+    """Propagate per-host taint through one assignment, element-wise
+    for matching tuple-to-tuple binds (``pid, nproc = process_index(),
+    process_count()`` must taint only ``pid``). A rebind from a
+    uniform expression — including a collective's replicated result
+    (:func:`_launders_divergence`) — KILLS the taint (the
+    textual-order discipline all the passes here share; conditional
+    kills are re-joined across branches by the scanner)."""
+    if len(stmt.targets) == 1 and isinstance(stmt.targets[0], ast.Tuple) \
+            and isinstance(stmt.value, ast.Tuple) \
+            and len(stmt.targets[0].elts) == len(stmt.value.elts):
+        for t, v in zip(stmt.targets[0].elts, stmt.value.elts):
+            div = _expr_divergent(v, tainted) and not \
+                _launders_divergence(v)
+            for name in _store_names(t):
+                (tainted.add if div else tainted.discard)(name)
+        return
+    div = _expr_divergent(stmt.value, tainted) and not \
+        _launders_divergence(stmt.value)
+    for t in stmt.targets:
+        for name in _store_names(t):
+            (tainted.add if div else tainted.discard)(name)
+
+
+def _walrus_taint(node, tainted: Set[str]) -> None:
+    """``(rank := process_index())`` binds inside an expression: taint
+    the walrus target like any other assignment (review finding: a
+    walrus-bound seed escaped the engine)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.NamedExpr) and isinstance(
+                sub.target, ast.Name):
+            div = _expr_divergent(sub.value, tainted) and not \
+                _launders_divergence(sub.value)
+            (tainted.add if div else tainted.discard)(sub.target.id)
+
+
+def _stmt_taint(stmt, tainted: Set[str]) -> None:
+    """Taint fold for one binding statement: plain assigns (with the
+    element-wise tuple rule), annotated assigns, and augmented assigns
+    (``x += seed`` taints; an AugAssign never kills — the old value
+    survives in the new one). Walrus binds anywhere in the statement
+    fold too."""
+    if isinstance(stmt, ast.Assign):
+        _assign_taint(stmt, tainted)
+    elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+        div = _expr_divergent(stmt.value, tainted) and not \
+            _launders_divergence(stmt.value)
+        for name in _store_names(stmt.target):
+            (tainted.add if div else tainted.discard)(name)
+    elif isinstance(stmt, ast.AugAssign):
+        if _expr_divergent(stmt.value, tainted) and not \
+                _launders_divergence(stmt.value):
+            for name in _store_names(stmt.target):
+                tainted.add(name)
+    _walrus_taint(stmt, tainted)
+
+
+def _condition_src(node) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - very old AST shapes
+        return "<condition>"
+
+
+def _own_walk(root):
+    """Walk ``root`` WITHOUT descending into nested function defs: each
+    nested def is its own scope, enumerated (and scanned) separately by
+    :func:`_scopes` — the same boundary rule the donation and
+    cast-before-transfer passes use."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        if node is not root and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _scopes(tree: ast.Module):
+    """``(qualname, scope node)`` for EVERY scope in the module: the
+    module top level itself (``<module>`` — script-style worker bodies
+    execute collectives at import time), module-level functions,
+    methods, and nested defs at any depth (the streaming hot path is
+    closure-heavy: ``produce``, ``put``, ``accumulate_one`` must not
+    escape the scan). Qualnames join with dots, so allowlist keys
+    address nested scopes as ``outer.inner``."""
+    yield "<module>", tree
+    def recurse(fdef, prefix):
+        name = f"{prefix}{fdef.name}"
+        yield name, fdef
+        # nested defs: _own_walk stops at them, so each is discovered
+        # exactly once, from its direct parent node
+        for sub in _own_walk(fdef):
+            for child in ast.iter_child_nodes(sub):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    yield from recurse(child, f"{name}.")
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield from recurse(node, "")
+        elif isinstance(node, ast.ClassDef):
+            for meth in node.body:
+                if isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    yield from recurse(meth, f"{node.name}.")
+
+
+# -- pass 1: collective divergence -------------------------------------------
+
+def collective_divergence(
+    tree: ast.Module, allowlist: Optional[Iterable[str]] = None,
+) -> List[tuple]:
+    """``(lineno, code, description)`` for every collective/barrier
+    site reachable under host-divergent control flow (see module
+    docstring). Scoped per function, textual order; nested defs are
+    separate scopes enumerated by :func:`_scopes` (they run later,
+    under their caller's control flow, which this engine cannot see —
+    each closure is scanned with its own fresh taint)."""
+    hits: List[tuple] = []
+    one_hop = collective_carriers(tree)
+
+    def check_stmt(stmt, tainted: Set[str], where: str,
+                   condition: Optional[str]):
+        if condition is None:
+            return
+        for sub in ast.walk(stmt):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return  # nested scope boundary inside this statement
+            if not isinstance(sub, ast.Call):
+                continue
+            coll = collective_call_name(sub, one_hop)
+            if coll is None:
+                continue
+            if _allowed(f"{where}:{coll}", allowlist):
+                continue
+            hits.append((
+                sub.lineno, "collective-divergence",
+                f"{where} reaches collective `{coll}` under the "
+                f"host-divergent condition `{condition}`: hosts where "
+                "the branch goes the other way never match this "
+                "collective, and the rest of the world wedges in it "
+                "(the gang-schedule hang; CLUSTER.md 'SPMD safety "
+                "invariants'). Hoist the collective out of the "
+                "branch, gate on a world-uniform value, or allowlist "
+                "with a comment (analysis/spmd.py)"))
+
+    def scan(stmts, tainted: Set[str], where: str,
+             condition: Optional[str]):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested def: its own scope, scanned separately
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign,
+                                 ast.AugAssign)):
+                check_stmt(stmt, tainted, where, condition)
+                _stmt_taint(stmt, tainted)
+                continue
+            if isinstance(stmt, ast.If):
+                check_stmt(stmt.test, tainted, where, condition)
+                _walrus_taint(stmt.test, tainted)
+                cond = condition
+                if _expr_divergent(stmt.test, tainted):
+                    cond = _condition_src(stmt.test)
+                # path-sensitive join (review finding): a kill inside
+                # one branch must not launder the fall-through path —
+                # each branch folds a copy, and a name stays tainted
+                # after the If when ANY path leaves it tainted
+                t_body, t_else = set(tainted), set(tainted)
+                scan(stmt.body, t_body, where, cond)
+                scan(stmt.orelse, t_else, where, cond)
+                tainted.clear()
+                tainted.update(t_body | t_else)
+                continue
+            if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+                header = stmt.test if isinstance(stmt, ast.While) \
+                    else stmt.iter
+                check_stmt(header, tainted, where, condition)
+                _walrus_taint(header, tainted)
+                cond = condition
+                if _expr_divergent(header, tainted):
+                    # a seed-derived iteration count (range(pid), a
+                    # local the process index flowed into) diverges
+                    # collectives inside the loop exactly like a branch
+                    cond = _condition_src(header)
+                # the body may run zero times: join body-out with the
+                # in-state instead of folding in place
+                t_body = set(tainted)
+                scan(stmt.body, t_body, where, cond)
+                tainted.update(t_body)
+                scan(stmt.orelse, tainted, where, cond)
+                continue
+            check_stmt(stmt, tainted, where, condition)
+            _walrus_taint(stmt, tainted)
+            # try/with blocks may be entered partially: join each
+            # block's out-state with the in-state (kills stay local)
+            outs = []
+            for name in ("body", "orelse", "finalbody"):
+                block = getattr(stmt, name, None)
+                if block:
+                    t = set(tainted)
+                    scan(block, t, where, condition)
+                    outs.append(t)
+            for h in getattr(stmt, "handlers", ()):
+                t = set(tainted)
+                scan(h.body, t, where, condition)
+                outs.append(t)
+            for t in outs:
+                tainted.update(t)
+
+    for where, fdef in _scopes(tree):
+        scan(fdef.body, set(), where, None)
+    return sorted(set(hits))
+
+
+# -- pass 2: barrier-name / coordination-shape stability ---------------------
+
+#: constructors whose result length is data-dependent: a payload built
+#: from one of these has a per-host shape
+_DYNAMIC_BUILDERS = frozenset({"list", "sorted", "set", "tuple"})
+
+#: numpy-ish array constructors a dynamic container flows through on
+#: its way to the wire
+_ARRAY_CTORS = frozenset({"array", "asarray", "stack", "concatenate",
+                          "frombuffer", "zeros", "ones", "empty", "full"})
+
+
+def _is_dynamic_expr(v, dynamic: Set[str], tainted: Set[str]) -> bool:
+    if isinstance(v, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+        return True
+    if isinstance(v, ast.Call):
+        name = _call_name(v)
+        if name in _DYNAMIC_BUILDERS:
+            return True
+        if name in _ARRAY_CTORS and v.args:
+            first = v.args[0]
+            if isinstance(first, (ast.ListComp, ast.SetComp,
+                                  ast.GeneratorExp)):
+                return True
+            if isinstance(first, ast.Name) and first.id in dynamic:
+                return True
+            if _expr_divergent(first, tainted) and name in (
+                    "zeros", "ones", "empty", "full"):
+                return True  # per-host SIZE -> per-host shape
+    if isinstance(v, ast.Name) and v.id in dynamic:
+        return True
+    return False
+
+
+def _fold_scope(fdef, upto: Optional[int] = None
+                ) -> Tuple[Set[str], Set[str]]:
+    """``(dynamic, tainted)`` name sets for one function scope, folded
+    in TEXTUAL (line) order up to line ``upto`` (exclusive; None =
+    whole scope) — so a rebind from a fixed-shape/uniform expression
+    kills an earlier dynamic/tainted mark before a later use, the same
+    discipline :func:`_assign_taint` documents. ``dynamic`` holds
+    locals bound to a dynamically-sized container (list comp,
+    ``list(...)``, an appended-to accumulator, an array built over
+    one); ``tainted`` the per-host divergence taint. Nested defs are
+    separate scopes (:func:`_own_walk`)."""
+    events = []
+    for sub in _own_walk(fdef):
+        if isinstance(sub, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            events.append((sub.lineno, "bind", sub))
+        elif isinstance(sub, ast.NamedExpr):
+            events.append((sub.lineno, "walrus", sub))
+        elif isinstance(sub, ast.Call) and isinstance(
+                sub.func, ast.Attribute) and sub.func.attr in (
+                    "append", "extend", "insert") and isinstance(
+                    sub.func.value, ast.Name):
+            events.append((sub.lineno, "append", sub.func.value.id))
+    dynamic: Set[str] = set()
+    tainted: Set[str] = set()
+    for lineno, kind, payload in sorted(events, key=lambda e: e[0]):
+        if upto is not None and lineno >= upto:
+            break
+        if kind == "append":
+            dynamic.add(payload)
+            continue
+        if kind == "walrus":
+            _walrus_taint(payload, tainted)
+            if isinstance(payload.target, ast.Name):
+                (dynamic.add if _is_dynamic_expr(
+                    payload.value, dynamic, tainted)
+                 else dynamic.discard)(payload.target.id)
+            continue
+        _stmt_taint(payload, tainted)
+        value = payload.value
+        if value is None:  # bare annotation: no bind
+            continue
+        dyn = _is_dynamic_expr(value, dynamic, tainted)
+        targets = (payload.targets if isinstance(payload, ast.Assign)
+                   else [payload.target])
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name) and isinstance(
+                        n.ctx, ast.Store):
+                    if isinstance(payload, ast.AugAssign):
+                        if dyn:
+                            dynamic.add(n.id)  # += never un-marks
+                    else:
+                        (dynamic.add if dyn else dynamic.discard)(n.id)
+    return dynamic, tainted
+
+
+def barrier_stability(
+    tree: ast.Module, allowlist: Optional[Iterable[str]] = None,
+) -> List[tuple]:
+    """``(lineno, code, description)`` for non-literal barrier tags and
+    shard-local-shaped coordination payloads (see module docstring)."""
+    hits: List[tuple] = []
+    for where, fdef in _scopes(tree):
+        for sub in _own_walk(fdef):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = _call_name(sub)
+            is_barrier = name == "sync_global_devices" or (
+                name == "barrier" and _is_coordinator_receiver(sub))
+            # the tag may ride positionally or as a keyword
+            # (sync_global_devices accepts name=; review finding: the
+            # keyword spelling used to bypass the rule)
+            tags = list(sub.args[:1]) + [
+                kw.value for kw in sub.keywords
+                if kw.arg in ("name", "tag")]
+            for tag in tags if is_barrier else ():
+                if not (isinstance(tag, ast.Constant)
+                        and isinstance(tag.value, str)):
+                    if _allowed(f"{where}:{name}", allowlist):
+                        continue
+                    hits.append((
+                        sub.lineno, "unstable-barrier-name",
+                        f"{where} passes a non-literal tag to "
+                        f"`{name}(...)`: barrier names must be FIXED "
+                        "per call site — a per-round tag recompiles "
+                        "the barrier program every round (tripping "
+                        "the warmup fence), and two hosts computing "
+                        "different tags deadlock. Use a string "
+                        "literal, or allowlist with a comment "
+                        "(analysis/spmd.py)"))
+            payloads = list(sub.args[:1]) + [
+                kw.value for kw in sub.keywords if kw.arg != "tiled"]
+            if name == "process_allgather" and payloads:
+                # fold the scope's binds in textual order up to THIS
+                # call: a rebind from a fixed-shape expression between
+                # a conditional dynamic bind and the gather kills the
+                # mark (review finding: BFS state produced a false
+                # positive on exactly that shape). The payload may
+                # ride positionally or as a keyword (in_tree=).
+                dynamic, tainted = _fold_scope(fdef, upto=sub.lineno + 1)
+                bad = False
+                for arg in payloads:
+                    if _is_dynamic_expr(arg, dynamic, tainted):
+                        bad = True
+                    if isinstance(arg, ast.Call):
+                        cname = _call_name(arg)
+                        if cname in _ARRAY_CTORS and arg.args and (
+                                isinstance(arg.args[0], (
+                                    ast.ListComp, ast.SetComp,
+                                    ast.GeneratorExp))
+                                or (isinstance(arg.args[0], ast.Name)
+                                    and arg.args[0].id in dynamic)):
+                            bad = True
+                if bad and not _allowed(f"{where}:process_allgather",
+                                        allowlist):
+                    hits.append((
+                        sub.lineno, "non-fixed-coordination-shape",
+                        f"{where} allgathers a payload whose shape "
+                        "derives from shard-local data (a dynamically "
+                        "sized container): hosts whose shapes differ "
+                        "crash or wedge inside the gather, and even "
+                        "agreeing hosts recompile the collective per "
+                        "round. Exchange a FIXED-shape summary "
+                        "instead (the WorldCoordinator.step "
+                        "`(cursor, done, has_carry)` discipline), or "
+                        "allowlist with a comment (analysis/spmd.py)"))
+    return sorted(set(hits))
+
+
+# -- pass 3 (AST half): collective axis names vs the mesh in scope -----------
+
+#: axis names the repo's canonical meshes bind
+#: (parallel/mesh.py DATA_AXIS / MODEL_AXIS)
+_CANONICAL_AXES = frozenset({"data", "model"})
+
+#: collectives taking an axis name (positionally second, or axis_name=)
+_AXIS_COLLECTIVES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "all_gather", "ppermute",
+    "all_to_all", "axis_index", "pshuffle",
+})
+
+
+def _module_axis_names(tree: ast.Module) -> FrozenSet[str]:
+    """Mesh axis names bound anywhere in this module: string literals
+    inside ``Mesh(...)`` / ``make_mesh(...)`` constructions and
+    ``P(...)``/``PartitionSpec(...)`` specs, plus the canonical
+    ('data', 'model') pair every mesh in this repo carries."""
+    axes: Set[str] = set(_CANONICAL_AXES)
+    for sub in ast.walk(tree):
+        if not isinstance(sub, ast.Call):
+            continue
+        if _call_name(sub) not in ("Mesh", "make_mesh", "P",
+                                   "PartitionSpec", "AxisType"):
+            continue
+        for a in ast.walk(sub):
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                axes.add(a.value)
+    return frozenset(axes)
+
+
+def collective_axis_bindings(tree: ast.Module) -> List[tuple]:
+    """``(lineno, code, description)`` for ``psum``/``all_gather``-style
+    calls whose literal axis name is not bound by any mesh axis known
+    to this module — an unbound name raises at TRACE time, but only on
+    the first run whose mesh actually executes the shard_map body,
+    which CI's single-host path never does."""
+    hits: List[tuple] = []
+    axes = _module_axis_names(tree)
+    for sub in ast.walk(tree):
+        if not (isinstance(sub, ast.Call)
+                and _call_name(sub) in _AXIS_COLLECTIVES):
+            continue
+        cands = []
+        if len(sub.args) >= 2:
+            cands.append(sub.args[1])
+        elif sub.args and _call_name(sub) == "axis_index":
+            cands.append(sub.args[0])
+        for kw in sub.keywords:
+            if kw.arg == "axis_name":
+                cands.append(kw.value)
+        for cand in cands:
+            if isinstance(cand, ast.Constant) and isinstance(
+                    cand.value, str) and cand.value not in axes:
+                hits.append((
+                    sub.lineno, "unbound-collective-axis",
+                    f"`{_call_name(sub)}(..., {cand.value!r})` names a "
+                    "mesh axis this module never binds (known axes: "
+                    f"{', '.join(sorted(axes))}): the collective "
+                    "raises an unbound-axis error at trace time on "
+                    "the first mesh that executes it. Use an axis the "
+                    "mesh in scope defines (parallel/mesh.py "
+                    "DATA_AXIS/MODEL_AXIS)"))
+    return sorted(set(hits))
+
+
+# -- pass 3 (spec half): sharding-flow graph lint ----------------------------
+
+def sharding_flow_lint(analysis) -> List:
+    """Graph diagnostics over the ``DatasetSpec.sharded`` provenance
+    lattice (the abstract interpreter propagates ``sharded`` through
+    transformer and delegate nodes):
+
+    * ``cross-host-materialization`` (ERROR) — a consumer collapses a
+      process-shard-local stream into a resident dataset or a single
+      datum: under a multi-host world the result holds ONE host's
+      fraction of the records, silently presented as the whole.
+      Estimator fits are exempt here — the distributed
+      ``fit_streaming`` path tree-reduces their carries across hosts,
+      and a non-streamable estimator is already an error
+      (``non-streamable-fit`` names the shard-local provenance).
+    * ``implicit-replication`` (WARNING) — a consumer zips a sharded
+      stream with a NON-sharded dataset input: each host pairs its
+      shard-local rows against the same (replicated) rows of the other
+      input, so only host 0's pairing is the intended one. The
+      non-sharded input must be this host's matching shard slice;
+      derive it from the same shard listing (CLUSTER.md 'Data').
+    """
+    from .interpreter import Diagnostic, SEVERITY_ERROR, SEVERITY_WARNING
+    from .spec import DatasetSpec, DatumSpec, TransformerSpec
+
+    graph = analysis.graph
+    out: List = []
+    for n in sorted(graph.nodes, key=lambda g: g.id):
+        deps = graph.get_dependencies(n)
+        dep_specs = [analysis.value(d) for d in deps]
+        sharded = [d for d in dep_specs
+                   if isinstance(d, DatasetSpec) and d.sharded]
+        if not sharded:
+            continue
+        op = graph.get_operator(n)
+        spec = analysis.value(n)
+        if isinstance(spec, TransformerSpec):
+            # estimator fit: the distributed fit_streaming path
+            # tree-reduces carries across hosts, and its labels input
+            # follows the shard-local convention the runtime itself
+            # guards (the fit fingerprint + the misaligned-labels
+            # raise) — neither sub-rule applies
+            continue
+        if isinstance(spec, DatumSpec) or (
+                isinstance(spec, DatasetSpec) and not spec.streaming):
+            what = ("a single datum" if isinstance(spec, DatumSpec)
+                    else "a resident dataset")
+            out.append(Diagnostic(
+                code="cross-host-materialization",
+                severity=SEVERITY_ERROR, node_id=n.id,
+                operator=op.label(),
+                message=(
+                    f"consumer collapses a process-shard-local stream "
+                    f"into {what}: under a multi-host world this "
+                    "holds ONE host's fraction of the records, "
+                    "silently presented as the whole dataset. Keep "
+                    "the computation streaming (accumulate/finalize "
+                    "tree-reduces across hosts), or gather "
+                    "deliberately via the distributed fit path "
+                    "(CLUSTER.md 'SPMD safety invariants')")))
+        unsharded = [d for d in dep_specs
+                     if isinstance(d, DatasetSpec) and not d.sharded]
+        if unsharded:
+            out.append(Diagnostic(
+                code="implicit-replication",
+                severity=SEVERITY_WARNING, node_id=n.id,
+                operator=op.label(),
+                message=(
+                    "consumer zips a process-shard-local stream with "
+                    "a non-sharded input: each host pairs its shard's "
+                    "rows against the SAME rows of the replicated "
+                    "input, so every host but one computes a "
+                    "misaligned pairing. Slice the other input to "
+                    "this host's shard (the dryrun worker's "
+                    "contiguous-block labels), or mark it sharded if "
+                    "it already is (CLUSTER.md 'Data')")))
+    return out
+
+
+# -- pass 4: world-checkpoint consistency ------------------------------------
+
+#: world-snapshot filesystem effects that only host 0 performs; the
+#: value says which sides need a barrier. merge_hosts READS every
+#: peer's sidecar and WRITES the world snapshot peers may resume from:
+#: both sides. clear destroys state nobody may still need: the
+#: preceding barrier (everyone past finalize) suffices.
+_HOST0_EFFECTS = {"merge_hosts": ("before", "after"),
+                  "clear": ("before",)}
+
+#: receivers that look like a stream checkpoint (the `clear` effect is
+#: only checked on these — `.clear()` on dicts/lists is ubiquitous)
+_CKPT_RECEIVERS = ("ckpt", "checkpoint", "snapshot")
+
+
+def _is_ckpt_receiver(call: ast.Call) -> bool:
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return False
+    recv = f.value
+    name = recv.id if isinstance(recv, ast.Name) else (
+        recv.attr if isinstance(recv, ast.Attribute) else "")
+    return any(p in name for p in _CKPT_RECEIVERS)
+
+
+def _barrier_lines(fdef) -> List[int]:
+    """Lines of true world BARRIERS in one scope: named barriers only.
+    ``WorldCoordinator.step`` is deliberately NOT one here — it is a
+    rendezvous, but the sidecar writes happen AFTER it in the round
+    loop, so it cannot order snapshot durability (review finding: a
+    step line earlier in the function made the 'before' check
+    vacuous)."""
+    lines = []
+    for sub in _own_walk(fdef):
+        if isinstance(sub, ast.Call):
+            name = _call_name(sub)
+            if name == "sync_global_devices" or (
+                    name == "barrier" and _is_coordinator_receiver(sub)):
+                lines.append(sub.lineno)
+    return sorted(lines)
+
+
+def _snapshot_write_lines(fdef) -> List[int]:
+    """Lines where this scope writes snapshot state peers must see as
+    durable before a fold (``save_host``/``save`` on a checkpoint-ish
+    receiver): the 'before' barrier must land BETWEEN the last such
+    write and the host-0 effect, or it orders nothing."""
+    lines = []
+    for sub in _own_walk(fdef):
+        if isinstance(sub, ast.Call) and isinstance(
+                sub.func, ast.Attribute) and sub.func.attr in (
+                    "save_host", "save") and _is_ckpt_receiver(sub):
+            lines.append(sub.lineno)
+    return sorted(lines)
+
+
+def world_checkpoint_consistency(
+    tree: ast.Module, allowlist: Optional[Iterable[str]] = None,
+) -> List[tuple]:
+    """``(lineno, code, description)`` for unbarriered host-0 snapshot
+    effects and raw (non-``_restore_carry``) checkpoint-carry reads
+    (see module docstring)."""
+    hits: List[tuple] = []
+    for where, fdef in _scopes(tree):
+        barriers = _barrier_lines(fdef)
+        writes = _snapshot_write_lines(fdef)
+
+        # -- host-0 effects must be barrier-paired -------------------------
+        for sub in _own_walk(fdef):
+            if not isinstance(sub, ast.If):
+                continue
+            # taint AS OF the gate (review finding: the whole-scope
+            # fold let a LATER uniform rebind of the gating name mask
+            # an earlier host-0 gate)
+            _, tainted = _fold_scope(fdef, upto=sub.lineno)
+            if not _expr_divergent(sub.test, tainted):
+                continue
+            end = getattr(sub, "end_lineno", sub.lineno)
+            # the 'before' barrier must order the LAST preceding
+            # snapshot write: a barrier (or any line) before the write
+            # proves nothing about its durability
+            last_write = max((w for w in writes if w < sub.lineno),
+                             default=None)
+            floor = last_write if last_write is not None else 0
+            for call in ast.walk(sub):
+                if not isinstance(call, ast.Call):
+                    continue
+                name = _call_name(call)
+                sides = _HOST0_EFFECTS.get(name)
+                if sides is None:
+                    continue
+                if name == "clear" and not _is_ckpt_receiver(call):
+                    continue
+                if _allowed(f"{where}:{name}", allowlist):
+                    continue
+                missing = []
+                if "before" in sides and not any(
+                        floor < b < sub.lineno for b in barriers):
+                    missing.append("before")
+                if "after" in sides and not any(
+                        b > end for b in barriers):
+                    missing.append("after")
+                if missing:
+                    hits.append((
+                        call.lineno, "unbarriered-host0-effect",
+                        f"{where} runs host-0-only `{name}(...)` with "
+                        f"no world barrier {' or '.join(missing)} the "
+                        "gating branch: peers race the shared "
+                        "snapshot files (a sidecar still in flight "
+                        "folds torn; a peer resumes a half-merged "
+                        "world). Bracket the effect with "
+                        "WorldCoordinator.barrier calls (the "
+                        "sidecars/world discipline in fit_streaming), "
+                        "or allowlist with a comment "
+                        "(analysis/spmd.py)"))
+
+        # -- restored carries re-enter through _restore_carry --------------
+        snap_names: Set[str] = set()
+        for sub in _own_walk(fdef):
+            if not isinstance(sub, ast.Assign):
+                continue
+            loads = any(
+                isinstance(c, ast.Call) and isinstance(
+                    c.func, ast.Attribute)
+                and c.func.attr in ("load", "load_world")
+                for c in ast.walk(sub.value))
+            if loads:
+                for t in sub.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name) and isinstance(
+                                n.ctx, ast.Store):
+                            snap_names.add(n.id)
+        if not snap_names:
+            continue
+        exempt: Set[int] = set()
+        for sub in _own_walk(fdef):
+            if isinstance(sub, ast.Call) and _call_name(sub) in (
+                    "_restore_carry", "restore"):
+                for a in sub.args:
+                    for n in ast.walk(a):
+                        exempt.add(id(n))
+            elif isinstance(sub, ast.Compare) and any(
+                    isinstance(c, ast.Constant) and c.value is None
+                    for c in sub.comparators):
+                for n in ast.walk(sub):
+                    exempt.add(id(n))
+        for sub in _own_walk(fdef):
+            if not (isinstance(sub, ast.Subscript)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id in snap_names):
+                continue
+            sl = sub.slice
+            if not (isinstance(sl, ast.Constant) and sl.value == "carry"):
+                continue
+            if id(sub) in exempt:
+                continue
+            if _allowed(f"{where}:carry", allowlist):
+                continue
+            hits.append((
+                sub.lineno, "carry-restore-discipline",
+                f"{where} feeds a restored checkpoint carry "
+                "(`...['carry']`) onward without `_restore_carry`: "
+                "the raw host arrays change the accumulate jit "
+                "signature (sharding + weak types), so EVERY resume "
+                "compiles a second program under the warmup fence. "
+                "Route the restore through "
+                "parallel.streaming._restore_carry (replicated "
+                "device_put, host ints preserved), or allowlist "
+                "with a comment (analysis/spmd.py)"))
+    return sorted(set(hits))
+
+
+# -- package scan (tools/lint.py + `check` CLI) ------------------------------
+
+def scan_file(path, rel: str) -> List[Dict[str, object]]:
+    """All four AST families over one file; ``[{file, lineno, code,
+    message}]`` (the shape tools/lint.py and ``check --json``
+    consume)."""
+    out: List[Dict[str, object]] = []
+    try:
+        tree = ast.parse(Path(path).read_text())
+    except SyntaxError as exc:
+        return [{"file": rel, "lineno": exc.lineno or 0,
+                 "code": "syntax-error", "message": str(exc)}]
+    for pass_fn in (collective_divergence, barrier_stability,
+                    collective_axis_bindings,
+                    world_checkpoint_consistency):
+        for lineno, code, msg in pass_fn(tree):
+            out.append({"file": rel, "lineno": lineno,
+                        "code": code, "message": msg})
+    return out
+
+
+def scan_package(pkg_root) -> List[Dict[str, object]]:
+    """Run every AST pass family over a package tree — tree-wide, like
+    the donation/recompile passes: the rules key on collective call
+    names specific enough that scoping would only hide new call
+    sites."""
+    pkg_root = Path(pkg_root)
+    out: List[Dict[str, object]] = []
+    for path in sorted(pkg_root.rglob("*.py")):
+        rel = str(path.relative_to(pkg_root.parent))
+        out.extend(scan_file(path, rel))
+    return out

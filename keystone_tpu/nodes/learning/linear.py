@@ -741,12 +741,29 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         return cost
 
 
-@functools.partial(jax.jit, static_argnames=("bounds", "num_iter"))
-def _block_solve(X, Y, x_mean, y_mean, mask, lam, bounds, num_iter):
-    m = mask[:, None].astype(X.dtype)
-    Yc = (Y - y_mean) * m
-    blocks = [(X[:, lo:hi] - x_mean[lo:hi]) * m for lo, hi in bounds]
-    return linalg.bcd_core(blocks, Yc, jnp.asarray(lam, X.dtype), num_passes=num_iter)
+@functools.lru_cache(maxsize=None)
+def _block_solve_for(mesh):
+    """Jitted block solve, one trace cache per mesh (the
+    ``_bcd_jit_for`` discipline): ``bcd_core`` reads the ambient mesh
+    through ``_class_spec``, so a module-lifetime jit here baked the
+    FIRST mesh's class-sharding constraints into the cached trace and
+    silently replayed them under a second mesh at the same shapes —
+    the dryrun_multichip(8) weighted-solver phase failure recorded in
+    MULTICHIP_r06 (an 8-device sharding constraint against 1-device
+    arguments). The mesh parameter keys the cache; the caller passes
+    the ambient mesh so each mesh gets its own trace. The cross-module
+    ``mesh-closure-jit`` lint (analysis/diagnostics.py) now flags the
+    old shape statically."""
+
+    @functools.partial(jax.jit, static_argnames=("bounds", "num_iter"))
+    def _block_solve(X, Y, x_mean, y_mean, mask, lam, bounds, num_iter):
+        m = mask[:, None].astype(X.dtype)
+        Yc = (Y - y_mean) * m
+        blocks = [(X[:, lo:hi] - x_mean[lo:hi]) * m for lo, hi in bounds]
+        return linalg.bcd_core(blocks, Yc, jnp.asarray(lam, X.dtype),
+                               num_passes=num_iter)
+
+    return _block_solve
 
 
 def block_least_squares(X, Y, n, lam, bounds, num_iter, mask=None):
@@ -757,12 +774,15 @@ def block_least_squares(X, Y, n, lam, bounds, num_iter, mask=None):
     routes through this, so callers that stage the solve into a larger
     jit (e.g. bench.py's end-to-end program) time exactly the
     production solver path."""
+    from ...parallel.mesh import get_mesh
+
     if mask is None:
         mask = jnp.ones(X.shape[0], X.dtype)
     x_mean = linalg.distributed_mean(X, n)
     y_mean = linalg.distributed_mean(Y, n)
+    solve = _block_solve_for(get_mesh())
     return (
-        _block_solve(X, Y, x_mean, y_mean, mask, lam, bounds, num_iter),
+        solve(X, Y, x_mean, y_mean, mask, lam, bounds, num_iter),
         x_mean,
         y_mean,
     )

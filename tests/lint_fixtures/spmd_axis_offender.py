@@ -1,0 +1,33 @@
+"""Synthetic offender for the unbound-collective-axis pass
+(``analysis/spmd.py``): a ``shard_map`` body whose ``psum`` /
+``all_gather`` axis name is bound by no mesh axis this module ever
+constructs — the trace-time unbound-axis error CI's single-host path
+never executes. Collectives over the canonical ('data', 'model') axes
+and over an axis a local ``Mesh(...)`` binds must NOT fire. Never
+imported; parsed as AST by tests/tools."""
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def make_local_mesh(devices):
+    # binds 'rows': collectives over it are in scope for this module
+    return Mesh(devices, ("rows",))
+
+
+def unbound_axis_body(x):
+    return jax.lax.psum(x, "batch")  # BUG: no mesh here binds 'batch'
+
+
+def unbound_gather(r):
+    return jax.lax.all_gather(r, "replica", axis=0)  # BUG: unbound
+
+
+def canonical_axes_body(x, r):
+    # the repo's canonical mesh axes (parallel/mesh.py): clean
+    s = jax.lax.psum(x, "data")
+    return s + jnp.sum(jax.lax.all_gather(r, "model", axis=0))
+
+
+def locally_bound_axis(x):
+    return jax.lax.psum(x, "rows")  # bound by make_local_mesh: clean

@@ -1,0 +1,50 @@
+"""Synthetic offender for the barrier-stability pass
+(``analysis/spmd.py``): non-literal ``sync_global_devices`` /
+``world.barrier`` tags (per-round names recompile the barrier program
+and let two hosts compute different tags), and ``process_allgather``
+payloads whose shape derives from shard-local data (a dynamically
+sized list, an array built over one) — the fixed-shape
+``(cursor, done)`` coordination invariant, violated. The literal-tag
+and fixed-shape spellings must NOT fire. Never imported; parsed as
+AST by tests/tools."""
+import numpy as np
+
+
+def sync_global_devices(tag):  # stand-in: parsed, never run
+    raise NotImplementedError
+
+
+def process_allgather(x):
+    raise NotImplementedError
+
+
+def per_round_tag(round_idx):
+    sync_global_devices(f"round-{round_idx}")  # BUG: non-literal tag
+
+
+def computed_coordinator_tag(world, phase):
+    world.barrier(phase + "-done")  # BUG: computed tag at the call site
+
+
+def shard_local_payload(records):
+    good = [r.key for r in records]  # per-host length
+    process_allgather(np.array(good))  # BUG: shape = this host's count
+
+
+def appended_payload(stream):
+    pending = []
+    for chunk in stream:
+        pending.append(chunk.n)
+    process_allgather(pending)  # BUG: dynamically sized container
+
+
+def fixed_shape_round(cursor, done):
+    # the WorldCoordinator.step discipline: a literal-length payload
+    # compiles once and matches on every host — never flagged
+    process_allgather(np.array([int(cursor), 1 if done else 0],
+                               np.int64))
+
+
+def literal_tags(world):
+    sync_global_devices("keystone-finalize")  # literal: clean
+    world.barrier("ckpt-sidecars")            # literal: clean

@@ -1,0 +1,54 @@
+"""Synthetic offender for the world-checkpoint consistency pass
+(``analysis/spmd.py``): host-0-only snapshot effects (``merge_hosts``,
+checkpoint ``clear``) not barrier-paired — peers race the shared
+snapshot files — and a restored checkpoint carry fed onward without
+the ``_restore_carry`` replicated-``device_put`` discipline (every
+resume would compile a second accumulate program under the warmup
+fence). The correctly bracketed / correctly restored spellings must
+NOT fire. Never imported; parsed as AST by tests/tools."""
+
+
+def _restore_carry(host_carry, mesh):  # stand-in: parsed, never run
+    raise NotImplementedError
+
+
+def unbarriered_merge(world, ckpt):
+    # BUG: no barrier before (sidecars may still be in flight) and
+    # none after (a peer can resume a half-merged world snapshot)
+    if world.pid == 0:
+        ckpt.merge_hosts(world.nproc)
+
+
+def unbarriered_clear(world, ckpt):
+    if world.pid == 0:
+        ckpt.clear()  # BUG: peers may not be past finalize yet
+
+
+def bracketed_merge(world, ckpt):
+    # the fit_streaming discipline: sidecar barrier, host-0 merge,
+    # world barrier — clean
+    world.barrier("ckpt-sidecars")
+    if world.pid == 0:
+        ckpt.merge_hosts(world.nproc)
+    world.barrier("ckpt-world")
+
+
+def barriered_clear(world, ckpt):
+    world.barrier("finalize-clear")
+    if world.pid == 0:
+        ckpt.clear()  # every host is past finalize: clean
+
+
+def raw_carry_restore(ckpt, fingerprint, mesh):
+    snap = ckpt.load(fingerprint)
+    if snap is not None:
+        carry = snap["carry"]  # BUG: raw host arrays re-enter the jit
+    return carry
+
+
+def disciplined_carry_restore(ckpt, fingerprint, mesh):
+    snap = ckpt.load_world(fingerprint, 0, 2)
+    if snap is not None:
+        carry = (None if snap["carry"] is None
+                 else _restore_carry(snap["carry"], mesh))  # clean
+    return carry

@@ -1,0 +1,81 @@
+"""Synthetic offender for the collective-divergence pass
+(``analysis/spmd.py``): collectives reachable under host-divergent
+control flow — the branch-on-``process_index`` hang, the taint-flow
+variant (a local derived from the process index), the one-call-hop
+variant (a helper that performs the collective), and a per-host LOOP
+bound around a collective. The world-uniform shapes (gating on
+``process_count() > 1``, a host-0 block with only filesystem work, a
+rebind that kills the taint) must NOT fire. Never imported; parsed as
+AST by tests/tools."""
+import numpy as np
+
+
+def sync_global_devices(tag):  # stand-in: parsed, never run
+    raise NotImplementedError
+
+
+def process_index():
+    raise NotImplementedError
+
+
+def process_count():
+    raise NotImplementedError
+
+
+def process_allgather(x):
+    raise NotImplementedError
+
+
+def _announce():
+    # a direct collective inside a helper: calling THIS under a
+    # divergent branch is the one-call-hop offender shape
+    sync_global_devices("announce")
+
+
+def branch_on_process_index(world):
+    sync_global_devices("enter")  # matched on every host: clean
+    if process_index() == 0:
+        sync_global_devices("host0-only")  # BUG: peers never match it
+
+
+def taint_flows_through_locals(world):
+    rank = process_index()
+    am_leader = rank == 0
+    if am_leader:
+        world.barrier("leader-only")  # BUG: taint propagated to the gate
+
+
+def one_hop_divergence():
+    if process_index() == 0:
+        _announce()  # BUG: the helper's collective diverges all the same
+
+
+def per_host_loop_bound(my_chunks):
+    # my_chunks is a per-host count by convention (seeded via the
+    # divergent name below): the loop runs a different number of
+    # rounds per host, so the collective inside mismatches
+    pid = process_index()
+    for _ in range(pid):
+        process_allgather(np.zeros(3))  # BUG: per-host round count
+
+
+def uniform_world_size_gate(world):
+    # process_count is the SAME on every host: gating a collective on
+    # it is the safe idiom, never flagged
+    if process_count() > 1:
+        sync_global_devices("world-enter")
+
+
+def host0_filesystem_only(ckpt, n):
+    # a host-0 block with no collective inside: pass 1 stays silent
+    # (pass 4 owns the barrier-pairing question)
+    world_barrier_placeholder = None
+    if process_index() == 0:
+        np.save("/tmp/out.npy", np.zeros(n))
+
+
+def rebind_kills_taint(world):
+    rank = process_index()
+    rank = 0  # rebound from a uniform value: taint dies here
+    if rank == 0:
+        sync_global_devices("everyone")  # clean: every host takes this

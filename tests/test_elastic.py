@@ -487,3 +487,36 @@ def test_partitioned_world_relaunches_and_resumes(tmp_path):
     fields = [_ok_fields(world, p) for p in range(2)]
     assert all(f["resumed"] == "1" for f in fields)
     assert (np.load(out_a)["weights"] == np.load(out_c)["weights"]).all()
+
+
+# -- the divergent-collective hazard, reproduced for real --------------------
+
+@pytest.mark.slow
+def test_divergent_collective_deadlocks_and_is_reaped(tmp_path):
+    """ISSUE 12 satellite: the hazard class the `collective-divergence`
+    pass (analysis/spmd.py) flags statically — a barrier under an
+    `if process_index() == 0:` branch — reproduced dynamically: the
+    deliberately divergent worker (tests/spmd_divergent_worker.py,
+    flagged by tests/test_spmd_passes.py) enters a collective its peer
+    never matches. The divergent host makes NO progress and raises NO
+    error (the silent gang-schedule hang); the peer finishes, exits 0,
+    and the DryrunWorld launcher's gang grace reaps the wedged
+    member."""
+    worker = os.path.join(os.path.dirname(__file__),
+                          "spmd_divergent_worker.py")
+    world = DryrunWorld(num_processes=2, devices_per_process=1,
+                        workdir=str(tmp_path), grace_s=8)
+    world.launch([sys.executable, worker])
+    codes = world.wait(timeout_s=180)
+    # the straight host completed the matched barrier and exited clean
+    assert codes[1] == 0, world.output(1)[-1500:]
+    assert "DIVERGE_DONE pid=1" in world.output(1)
+    # the divergent host entered the world (the matched barrier), then
+    # wedged in the host-0-only collective: never printed its done
+    # line, never errored on its own — it was killed by gang grace
+    out0 = world.output(0)
+    assert "DIVERGE_ENTER pid=0" in out0, out0[-1500:]
+    assert "DIVERGE_DONE pid=0" not in out0, (
+        "the divergent host was expected to wedge in the unmatched "
+        "collective, but it completed — the hazard did not reproduce")
+    assert codes[0] != 0, codes

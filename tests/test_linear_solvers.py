@@ -325,3 +325,30 @@ def test_finite_or_eigh_fallback_fires_directly():
     out2 = np.asarray(_finite_or_eigh_solve(
         good, lambda: jnp.asarray(reg), jnp.asarray(rhs)))
     np.testing.assert_array_equal(out2, np.asarray(good))
+
+
+def test_block_least_squares_mesh_switch():
+    """Regression (the MULTICHIP_r06 weighted-solver phase failure):
+    ``_block_solve`` was one module-lifetime jit, and ``bcd_core``
+    reads the ambient mesh through ``_class_spec`` — so the first
+    mesh's class-sharding constraints baked into the cached trace and
+    replayed against a second mesh's arguments at the same shapes
+    ("incompatible devices: argument ... device ids [0] ...
+    sharding_constraint ... [0..7]"). The per-mesh
+    ``_block_solve_for`` factory keys the trace cache by mesh: an
+    8-device ('data' x 'model') fit followed by a 1-device fit at
+    IDENTICAL shapes must both run, and agree to f32 rounding (the
+    dryrun_multichip parity bar)."""
+    import jax
+
+    from keystone_tpu.parallel.mesh import make_mesh, mesh_scope
+
+    A, Y = make_problem(n=64, d=16, k=2, seed=1)
+    est = BlockLeastSquaresEstimator(block_size=8, num_iter=2, lam=0.2)
+    devices = jax.devices()[:8]
+    with mesh_scope(make_mesh(devices, data=4, model=2)):
+        w_n = np.asarray(est.fit(A, Y).weights)
+    with mesh_scope(make_mesh(devices[:1], data=1, model=1)):
+        w_1 = np.asarray(est.fit(A, Y).weights)
+    scale = max(float(np.max(np.abs(w_1))), 1e-6)
+    assert float(np.max(np.abs(w_n - w_1))) / scale < 5e-3

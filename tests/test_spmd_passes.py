@@ -1,0 +1,558 @@
+"""SPMD-safety static passes (analysis/spmd.py, the ISSUE 12
+tentpole): each of the four families — collective divergence,
+barrier-name/coordination-shape stability, sharding-flow (AST axis
+bindings + the spec-level ``DatasetSpec.sharded`` lattice), and
+world-checkpoint consistency — fires on its synthetic offender fixture
+(tests/lint_fixtures) and reports the shipped package tree clean; the
+deliberately divergent dryrun worker
+(tests/spmd_divergent_worker.py) is statically flagged here and
+dynamically deadlocked-and-reaped by the @slow test alongside the
+elastic suite (tests/test_elastic.py)."""
+import ast
+import pathlib
+
+import jax
+import numpy as np
+import pytest
+
+from keystone_tpu.analysis.spmd import (
+    SPMD_ALLOWLIST,
+    barrier_stability,
+    collective_axis_bindings,
+    collective_carriers,
+    collective_divergence,
+    scan_package,
+    sharding_flow_lint,
+    world_checkpoint_consistency,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXTURES = pathlib.Path(__file__).resolve().parent / "lint_fixtures"
+
+
+def _tree(name):
+    return ast.parse((FIXTURES / f"{name}.py").read_text())
+
+
+# -- pass 1: collective divergence -------------------------------------------
+
+def test_collective_divergence_fires_on_offender():
+    hits = collective_divergence(_tree("spmd_divergence_offender"))
+    assert {c for _, c, _ in hits} == {"collective-divergence"}
+    # the four bug shapes: direct branch, taint flow, one call hop,
+    # per-host loop bound — and NOT the uniform/rebind/fs-only shapes
+    assert len(hits) == 4
+    msgs = " ".join(m for _, _, m in hits)
+    assert "branch_on_process_index" in msgs
+    assert "taint_flows_through_locals" in msgs
+    assert "one_hop_divergence" in msgs
+    assert "per_host_loop_bound" in msgs
+    assert "uniform_world_size_gate" not in msgs
+    assert "host0_filesystem_only" not in msgs
+    assert "rebind_kills_taint" not in msgs
+
+
+def test_divergence_names_both_condition_and_collective():
+    hits = collective_divergence(_tree("spmd_divergence_offender"))
+    direct = next(m for _, _, m in hits if "branch_on_process_index" in m)
+    assert "`sync_global_devices`" in direct       # the collective
+    assert "`process_index() == 0`" in direct      # the branch condition
+
+
+def test_divergence_one_hop_budget():
+    carriers = collective_carriers(_tree("spmd_divergence_offender"))
+    assert "_announce" in carriers
+
+
+def test_divergence_allowlist_suppresses_with_entry():
+    hits = collective_divergence(
+        _tree("spmd_divergence_offender"),
+        allowlist={"branch_on_process_index:sync_global_devices",
+                   "taint_flows_through_locals:barrier",
+                   "per_host_loop_bound:process_allgather"})
+    assert len(hits) == 1
+    assert "one_hop_divergence" in hits[0][2]
+
+
+def test_collective_result_launders_divergence():
+    """The replicated result of a coordination collective is
+    world-uniform: gating later collectives on it is the ROUND-LOOP
+    idiom (fit_streaming's checkpoint rounds), never flagged."""
+    src = (
+        "def round_loop(world, ckpt, done):\n"
+        "    state = world.step(cursor=1, done=done)\n"
+        "    if state.all_done:\n"
+        "        world.barrier('finalize')\n")
+    assert collective_divergence(ast.parse(src)) == []
+
+
+def test_tuple_assign_taints_elementwise():
+    """`pid, nproc = process_index(), process_count()` must taint only
+    pid — gating on world size stays the safe idiom."""
+    src = (
+        "def worker(world):\n"
+        "    rank, nproc = process_index(), process_count()\n"
+        "    if nproc > 1:\n"
+        "        world.barrier('enter')\n")
+    assert collective_divergence(ast.parse(src)) == []
+    src_bad = src.replace("nproc > 1", "rank > 0")
+    hits = collective_divergence(ast.parse(src_bad))
+    assert [c for _, c, _ in hits] == ["collective-divergence"]
+
+
+# -- pass 2: barrier / coordination-shape stability --------------------------
+
+def test_barrier_stability_fires_on_offender():
+    hits = barrier_stability(_tree("spmd_barrier_offender"))
+    codes = sorted(c for _, c, _ in hits)
+    assert codes == ["non-fixed-coordination-shape"] * 2 + \
+        ["unstable-barrier-name"] * 2
+    msgs = " ".join(m for _, _, m in hits)
+    assert "per_round_tag" in msgs
+    assert "computed_coordinator_tag" in msgs
+    assert "shard_local_payload" in msgs
+    assert "appended_payload" in msgs
+    assert "fixed_shape_round" not in msgs   # literal-length payload
+    assert "literal_tags" not in msgs
+
+
+def test_world_coordinator_funnel_is_the_only_allowlisted_tag():
+    """The shipped tree's one deliberate non-literal barrier tag is the
+    WorldCoordinator.barrier funnel (callers' literalness is enforced
+    at their call sites); the allowlist carries exactly that entry and
+    removing it makes the funnel fire — the entry is load-bearing."""
+    assert "WorldCoordinator.barrier:sync_global_devices" \
+        in SPMD_ALLOWLIST
+    tree = ast.parse(
+        (REPO / "keystone_tpu/parallel/distributed.py").read_text())
+    assert barrier_stability(tree) == []
+    unsuppressed = barrier_stability(tree, allowlist=())
+    assert [c for _, c, _ in unsuppressed] == ["unstable-barrier-name"]
+    assert "WorldCoordinator.barrier" in unsuppressed[0][2]
+
+
+# -- pass 3 (AST): collective axis bindings ----------------------------------
+
+def test_unbound_axis_fires_on_offender():
+    hits = collective_axis_bindings(_tree("spmd_axis_offender"))
+    assert {c for _, c, _ in hits} == {"unbound-collective-axis"}
+    msgs = " ".join(m for _, _, m in hits)
+    assert "'batch'" in msgs and "'replica'" in msgs
+    # canonical axes and the locally bound Mesh axis are in scope
+    assert "'data'" not in msgs and "'rows'" not in msgs
+
+
+def test_shipped_shard_map_axes_are_bound():
+    """ops/linalg.py's TSQR shard_map all-gathers over 'data' — bound
+    by every mesh in this repo; the pass agrees."""
+    tree = ast.parse((REPO / "keystone_tpu/ops/linalg.py").read_text())
+    assert collective_axis_bindings(tree) == []
+
+
+# -- pass 3 (spec): sharding-flow lattice ------------------------------------
+
+def _analyzed(op, dep_spec_list):
+    """One-node graph: sources bound to dep_spec_list, op consuming
+    them, analyzed; returns the analysis object."""
+    from keystone_tpu.analysis.interpreter import analyze
+    from keystone_tpu.workflow.graph import Graph
+
+    g = Graph()
+    sources = []
+    for _ in dep_spec_list:
+        g, s = g.add_source()
+        sources.append(s)
+    g, node = g.add_node(op, tuple(sources))
+    g, _ = g.add_sink(node)
+    return analyze(g, dict(zip(sources, dep_spec_list)))
+
+
+def _sharded_stream_spec(d=12):
+    from keystone_tpu.analysis.spec import DatasetSpec
+
+    return DatasetSpec(jax.ShapeDtypeStruct((d,), np.float32), n=None,
+                       streaming=True, sharded=True)
+
+
+def test_cross_host_materialization_fires():
+    """A consumer collapsing a process-shard-local stream into a
+    resident dataset is flagged: the result is one host's fraction
+    presented as the whole."""
+    from keystone_tpu.analysis.spec import DatasetSpec
+    from keystone_tpu.workflow.operators import Operator
+
+    class MaterializeOp(Operator):
+        def execute(self, deps):
+            raise NotImplementedError
+
+        def abstract_eval(self, dep_specs):
+            return DatasetSpec(dep_specs[0].element, n=128,
+                               sparsity=1.0)  # resident: stream gone
+
+    analysis = _analyzed(MaterializeOp(), [_sharded_stream_spec()])
+    hits = sharding_flow_lint(analysis)
+    assert [d.code for d in hits] == ["cross-host-materialization"]
+    assert hits[0].severity == "error"
+    assert "ONE host's fraction" in hits[0].message
+
+
+def test_implicit_replication_fires_on_mixed_zip():
+    """A transformer zipping a sharded stream with a non-sharded
+    dataset warns: each host would pair its shard against the same
+    replicated rows."""
+    from keystone_tpu.analysis.spec import DatasetSpec
+    from keystone_tpu.workflow.operators import TransformerOperator
+
+    class ZipOp(TransformerOperator):
+        def single_transform(self, inputs):
+            return inputs[0] + inputs[1]
+
+    resident = DatasetSpec(jax.ShapeDtypeStruct((12,), np.float32),
+                           n=128, sparsity=1.0)
+    analysis = _analyzed(ZipOp(), [_sharded_stream_spec(), resident])
+    hits = sharding_flow_lint(analysis)
+    assert [d.code for d in hits] == ["implicit-replication"]
+    assert hits[0].severity == "warning"
+
+
+def test_sharded_provenance_propagates_and_streamable_fit_is_clean():
+    """The lattice: mapping a sharded stream keeps the provenance
+    (TransformerOperator.abstract_eval), and a STREAMABLE estimator on
+    a sharded stream raises no sharding-flow diagnostic (the
+    distributed fit tree-reduces its carries)."""
+    from keystone_tpu.analysis.diagnostics import check_graph
+    from keystone_tpu.analysis.spec import DatasetSpec
+    from keystone_tpu.nodes.learning.linear import LinearMapEstimator
+    from keystone_tpu.workflow.operators import TransformerOperator
+
+    class Identity(TransformerOperator):
+        def single_transform(self, inputs):
+            return inputs[0]
+
+    analysis = _analyzed(Identity(), [_sharded_stream_spec()])
+    node = next(iter(analysis.graph.nodes))
+    out = analysis.value(node)
+    assert isinstance(out, DatasetSpec) and out.sharded and out.streaming
+    assert sharding_flow_lint(analysis) == []
+
+    # end-to-end through check_graph: streamable labeled fit on a
+    # sharded stream — no sharding-flow diagnostics (the estimator
+    # exemption), and the spmd lints ride the standard check report
+    from keystone_tpu.parallel.streaming import StreamingDataset
+
+    X = np.random.RandomState(0).rand(64, 12).astype(np.float32)
+    Y = np.eye(4, dtype=np.float32)[np.arange(64) % 4]
+    stream = StreamingDataset.from_numpy(X, chunk_size=32, tag="spmd")
+    stream.process_sharded = True
+    p = LinearMapEstimator(lam=0.1).with_data(stream, Y)
+    rep = check_graph(p._graph, name="sharded-fit")
+    assert not [d for d in rep.diagnostics
+                if d.code in ("cross-host-materialization",
+                              "implicit-replication")]
+
+
+def test_check_graph_carries_sharding_flow_lint():
+    """check_graph (the `check` CLI engine) includes the sharding-flow
+    family: a materializing consumer of a sharded stream turns the
+    report red."""
+    from keystone_tpu.analysis.diagnostics import check_graph
+    from keystone_tpu.analysis.spec import DatasetSpec
+    from keystone_tpu.workflow.graph import Graph
+    from keystone_tpu.workflow.operators import Operator
+
+    class MaterializeOp(Operator):
+        def execute(self, deps):
+            raise NotImplementedError
+
+        def abstract_eval(self, dep_specs):
+            return DatasetSpec(dep_specs[0].element, n=64, sparsity=1.0)
+
+    g = Graph()
+    g, s = g.add_source()
+    g, node = g.add_node(MaterializeOp(), (s,))
+    g, _ = g.add_sink(node)
+    rep = check_graph(g, {s: _sharded_stream_spec()}, name="mat")
+    assert not rep.ok
+    assert "cross-host-materialization" in {d.code for d in rep.diagnostics}
+
+
+# -- pass 4: world-checkpoint consistency ------------------------------------
+
+def test_checkpoint_consistency_fires_on_offender():
+    hits = world_checkpoint_consistency(_tree("spmd_checkpoint_offender"))
+    codes = sorted(c for _, c, _ in hits)
+    assert codes == ["carry-restore-discipline",
+                     "unbarriered-host0-effect",
+                     "unbarriered-host0-effect"]
+    offenders = {m.split()[0] for _, _, m in hits}
+    assert offenders == {"unbarriered_merge", "unbarriered_clear",
+                         "raw_carry_restore"}
+
+
+def test_merge_needs_both_sides_clear_needs_before():
+    """merge_hosts reads peers' sidecars AND writes what peers resume
+    from: barrier before and after; clear only destroys — barrier
+    before suffices (the fit_streaming finalize-clear shape)."""
+    src = (
+        "def half_bracketed(world, ckpt):\n"
+        "    world.barrier('sidecars')\n"
+        "    if world.pid == 0:\n"
+        "        ckpt.merge_hosts(2)\n")
+    hits = world_checkpoint_consistency(ast.parse(src))
+    assert len(hits) == 1 and "after" in hits[0][2]
+    assert "before" not in hits[0][2].split("no world barrier")[1][:20]
+
+
+def test_checkpoint_allowlist_suppresses():
+    hits = world_checkpoint_consistency(
+        _tree("spmd_checkpoint_offender"),
+        allowlist={"unbarriered_merge:merge_hosts",
+                   "unbarriered_clear:clear",
+                   "raw_carry_restore:carry"})
+    assert hits == []
+
+
+def test_nested_defs_are_their_own_scanned_scopes():
+    """Review regression: the streaming hot path is closure-heavy
+    (produce/put/accumulate_one), so nested defs must be enumerated
+    and scanned as scopes of their own — a divergent barrier inside a
+    closure must not escape the pass, and the hit names the dotted
+    qualname an allowlist entry would use."""
+    src = (
+        "def outer():\n"
+        "    def inner():\n"
+        "        if process_index() == 0:\n"
+        "            sync_global_devices('oops')\n"
+        "    return inner\n")
+    hits = collective_divergence(ast.parse(src))
+    assert [c for _, c, _ in hits] == ["collective-divergence"]
+    assert hits[0][2].startswith("outer.inner ")
+    assert collective_divergence(
+        ast.parse(src), allowlist={
+            "outer.inner:sync_global_devices"}) == []
+
+
+def test_rebind_after_conditional_dynamic_bind_is_clean():
+    """Review regression: the dynamic-shape fold is TEXTUAL order — a
+    rebind from a fixed-shape expression between a conditional
+    dynamic bind and the gather kills the mark (BFS state used to
+    false-positive here, breaking the CI gate on correct code)."""
+    src = (
+        "def f(flag, data):\n"
+        "    if flag:\n"
+        "        xs = list(data)\n"
+        "    xs = fixed_summary()\n"
+        "    process_allgather(xs)\n")
+    assert barrier_stability(ast.parse(src)) == []
+    # without the rebind the dynamic bind reaches the gather: fires
+    bad = src.replace("    xs = fixed_summary()\n", "")
+    assert [c for _, c, _ in barrier_stability(ast.parse(bad))] == \
+        ["non-fixed-coordination-shape"]
+
+
+def test_step_does_not_satisfy_the_before_barrier():
+    """Review regression: the 'before' barrier must order the LAST
+    preceding sidecar write — `world.step` earlier in the round loop
+    (which every distributed fit has) is a rendezvous, not a
+    durability barrier, and a named barrier BEFORE the write orders
+    nothing either."""
+    body = (
+        "def round_loop(world, ckpt, idx, carry):\n"
+        "    state = world.step(cursor=idx, done=False)\n"
+        "{extra}"
+        "    ckpt.save_host('fp', world.pid, idx, carry)\n"
+        "{between}"
+        "    if world.pid == 0:\n"
+        "        ckpt.merge_hosts(world.nproc)\n"
+        "    world.barrier('ckpt-world')\n")
+    unordered = body.format(extra="", between="")
+    hits = world_checkpoint_consistency(ast.parse(unordered))
+    assert len(hits) == 1 and "before" in hits[0][2]
+    early = body.format(extra="    world.barrier('early')\n", between="")
+    hits = world_checkpoint_consistency(ast.parse(early))
+    assert len(hits) == 1 and "before" in hits[0][2]
+    bracketed = body.format(
+        extra="", between="    world.barrier('ckpt-sidecars')\n")
+    assert world_checkpoint_consistency(ast.parse(bracketed)) == []
+
+
+def test_conditional_kill_does_not_launder_fallthrough():
+    """Review regression: a rebind inside ONE branch must not kill the
+    taint for the fall-through path (any-path join); a rebind on BOTH
+    paths legitimately does."""
+    src = (
+        "def f(world):\n"
+        "    rank = process_index()\n"
+        "    if maybe():\n"
+        "        rank = 0\n"
+        "    if rank == 0:\n"
+        "        world.barrier('x')\n")
+    hits = collective_divergence(ast.parse(src))
+    assert [c for _, c, _ in hits] == ["collective-divergence"]
+    both = src.replace(
+        "    if rank == 0:",
+        "    else:\n        rank = 0\n    if rank == 0:")
+    assert collective_divergence(ast.parse(both)) == []
+
+
+def test_annassign_augassign_walrus_binds_are_tainted():
+    """Review regression: `rank: int = process_index()`,
+    `rank += process_index()`, and `(rank := process_index())` all
+    bind the seed — a one-character annotation must not defeat the
+    pass."""
+    ann = (
+        "def f(world):\n"
+        "    rank: int = process_index()\n"
+        "    if rank == 0:\n"
+        "        world.barrier('x')\n")
+    assert len(collective_divergence(ast.parse(ann))) == 1
+    aug = ann.replace("    rank: int = process_index()\n",
+                      "    rank = 0\n    rank += process_index()\n")
+    assert len(collective_divergence(ast.parse(aug))) == 1
+    walrus = (
+        "def f(world):\n"
+        "    if (rank := process_index()) == 0:\n"
+        "        world.barrier('x')\n"
+        "    if rank == 0:\n"
+        "        world.barrier('y')\n")
+    assert len(collective_divergence(ast.parse(walrus))) == 2
+
+
+def test_module_level_statements_are_scanned():
+    """Review regression: a script-style module body executing a
+    divergent collective at import time is a scope of its own
+    (`<module>`), not a blind spot."""
+    src = (
+        "import jax\n"
+        "if process_index() == 0:\n"
+        "    sync_global_devices('x')\n")
+    hits = collective_divergence(ast.parse(src))
+    assert [c for _, c, _ in hits] == ["collective-divergence"]
+    assert hits[0][2].startswith("<module> ")
+    assert collective_divergence(
+        ast.parse(src),
+        allowlist={"<module>:sync_global_devices"}) == []
+
+
+def test_keyword_spelled_tags_and_payloads_are_checked():
+    """Review regression: `sync_global_devices(name=...)` /
+    `world.barrier(name=...)` / `process_allgather(in_tree=...)` are
+    the same hazards as the positional spellings."""
+    assert [c for _, c, _ in barrier_stability(ast.parse(
+        "def f(i):\n    sync_global_devices(name=f'round-{i}')\n"))] \
+        == ["unstable-barrier-name"]
+    assert [c for _, c, _ in barrier_stability(ast.parse(
+        "def f(world, t):\n    world.barrier(name=t)\n"))] \
+        == ["unstable-barrier-name"]
+    assert [c for _, c, _ in barrier_stability(ast.parse(
+        "def f(rs):\n    xs = [r.key for r in rs]\n"
+        "    process_allgather(in_tree=xs)\n"))] \
+        == ["non-fixed-coordination-shape"]
+    assert barrier_stability(ast.parse(
+        "def f():\n    sync_global_devices(name='fixed')\n")) == []
+
+
+def test_host0_gate_taint_is_as_of_the_gate():
+    """Review regression: pass 4 folds taint up to each gate — a
+    LATER uniform rebind of the gating name must not mask an earlier
+    unbarriered host-0 effect, while a rebind BEFORE the gate still
+    launders (the shared textual discipline)."""
+    src = (
+        "def f(ckpt, n):\n"
+        "    rank = process_index()\n"
+        "    if rank == 0:\n"
+        "        ckpt.merge_hosts(n)\n"
+        "    rank = 0\n")
+    hits = world_checkpoint_consistency(ast.parse(src))
+    assert len(hits) == 1 and "unbarriered-host0-effect" == hits[0][1]
+    before = src.replace("    if rank == 0:",
+                         "    rank = 0\n    if rank == 0:")
+    assert world_checkpoint_consistency(ast.parse(before)) == []
+
+
+# -- the divergent dryrun worker is statically flagged -----------------------
+
+def test_divergent_worker_is_statically_flagged():
+    """The deliberately divergent dryrun worker
+    (tests/spmd_divergent_worker.py, deadlocked for real by the @slow
+    test in test_elastic.py) is exactly the hazard class pass 1
+    catches: the host-0-only sync_global_devices is flagged, the
+    matched enter barrier is not."""
+    tree = ast.parse(
+        (REPO / "tests" / "spmd_divergent_worker.py").read_text())
+    hits = collective_divergence(tree)
+    assert [c for _, c, _ in hits] == ["collective-divergence"]
+    assert "process_index() == 0" in hits[0][2]
+    # the matched barrier two lines up is NOT part of the hit
+    src_lines = (REPO / "tests" / "spmd_divergent_worker.py"
+                 ).read_text().splitlines()
+    assert "host0-only" in src_lines[hits[0][0] - 1]
+
+
+# -- the tree is clean -------------------------------------------------------
+
+def test_package_tree_is_spmd_clean():
+    """All four families over the shipped tree: zero diagnostics (the
+    one deliberate exception — the WorldCoordinator.barrier funnel —
+    lives in the commented SPMD_ALLOWLIST)."""
+    hits = scan_package(REPO / "keystone_tpu")
+    assert hits == [], hits
+
+
+def test_scan_schema_and_offenders_report(tmp_path):
+    """scan_package returns the {file, lineno, code, message} shape the
+    lint gate and the check CLI's `spmd` JSON key consume."""
+    pkg = tmp_path / "pkg"
+    (pkg / "parallel").mkdir(parents=True)
+    (pkg / "parallel" / "divergent.py").write_text(
+        (FIXTURES / "spmd_divergence_offender.py").read_text())
+    hits = scan_package(pkg)
+    assert {h["code"] for h in hits} == {"collective-divergence"}
+    for h in hits:
+        assert set(h) == {"file", "lineno", "code", "message"}
+        assert h["file"].endswith("divergent.py")
+        assert isinstance(h["lineno"], int) and h["lineno"] > 0
+
+
+# -- wiring: lint + check CLI ------------------------------------------------
+
+def test_lint_gate_runs_spmd_passes(tmp_path, monkeypatch):
+    """tools/lint.py fails when a package module has an SPMD
+    diagnostic (wired like the concurrency passes)."""
+    import sys
+
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import lint
+    finally:
+        sys.path.pop(0)
+    pkg = tmp_path / "keystone_tpu"
+    (pkg / "parallel").mkdir(parents=True)
+    (pkg / "parallel" / "bad.py").write_text(
+        (FIXTURES / "spmd_checkpoint_offender.py").read_text())
+    monkeypatch.setattr(lint, "REPO", tmp_path)
+    monkeypatch.setattr(lint, "PKG", pkg)
+    assert lint.run_spmd_rules() > 0
+
+
+@pytest.mark.slow
+def test_check_cli_json_carries_spmd_key(tmp_path):
+    """`python -m keystone_tpu check <app> --json` grows the `spmd`
+    key (clean today) next to `concurrency`/`metrics_names`, exit
+    codes preserved — the schema the CI consumers parse."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    out = tmp_path / "report.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+    proc = subprocess.run(
+        [sys.executable, "-m", "keystone_tpu", "check",
+         "mnist.random_fft", "--json", str(out)],
+        cwd=REPO, capture_output=True, text=True, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    blob = json.loads(out.read_text())
+    assert blob["spmd"] == []
+    assert isinstance(blob["spmd"], list)
+    assert blob["concurrency"] == []  # neighbours unchanged

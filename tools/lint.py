@@ -66,6 +66,21 @@ Three layers, all hermetic (no data, no device buffers):
      ``non-atomic-guarded-sequence`` — check-then-act on a guarded
      field split across two ``with`` blocks. Deliberate exceptions
      live in the commented ``CONCURRENCY_ALLOWLIST``.
+   - **SPMD safety** (``analysis.spmd``, tree-wide):
+     ``collective-divergence`` — a collective/barrier site reachable
+     under host-divergent control flow (a branch on
+     ``process_index()`` or per-host taint): one host skips the
+     collective and the rest of the world wedges in it;
+     ``unstable-barrier-name`` / ``non-fixed-coordination-shape`` —
+     barrier tags must be string literals per call site and
+     ``process_allgather`` payloads fixed-shape (the
+     ``WorldCoordinator.step`` ``(cursor, done)`` discipline);
+     ``unbound-collective-axis`` — ``psum``/``all_gather`` axis names
+     must be bound by a mesh axis in scope;
+     ``unbarriered-host0-effect`` / ``carry-restore-discipline`` —
+     host-0-only world-snapshot effects must be barrier-paired and
+     restored carries must re-enter through ``_restore_carry``.
+     Deliberate exceptions live in the commented ``SPMD_ALLOWLIST``.
 3. **ruff** (when installed): style/correctness pass over the package.
    Skipped with a notice when the container lacks ruff — layers 1–2
    are the required gate.
@@ -222,6 +237,26 @@ def run_concurrency_rules() -> int:
     return failures
 
 
+# -- layer 2a': SPMD-safety passes -------------------------------------------
+
+def run_spmd_rules() -> int:
+    """The four SPMD-safety pass families over the package tree
+    (single source of truth in ``analysis.spmd``: collective
+    divergence, barrier-name/coordination-shape stability, collective
+    axis bindings, world-checkpoint consistency; offender fixtures
+    under tests/lint_fixtures pin each rule's firing shape, and the
+    divergent dryrun worker reproduces the hang dynamically)."""
+    from keystone_tpu.analysis.spmd import scan_package
+
+    failures = 0
+    for hit in scan_package(PKG):
+        print(f"{hit['file']}:{hit['lineno']}: {hit['code']}: "
+              f"{hit['message']}")
+        failures += 1
+    print(f"spmd passes: {failures} failure(s)")
+    return failures
+
+
 # -- layer 2b: donation shape gate (spec-level, eval_shape) ------------------
 
 def _donating_modules():
@@ -331,6 +366,7 @@ def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     failures = run_ast_rules()
     failures += run_concurrency_rules()
+    failures += run_spmd_rules()
     failures += run_donation_shape_gate()
     failures += run_ruff()
     if "--skip-apps" not in argv:
