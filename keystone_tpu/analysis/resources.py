@@ -232,6 +232,120 @@ def moments_carry_nbytes(dep_specs: Sequence[Any]) -> Optional[float]:
     return None if d is None else 2.0 * 4.0 * d
 
 
+# -- Pallas kernel workspace (PR 13) ----------------------------------------
+#
+# The kernel program's dispatchers change what the apply path
+# materializes in HBM, and the plan should say so: the fused FV kernel
+# replaces the (nDesc, K) posterior round trip with two padded (Dp, Kp)
+# moment accumulators; the banded SIFT path keeps its band operators
+# resident as program constants. Each helper mirrors its dispatcher's
+# actual decision (``use_pallas()`` + the shared fits-vmem predicate),
+# so the charge follows the kernel the runtime will really pick.
+
+
+def fv_apply_transient_nbytes(d: int, k: int,
+                              n_desc: Optional[int]) -> Optional[float]:
+    """Per-item workspace of the Fisher-vector apply. Fused kernel
+    dispatched: the two (Dp, Kp) padded moment accumulators plus the
+    padded parameter blocks (q never exists in HBM). Fallback: the
+    (nDesc, K) posterior matrix the split form materializes between
+    the posterior and moment programs — None when nDesc is unknown
+    (the planner lists the node as unresolved rather than inventing
+    a number)."""
+    from ..ops.pallas_kernels import _LANE, _round_up, fv_fits_vmem, use_pallas
+
+    if use_pallas() and fv_fits_vmem(d, k):
+        dp = _round_up(max(d + 1, _LANE), _LANE)
+        kp = _round_up(max(k, _LANE), _LANE)
+        return 4.0 * (4.0 * dp * kp)
+    if n_desc is None:
+        return None
+    return 4.0 * float(n_desc) * k
+
+
+def sift_band_operator_nbytes(height: int, width: int, step: int,
+                              bin_size: int, num_scales: int,
+                              scale_step: int) -> float:
+    """Resident band-operator constants of one dense-SIFT config: the
+    per-scale smoothing matrices (H, H) + (W, W) and sampling operators
+    (NBP*n, L) both axes, charged once per config since the lru caches
+    keep them alive. When the banded kernel will dispatch
+    (`ops.sift._resolve_kernel_mode`), the sampling operators are
+    charged TWICE: `_sampling_operator_interleaved` caches a permuted
+    copy in addition to (not instead of) the bin-major original."""
+    from ..ops.sift import (
+        NBP,
+        _keypoint_grid,
+        _resolve_kernel_mode,
+        _scale_params,
+    )
+
+    sampling_copies = (
+        2.0 if _resolve_kernel_mode(None, height, width) != "einsum"
+        else 1.0)
+    total = 0.0
+    for scale in range(num_scales):
+        s, bs, lo = _scale_params(scale, step, bin_size, num_scales,
+                                  scale_step)
+        total += 4.0 * (height * height + width * width)
+        extent = float(bs * NBP)
+        ny = len(_keypoint_grid(height, lo, height - 1, s, extent))
+        nx = len(_keypoint_grid(width, lo, width - 1, s, extent))
+        total += sampling_copies * 4.0 * (
+            NBP * ny * height + NBP * nx * width)
+    return total
+
+
+def transform_workspace_effect(per_item_fn, data_specs: Sequence[Any],
+                               out_spec: Any,
+                               data_shards: int) -> Optional[ResourceEffect]:
+    """Spec-derived effect of an apply node plus its declared per-item
+    device workspace (kernel or fallback scratch): the workspace scales
+    with the batch for a resident dataset of known size (every item's
+    scratch is live inside the one batched program) and is charged once
+    per item otherwise. Returns None — deferring to the derived effect
+    — when the workspace does not resolve."""
+    import dataclasses
+
+    data = [s for s in data_specs
+            if isinstance(s, (DatasetSpec, DatumSpec))]
+    if not callable(per_item_fn) or not data:
+        return None
+    per_item = per_item_fn(data[0].element)
+    if per_item is None:
+        return None
+    if getattr(data[0], "streaming", False):
+        # a streamed apply only ever holds one chunk's items live —
+        # scaling by the stream's LOGICAL n would invent phantom
+        # gigabytes of transient (the plan charges the stream buffer,
+        # not the logical size; same principle here)
+        geom = getattr(data[0], "geometry", None)
+        items = geom.chunk_rows if geom is not None else 1
+    else:
+        n = getattr(data[0], "n", None)
+        items = 1 if n is None else padded_rows(n, data_shards)
+    base = spec_effect(out_spec, data_shards)
+    return dataclasses.replace(
+        base, transient_nbytes=base.transient_nbytes
+        + float(per_item) * items,
+        note=(base.note + "; " if base.note else "")
+        + "apply kernel workspace")
+
+
+def delegate_resource_effect(dep_specs: Sequence[Any], out_spec: Any,
+                             data_shards: int) -> Optional[ResourceEffect]:
+    """Effect of a Delegate (fitted-transformer apply) node: the
+    spec-derived output charge plus the fitted transformer's declared
+    apply workspace (``TransformerSpec.apply_transient_nbytes``, set
+    from the estimator's ``abstract_apply_transient`` hook). Returns
+    None — deferring to the derived effect — when the transformer
+    declares no workspace."""
+    t = dep_specs[0] if dep_specs else None
+    return transform_workspace_effect(
+        getattr(t, "apply_transient_nbytes", None), dep_specs[1:],
+        out_spec, data_shards)
+
+
 def estimator_resource_effect(estimator: Any,
                               dep_specs: Sequence[Any]) -> ResourceEffect:
     """Effect of an estimator node: the fitted model is the output that
@@ -348,7 +462,8 @@ def plan_graph(analysis: Any, name: str = "graph",
             label = op.label()
             dep_specs = [analysis.value(d)
                          for d in graph.get_dependencies(gid)]
-            override = op.resource_effect(dep_specs, spec)
+            override = op.resource_effect(dep_specs, spec,
+                                          data_shards=data_shards)
             if override is not None:
                 eff = override
         live[gid] = eff.out_nbytes

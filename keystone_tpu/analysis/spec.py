@@ -129,11 +129,17 @@ class TransformerSpec(AbstractValue):
     """Abstract fitted transformer. ``apply_element`` maps an input
     element spec to the fitted transformer's output element spec (what
     the estimator's ``abstract_fit`` promised); None when the estimator
-    does not describe its output."""
+    does not describe its output. ``apply_transient_nbytes`` maps the
+    same input element to the fitted apply's per-item device workspace
+    (the Pallas-kernel/fallback scratch the HBM planner charges at the
+    Delegate node — ``analysis.resources.delegate_resource_effect``);
+    None when the estimator declares none."""
 
     apply_element: Optional[Callable[[Any], Any]] = field(
         default=None, compare=False)
     label: str = "Transformer"
+    apply_transient_nbytes: Optional[Callable[[Any], Any]] = field(
+        default=None, compare=False)
 
     def __repr__(self) -> str:
         known = "known" if self.apply_element is not None else "opaque"

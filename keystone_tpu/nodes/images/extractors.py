@@ -41,6 +41,34 @@ class SIFTExtractor(Transformer):
             height, width, self.step, self.bin_size,
             self.num_scales, self.scale_step)
 
+    # -- static HBM planning (analysis.resources) --------------------------
+    def resource_effect(self, dep_specs, out_spec, data_shards=1):
+        """SIFT nodes charge their per-config band-operator constants
+        (smoothing + sampling matrices, resident whether they feed the
+        einsum or the Pallas banded kernel) as a one-off transient —
+        the lru caches keep the arrays alive across every image of a
+        config."""
+        import dataclasses
+
+        from ...analysis.resources import (
+            sift_band_operator_nbytes,
+            spec_effect,
+        )
+
+        element = (getattr(dep_specs[0], "element", None)
+                   if dep_specs else None)
+        if not (isinstance(element, jax.ShapeDtypeStruct)
+                and len(element.shape) >= 2):
+            return None
+        base = spec_effect(out_spec, data_shards)
+        extra = sift_band_operator_nbytes(
+            int(element.shape[0]), int(element.shape[1]), self.step,
+            self.bin_size, self.num_scales, self.scale_step)
+        return dataclasses.replace(
+            base, transient_nbytes=base.transient_nbytes + extra,
+            note=(base.note + "; " if base.note else "")
+            + "SIFT band-operator constants")
+
 
 class BatchSIFTExtractor(SIFTExtractor):
     """SIFT over per-item image batches via vmap (fixed image size)."""

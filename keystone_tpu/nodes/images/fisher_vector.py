@@ -36,16 +36,52 @@ from ..learning.gmm import (
 )
 
 
-@functools.partial(jax.jit, static_argnames=())
-def _fisher_vector(X, means, variances, weights, weight_threshold):
-    """X is (D, nDesc); means/variances (D, K); weights (K,)."""
-    n_desc = X.shape[1]
+def _fv_moment_sums(X, means, variances, weights, weight_threshold,
+                    kernel_mode=None):
+    """Raw posterior moment sums ``(sum q, X q, (X*X) q)`` of a
+    (D, nDesc) descriptor matrix — the FV encoder's hot path.
+
+    Dispatch (``kernel_mode=None`` = auto): the fused Pallas kernel on
+    TPU when its accumulators fit VMEM
+    (``ops.pallas_kernels.fv_moments_pallas`` — posteriors computed
+    tile-by-tile in VMEM, the (nDesc, K) posterior matrix never written
+    to HBM), else the split einsum fallback (the pre-kernel
+    implementation, bit-identical: one posterior program + three moment
+    GEMMs through HBM). ``"pallas_interpret"`` runs the kernel body on
+    the CPU interpreter (tier-1/parity-gate path); ``"einsum"`` forces
+    the fallback."""
+    from ...ops.pallas_kernels import (
+        fv_fits_vmem,
+        fv_moments_pallas,
+        use_pallas,
+    )
+
+    d, k = means.shape
+    mode = kernel_mode
+    if mode is None:
+        mode = ("pallas" if use_pallas() and fv_fits_vmem(d, k)
+                else "einsum")
+    if mode in ("pallas", "pallas_interpret"):
+        return fv_moments_pallas(
+            X, means, variances, weights, threshold=weight_threshold,
+            interpret=(mode == "pallas_interpret"))
     q = _posteriors(
         X.T, means.T, variances.T, weights, weight_threshold
     )  # (nDesc, K)
-    s0 = jnp.mean(q, axis=0)                      # (K,)
-    s1 = (X @ q) / n_desc                         # (D, K)
-    s2 = ((X * X) @ q) / n_desc                   # (D, K)
+    return jnp.sum(q, axis=0), X @ q, (X * X) @ q
+
+
+@functools.partial(
+    jax.jit, static_argnames=("weight_threshold", "kernel_mode"))
+def _fisher_vector(X, means, variances, weights, weight_threshold,
+                   kernel_mode=None):
+    """X is (D, nDesc); means/variances (D, K); weights (K,)."""
+    n_desc = X.shape[1]
+    q_sum, s1_sum, s2_sum = _fv_moment_sums(
+        X, means, variances, weights, weight_threshold, kernel_mode)
+    s0 = q_sum / n_desc                           # (K,)
+    s1 = s1_sum / n_desc                          # (D, K)
+    s2 = s2_sum / n_desc                          # (D, K)
     sqrt_w = jnp.sqrt(weights)
     fv1 = (s1 - means * s0[None, :]) / (jnp.sqrt(variances) * sqrt_w[None, :])
     fv2 = (s2 - 2.0 * means * s1 + (means * means - variances) * s0[None, :]) \
@@ -91,6 +127,17 @@ class FisherVector(Transformer):
     def struct_key(self):
         return (FisherVector, self.weight_threshold)
 
+    # -- static HBM planning (analysis.resources) --------------------------
+    def resource_effect(self, dep_specs, out_spec, data_shards=1):
+        """A pre-fitted FV node charges the same apply workspace the
+        estimator's Delegate node would (fused-kernel accumulators or
+        the fallback's posterior matrix)."""
+        from ...analysis.resources import transform_workspace_effect
+
+        return transform_workspace_effect(
+            _fisher_apply_transient(self.gmm.k), dep_specs, out_spec,
+            data_shards)
+
 
 def _gmm_from_columns(ds: Dataset, k: int,
                       seed: Optional[int] = None) -> GaussianMixtureModel:
@@ -135,6 +182,26 @@ def _fisher_fitted_nbytes(k: int, dep_specs):
     return 4.0 * (2.0 * d * k + k)
 
 
+def _fisher_apply_transient(k: int):
+    """Per-item apply workspace for the HBM planner: the fused-kernel
+    moment accumulators when the Pallas dispatch will take them, else
+    the (nDesc, K) posterior matrix the split fallback materializes
+    (``analysis.resources.fv_apply_transient_nbytes`` mirrors the
+    runtime dispatch)."""
+    import jax
+
+    from ...analysis.resources import fv_apply_transient_nbytes
+
+    def workspace(element):
+        if not (isinstance(element, jax.ShapeDtypeStruct)
+                and len(element.shape) == 2):
+            return None
+        return fv_apply_transient_nbytes(
+            int(element.shape[0]), k, int(element.shape[1]))
+
+    return workspace
+
+
 class ScalaGMMFisherVectorEstimator(Estimator):
     """Per-item-jit FV estimator (reference ``FisherVector.scala:67-73``;
     the name mirrors the reference's scala implementation)."""
@@ -148,6 +215,9 @@ class ScalaGMMFisherVectorEstimator(Estimator):
     # -- static HBM planning (analysis.resources) --------------------------
     def fitted_nbytes(self, dep_specs):
         return _fisher_fitted_nbytes(self.k, dep_specs)
+
+    def abstract_apply_transient(self, dep_specs):
+        return _fisher_apply_transient(self.k)
 
     def _fit(self, ds: Dataset) -> FisherVector:
         return FisherVector(_gmm_from_columns(ds, self.k))
@@ -173,6 +243,9 @@ class GMMFisherVectorEstimator(OptimizableEstimator):
     # -- static HBM planning (analysis.resources) --------------------------
     def fitted_nbytes(self, dep_specs):
         return _fisher_fitted_nbytes(self.k, dep_specs)
+
+    def abstract_apply_transient(self, dep_specs):
+        return _fisher_apply_transient(self.k)
 
     @property
     def default(self) -> Estimator:

@@ -74,20 +74,135 @@ def _array_token(a):
             float(m[0]), float(m[1]), float(m[2]), float(m[3]))
 
 
+# -- quantized predict (serving plane) -------------------------------------
+#
+# The PR 5 wire_dtype contract applied to WEIGHTS: a fitted mapper may
+# hold its weight matrix at a narrower dtype than f32 — bf16, or int8
+# with per-column scales — for the serving plane, where the apply path
+# re-reads the full (d, k) matrix from HBM per request batch. Accuracy
+# is policed two ways: the quantization error is recorded into the
+# numerics funnel the moment the weights narrow (``numerics.quant_error``
+# event + ``numerics.quant_rel_error`` gauge), and the parity gate
+# (tools/profile_imagenet.py, tests/test_pallas_kernels.py) pins
+# argmax agreement and an error bound against the f32 apply.
+
+def _canon_weight_dtype(weight_dtype):
+    if weight_dtype is None:
+        return None
+    alias = {"bf16": "bf16", "bfloat16": "bf16", "int8": "int8"}
+    try:
+        key = alias.get(str(np.dtype(weight_dtype)), None) \
+            if not isinstance(weight_dtype, str) else alias.get(weight_dtype)
+    except TypeError:
+        key = alias.get(str(weight_dtype))
+    if key is None:
+        raise ValueError(
+            f"weight_dtype must be None, 'bf16' or 'int8', got "
+            f"{weight_dtype!r}")
+    return key
+
+
+def _quantize_weights(W, weight_dtype):
+    """Quantize a fitted (d, k) f32 weight matrix: bf16 (scales of
+    ones), or int8 with per-COLUMN scales (symmetric, 127 levels —
+    each output class keeps its own dynamic range, so one large-norm
+    column cannot crush the resolution of the rest). Returns
+    ``(Wq, scale)`` and records the dequantization error into the
+    numerics funnel — quantization drift is a numbers-plane event, not
+    a silent precision choice."""
+    from ...observability import MetricsRegistry
+    from ...observability.numerics import record_numerics_event
+
+    Wf = jnp.asarray(W, jnp.float32)
+    k = Wf.shape[1]
+    if weight_dtype == "bf16":
+        Wq = Wf.astype(jnp.bfloat16)
+        scale = jnp.ones((k,), jnp.float32)
+    else:
+        amax = jnp.max(jnp.abs(Wf), axis=0)
+        scale = jnp.where(amax > 0.0, amax / 127.0, 1.0)
+        Wq = jnp.clip(jnp.round(Wf / scale[None, :]), -127.0, 127.0) \
+            .astype(jnp.int8)
+    deq = Wq.astype(jnp.float32) * scale[None, :]
+    denom = jnp.maximum(jnp.max(jnp.abs(Wf)), 1e-12)
+    err = jnp.abs(deq - Wf)
+    max_rel = float(jnp.max(err) / denom)
+    rms_rel = float(jnp.sqrt(jnp.mean(err * err)) / denom)
+    MetricsRegistry.get_or_create().gauge(
+        "numerics.quant_rel_error").set(max_rel)
+    record_numerics_event(
+        "quant_error", dtype=weight_dtype, shape=tuple(Wf.shape),
+        max_rel=round(max_rel, 6), rms_rel=round(rms_rel, 6))
+    return Wq, scale
+
+
+def _maybe_quantized_params(affine, weight_dtype):
+    """The shared apply_params tail of both mappers: narrow the f32
+    affine params when a weight_dtype is set (4-tuple stays the plain
+    `_affine_apply_batch` contract; 5-tuple is the quantized one)."""
+    if weight_dtype is None:
+        return affine
+    W, mean, inv_std, b = affine
+    Wq, scale = _quantize_weights(W, weight_dtype)
+    return (Wq, scale, mean, inv_std, b)
+
+
+def _dequant_affine(params, x):
+    """The ONE home of the dequantizing affine math — shared by the
+    per-item apply, the fused-chain apply_with_params, and the batched
+    program's einsum fallback, so the quantization semantics cannot
+    silently diverge between paths."""
+    Wq, scale, mean, inv_std, b = params
+    return ((x - mean) * inv_std) @ (
+        Wq.astype(jnp.float32) * scale[None, :]) + b
+
+
+@jax.jit
+def _quantized_affine_batch(X, Wq, scale, mean, inv_std, b):
+    """Whole-batch quantized fitted-model apply, params as ARGUMENTS
+    (the `_affine_apply_batch` contract — one compile serves every
+    refit): ``((X - mean) * inv_std) @ dequant(Wq) + b`` with f32
+    accumulation. Dispatch: the Pallas kernel on TPU when the
+    VMEM-resident weight block fits (``ops.pallas_kernels.
+    quantized_affine_pallas``), else the dequantizing einsum fallback
+    (bit-compatible: same dequantize-then-f32-matmul math)."""
+    from ...ops.pallas_kernels import (
+        quant_fits_vmem,
+        quantized_affine_pallas,
+        use_pallas,
+    )
+
+    d, k = Wq.shape
+    if use_pallas() and quant_fits_vmem(d, k, Wq.dtype.itemsize):
+        return quantized_affine_pallas(X, Wq, scale, mean, inv_std, b)
+    return _dequant_affine((Wq, scale, mean, inv_std, b), X)
+
+
 class LinearMapper(Transformer):
     """out = x_model^T in (+ b), with optional feature scaler
-    (reference ``LinearMapper.scala:18-62``)."""
+    (reference ``LinearMapper.scala:18-62``). ``weight_dtype`` narrows
+    the stored weights on the apply path (None = f32; ``"bf16"`` /
+    ``"int8"`` per-column-scaled — the serving plane's quantized
+    predict, see ``_quantize_weights``)."""
 
     def __init__(
         self,
         weights: np.ndarray,
         intercept: Optional[np.ndarray] = None,
         feature_scaler: Optional[StandardScalerModel] = None,
+        weight_dtype: Optional[str] = None,
     ):
         # host or device arrays, kept as handed in (see BlockLinearMapper)
         self.weights = weights
         self.intercept = intercept
         self.feature_scaler = feature_scaler
+        self.weight_dtype = _canon_weight_dtype(weight_dtype)
+        if (self.weight_dtype is not None and feature_scaler is not None
+                and type(feature_scaler) is not StandardScalerModel):
+            raise ValueError(
+                "weight_dtype quantization requires a plain "
+                "StandardScalerModel feature scaler (or none): the "
+                "quantized apply is one fused affine program")
 
     def __getstate__(self):
         d = super().__getstate__()  # strips per-instance jit caches
@@ -99,6 +214,7 @@ class LinearMapper(Transformer):
     def eq_key(self):
         return (
             LinearMapper,
+            self.weight_dtype,
             _array_token(self.weights),
             _array_token(self.intercept),
             None if self.feature_scaler is None
@@ -106,6 +222,8 @@ class LinearMapper(Transformer):
         )
 
     def apply(self, x):
+        if self.weight_dtype is not None:
+            return self.apply_with_params(self.apply_params(), x)
         if self.feature_scaler is not None:
             x = self.feature_scaler.apply(x)
         out = x @ self.weights
@@ -124,6 +242,9 @@ class LinearMapper(Transformer):
     def apply_dataset(self, ds: Dataset) -> Dataset:
         params = self.apply_params()
         if isinstance(ds, ArrayDataset) and params is not None:
+            if self.weight_dtype is not None:
+                return ds.map_batch(
+                    lambda X: _quantized_affine_batch(X, *params))
             return ds.map_batch(
                 lambda X: _affine_apply_batch(X, *params))
         return super().apply_dataset(ds)
@@ -140,18 +261,22 @@ class LinearMapper(Transformer):
             mean = None if scaler is None else scaler.mean
             inv = (None if scaler is None or scaler.std is None
                    else 1.0 / np.asarray(scaler.std))
-            params = _affine_params(self.weights, mean, inv, self.intercept)
+            params = _maybe_quantized_params(
+                _affine_params(self.weights, mean, inv, self.intercept),
+                self.weight_dtype)
             self.__dict__["_jit_affine_params"] = params  # _jit_*: unpickled
         return params
 
     def apply_with_params(self, params, x):
+        if self.weight_dtype is not None:
+            return _dequant_affine(params, x)
         W, mean, inv_std, b = params
         return ((x - mean) * inv_std) @ W + b
 
     def struct_key(self):
         if self._simple_scaler() is False:
             return super().struct_key()
-        return (LinearMapper, "affine")
+        return (LinearMapper, "affine", self.weight_dtype)
 
 
 class LinearMapEstimator(LabelEstimator):
@@ -159,8 +284,13 @@ class LinearMapEstimator(LabelEstimator):
     and labels; intercept = label mean (reference
     ``LinearMapper.scala:71-98``)."""
 
-    def __init__(self, lam: Optional[float] = None):
+    def __init__(self, lam: Optional[float] = None,
+                 weight_dtype: Optional[str] = None):
         self.lam = lam
+        # serving-plane quantized predict: the fitted mapper narrows
+        # its weights (validated eagerly so a typo fails at config
+        # time, not after the fit)
+        self.weight_dtype = _canon_weight_dtype(weight_dtype)
 
     def abstract_fit(self, dep_specs):
         from ...analysis.spec import labels_width_fit
@@ -198,6 +328,7 @@ class LinearMapEstimator(LabelEstimator):
             W,
             intercept=y_mean,
             feature_scaler=StandardScalerModel(x_mean),
+            weight_dtype=self.weight_dtype,
         )
 
     def _fit(self, ds: Dataset, labels: Dataset) -> LinearMapper:
@@ -216,6 +347,7 @@ class LinearMapEstimator(LabelEstimator):
             W,
             intercept=y_mean,
             feature_scaler=StandardScalerModel(x_mean),
+            weight_dtype=self.weight_dtype,
         )
 
     #: Serial device round-trips per fit (center / gram / factorize /
@@ -505,6 +637,7 @@ class BlockLinearMapper(Transformer):
         intercept: Optional[np.ndarray] = None,
         feature_means: Optional[np.ndarray] = None,
         weights: Optional[np.ndarray] = None,
+        weight_dtype: Optional[str] = None,
     ):
         # blocks are kept as handed in (host OR device arrays): forcing
         # np.asarray here would drag freshly-fitted device weights to
@@ -516,6 +649,7 @@ class BlockLinearMapper(Transformer):
         self.block_size = block_size
         self.intercept = intercept
         self.feature_means = feature_means
+        self.weight_dtype = _canon_weight_dtype(weight_dtype)
         if weights is not None:
             self.weights = weights
         else:
@@ -530,6 +664,7 @@ class BlockLinearMapper(Transformer):
         return (
             BlockLinearMapper,
             self.block_size,
+            self.weight_dtype,
             _array_token(self.weights),
             _array_token(self.intercept),
             _array_token(self.feature_means),
@@ -547,6 +682,8 @@ class BlockLinearMapper(Transformer):
         return d
 
     def apply(self, x):
+        if self.weight_dtype is not None:
+            return self.apply_with_params(self.apply_params(), x)
         if self.feature_means is not None:
             x = x - self.feature_means
         out = x @ self.weights
@@ -557,6 +694,9 @@ class BlockLinearMapper(Transformer):
     def apply_dataset(self, ds: Dataset) -> Dataset:
         if isinstance(ds, ArrayDataset):
             params = self.apply_params()
+            if self.weight_dtype is not None:
+                return ds.map_batch(
+                    lambda X: _quantized_affine_batch(X, *params))
             return ds.map_batch(
                 lambda X: _affine_apply_batch(X, *params))
         return super().apply_dataset(ds)
@@ -567,17 +707,21 @@ class BlockLinearMapper(Transformer):
     def apply_params(self):
         params = self.__dict__.get("_jit_affine_params")
         if params is None:
-            params = _affine_params(self.weights, self.feature_means,
-                                    None, self.intercept)
+            params = _maybe_quantized_params(
+                _affine_params(self.weights, self.feature_means,
+                               None, self.intercept),
+                self.weight_dtype)
             self.__dict__["_jit_affine_params"] = params  # _jit_*: unpickled
         return params
 
     def apply_with_params(self, params, x):
+        if self.weight_dtype is not None:
+            return _dequant_affine(params, x)
         W, mean, inv_std, b = params
         return ((x - mean) * inv_std) @ W + b
 
     def struct_key(self):
-        return (BlockLinearMapper, "affine")
+        return (BlockLinearMapper, "affine", self.weight_dtype)
 
     def _block_bounds(self) -> List[tuple]:
         bounds, lo = [], 0
@@ -627,10 +771,12 @@ class BlockLeastSquaresEstimator(LabelEstimator):
     (reference :204) for the auto-cache planner.
     """
 
-    def __init__(self, block_size: int, num_iter: int, lam: float = 0.0):
+    def __init__(self, block_size: int, num_iter: int, lam: float = 0.0,
+                 weight_dtype: Optional[str] = None):
         self.block_size = block_size
         self.num_iter = num_iter
         self.lam = lam
+        self.weight_dtype = _canon_weight_dtype(weight_dtype)
 
     @property
     def weight(self) -> int:
@@ -668,7 +814,8 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             G, C, sx, sy, jnp.asarray(n, G.dtype),
             jnp.asarray(float(self.lam), G.dtype), bounds, self.num_iter)
         return BlockLinearMapper(
-            list(Ws), bs, intercept=y_mean, feature_means=x_mean)
+            list(Ws), bs, intercept=y_mean, feature_means=x_mean,
+            weight_dtype=self.weight_dtype)
 
     def _fit(self, ds: Dataset, labels: Dataset) -> BlockLinearMapper:
         ds, labels = ensure_array(ds), ensure_array(labels)
@@ -683,7 +830,8 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         # blocks stay device-resident (see BlockLinearMapper.__init__)
         intercept = y_mean  # apply() centers x by the means, so b = y_mean
         return BlockLinearMapper(
-            list(Ws), bs, intercept=intercept, feature_means=x_mean
+            list(Ws), bs, intercept=intercept, feature_means=x_mean,
+            weight_dtype=self.weight_dtype,
         )
 
     #: The scan-based BCD stages the whole multi-pass solve into ONE

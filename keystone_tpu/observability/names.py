@@ -63,6 +63,9 @@ METRIC_NAMES: FrozenSet[str] = frozenset({
     "numerics.drift_score",      # gauge: latest apply-vs-fit PSI max
     "numerics.health_age_s",     # gauge (sampler probe): seconds since
                                  # the last health word was pulled
+    "numerics.quant_rel_error",  # gauge: max relative dequantization
+                                 # error of the most recently narrowed
+                                 # weight matrix (weight_dtype predict)
     # parallel/distributed.py — cross-host chunk-step coordination
     # (PR 11): the elastic multi-host streamed-fit plane
     "coord.world_size",      # gauge: jax process count of the live world
@@ -81,6 +84,28 @@ METRIC_PREFIXES: Tuple[str, ...] = (
     "numerics.",     # observability/numerics.py: one counter per
                      # numerics event kind (record_numerics_event)
 )
+
+
+#: BENCH metric-line names of the Pallas kernel program (PR 13).
+#: Bench lines are not process metrics (no counter/gauge call sites for
+#: the AST pass to check), but they cross the same process boundary:
+#: ``benchdiff`` classifies them BY NAME across BENCH_r*.json rounds and
+#: a renamed line silently becomes "new" (baseline reset — exactly the
+#: regression-masking a rename must not buy). New kernel bench lines are
+#: catalogued here next to the runtime names so renames stay two-line,
+#: reviewable changes — enforced by
+#: ``tests/test_pallas_kernels.py::test_bench_metric_names_catalogued``
+#: (a catalogued name absent from bench.py fails tier-1); each carries
+#: an ``*_mfu`` companion key that benchdiff bands alongside the
+#: headline (PR 9 companion-key pickup).
+BENCH_METRIC_NAMES: FrozenSet[str] = frozenset({
+    "sift_banded_images_per_sec_per_chip",   # banded-GEMM dense SIFT
+    "fv_fused_images_per_sec_per_chip",      # fused GMM-posterior + FV
+    "predict_quantized_f32_rows_per_sec_per_chip",   # quantized predict
+    "predict_quantized_bf16_rows_per_sec_per_chip",  # (f32 line is the
+    "predict_quantized_int8_rows_per_sec_per_chip",  # baseline the
+                                                     # parity keys cite)
+})
 
 
 def is_catalogued(name: str) -> bool:

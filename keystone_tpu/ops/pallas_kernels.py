@@ -1,20 +1,43 @@
-"""Pallas TPU kernels for the solver hot path.
+"""Pallas TPU kernels for the image and solver hot paths.
 
-The flagship solvers (normal equations, BCD) spend their FLOPs on two
-GEMMs over the same data: the Gram matrix X^T X and the cross-product
-X^T Y (SURVEY.md section 3.2 — the reference's per-partition Gram +
-treeReduce). As separate XLA ops each reads X from HBM once; the fused
-kernel streams each row-tile of X through VMEM exactly once and
-accumulates both products on the MXU — an HBM-bandwidth win when n is
-large (the usual case: n >> d).
+The kernel program (PERFORMANCE.md rule 13: write the kernel only where
+the roofline says so):
 
-Grid: one dimension over row tiles; both outputs map to the same block
-every step, so the kernel zeroes them on the first step and accumulates
-(the standard Pallas reduction pattern). Row padding is zero-filled by
-the wrapper, so padded rows contribute nothing.
+* :func:`gram_cross` — fused ``(X^T X, X^T Y)`` for the least-squares
+  family (SURVEY.md section 3.2 — the reference's per-partition Gram +
+  treeReduce). As separate XLA ops each GEMM reads X from HBM once; the
+  fused kernel streams each row-tile of X through VMEM exactly once and
+  accumulates both products on the MXU.
+* :func:`banded_matmul` — block-banded GEMM for the dense-SIFT band
+  matrices (``ops/sift.py``). The smoothing/binning operators are
+  mostly-zero band matrices; the dense einsum multiplies every tile
+  through the MXU. The band structure is static per ``(L, bin_size)``,
+  so the live-tile map is computed at trace time on the host and the
+  kernel visits only tiles the band touches (scalar-prefetch index
+  maps).
+* :func:`fv_moments_pallas` — fused GMM-posterior + Fisher-vector
+  moment accumulation (dispatched from
+  ``nodes/images/fisher_vector.py``). The split form materializes the
+  ``(nDesc, K)`` posterior matrix in HBM between the posterior and
+  moment programs; the fused kernel computes posteriors tile-by-tile
+  and accumulates the q/s1/s2 moment sums in VMEM — the stage flips
+  from memory-bound to compute-bound (PR 9 roofline).
+* :func:`quantized_affine_pallas` — the serving plane's quantized
+  predict (dispatched from ``nodes/learning/linear.py``):
+  ``((x - mean) * inv_std) @ W + b`` with W resident in VMEM at bf16 or
+  int8 (per-column scales), dequantized on the fly, f32 accumulation.
 
-Used automatically on TPU via :func:`gram_cross`; other backends fall
-back to two jnp matmuls (tests exercise the kernel in interpreter mode).
+All reductions follow the standard Pallas pattern: outputs map to the
+same block every grid step, zeroed on the first step and accumulated.
+Row padding is zero-filled by the wrappers, so padded rows contribute
+nothing (the FV kernel additionally masks padded descriptor columns —
+a zero descriptor still has a nonzero posterior).
+
+Every kernel dispatches via :func:`use_pallas` plus a per-kernel
+VMEM-fit predicate (one shared budget, :func:`fits_vmem`) and keeps a
+bit-compatible einsum fallback; tests exercise the kernel bodies in
+interpreter mode on CPU (``interpret=True``), so the kernel code itself
+is tier-1-covered in CPU-only containers.
 """
 from __future__ import annotations
 
@@ -153,21 +176,40 @@ def _device_vmem_bytes() -> int:
     return _MEASURED_VMEM_BYTES
 
 
-def _gram_vmem_slots() -> int:
-    """Budget in f32 slots: scaled DOWN proportionally on generations
-    reporting less VMEM than the measured chip (conservative — prevents
-    the scoped-vmem compiler OOM), but never scaled UP past the
-    measured boundary: the dp=1024 compiler crash was measured, and a
-    larger reported VMEM does not prove the scoped-vmem ceiling grew
-    with it. ``KEYSTONE_GRAM_VMEM_SLOTS`` overrides for generations
-    where a bigger budget has been validated by hand — read live (not
-    cached) so setting it mid-process takes effect; only the device
-    probe is cached."""
+def vmem_budget_slots() -> int:
+    """The shared per-kernel VMEM budget, in f32 slots — ONE home for
+    the fits-vmem arithmetic every dispatcher uses (gram, banded SIFT,
+    fused FV, quantized predict). Scaled DOWN proportionally on
+    generations reporting less VMEM than the measured chip
+    (conservative — prevents the scoped-vmem compiler OOM), but never
+    scaled UP past the measured boundary: the dp=1024 compiler crash
+    was measured, and a larger reported VMEM does not prove the
+    scoped-vmem ceiling grew with it. ``KEYSTONE_GRAM_VMEM_SLOTS``
+    overrides for generations where a bigger budget has been validated
+    by hand — read live (not cached) so setting it mid-process affects
+    every subsequent TRACE; only the device probe is cached. The honest
+    limit: dispatchers living inside jitted programs (the gram carry
+    update, sift's ``_dsift_one_scale``, linear's
+    ``_quantized_affine_batch``) bake their decision into the compiled
+    executable per (shape, static-args) signature, so the override
+    steers shapes traced AFTER it is set — set it before the first
+    fit/apply of a shape, not mid-steady-state."""
     env = os.environ.get("KEYSTONE_GRAM_VMEM_SLOTS")
     if env:
         return int(env)
     frac = min(1.0, _cached_device_vmem() / _MEASURED_VMEM_BYTES)
     return int(_GRAM_VMEM_SLOTS_V5E * frac)
+
+
+def fits_vmem(slots: float) -> bool:
+    """True when a kernel whose VMEM-resident footprint is ``slots``
+    f32 slots (accumulators + double-buffered input tiles + live
+    temps) fits the shared budget. Each kernel's dispatcher computes
+    its own footprint and asks this ONE predicate — beyond the budget
+    the TPU compiler crashes with a scoped-vmem OOM, so the wrappers
+    must fall back to the einsum path instead of attempting the
+    kernel."""
+    return slots <= vmem_budget_slots()
 
 
 @functools.lru_cache(maxsize=1)
@@ -176,12 +218,12 @@ def _cached_device_vmem() -> int:
 
 
 def gram_fits_vmem(d: int, k: int) -> bool:
-    """True when the fused kernel's VMEM-resident footprint
+    """True when the fused gram kernel's VMEM-resident footprint
     (accumulators + double-buffered input tiles) fits for feature dim d
     and label dim k (post-padding)."""
     dp = _round_up(max(d, _LANE), _LANE)
     kp = _round_up(max(k, _LANE), _LANE)
-    return (dp + 2 * ROW_TILE) * (dp + kp) <= _gram_vmem_slots()
+    return fits_vmem((dp + 2 * ROW_TILE) * (dp + kp))
 
 
 def gram_cross(X: jax.Array, Y: jax.Array) -> Tuple[jax.Array, jax.Array]:
@@ -316,3 +358,318 @@ def fused_cifar_featurize(imgs, filters, img_size=32, patch_size=6,
     # strip padding: regions R, channels K per half
     pooled = jnp.concatenate([out[:, :R, :K], out[:, :R, Kp:Kp + K]], axis=-1)
     return pooled.reshape(B, R * 2 * K)
+
+
+# -- banded GEMM (dense-SIFT band matrices) --------------------------------
+#
+# The SIFT smoothing/binning operators (ops/sift.py) are band matrices:
+# row j of the Gaussian operator touches columns [j - r, j + r]; the
+# interleaved sampling operator's rows advance `step` columns per
+# keypoint. Dense, each matmul drives every (tile_m, tile_l) block
+# through the MXU; banded, only the blocks the band touches are live —
+# the r5/r6 profiles measured the band matmuls at ~2x the useful FLOPs.
+# The band matrix is a host numpy constant per (L, bin_size) config, so
+# the live-tile map (first live column tile per row tile) is computed at
+# trace time and shipped as a scalar-prefetch argument the BlockSpec
+# index maps read.
+
+BAND_TILE_M = 128
+BAND_TILE_L = 128
+BAND_TILE_N = 128
+
+
+def band_tile_map(band: np.ndarray, tile_m: int = BAND_TILE_M,
+                  tile_l: int = BAND_TILE_L):
+    """Live-tile map of a host band matrix: for each ``tile_m``-row
+    tile, the first live column tile and the max live-tile count over
+    all row tiles (the static grid's inner extent). Starts are clamped
+    so ``start + max_count`` never exceeds the column-tile count: every
+    visited block is then either live or genuinely zero in the band
+    (zero blocks contribute nothing — no masking needed), and no block
+    is ever visited twice (distinct ``j`` -> distinct column tile)."""
+    m, l = band.shape
+    n_row_tiles = -(-m // tile_m)
+    n_col_tiles = -(-l // tile_l)
+    starts = np.zeros(n_row_tiles, np.int32)
+    max_count = 1
+    for i in range(n_row_tiles):
+        rows = band[i * tile_m:(i + 1) * tile_m]
+        nz = np.nonzero(np.any(rows != 0.0, axis=0))[0]
+        if len(nz) == 0:
+            starts[i] = 0
+            continue
+        lo, hi = int(nz[0]) // tile_l, int(nz[-1]) // tile_l
+        starts[i] = lo
+        max_count = max(max_count, hi - lo + 1)
+    starts = np.minimum(starts, max(n_col_tiles - max_count, 0))
+    return starts, max_count
+
+
+def _banded_kernel(starts_ref, x_ref, b_ref, o_ref, *, precision):
+    del starts_ref  # consumed by the index maps
+    @pl.when(pl.program_id(2) == 0)
+    def _():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    o_ref[:] += jax.lax.dot_general(
+        b_ref[:], x_ref[:], dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision)
+
+
+@functools.partial(
+    observed_jit, name="banded_matmul",
+    static_argnames=("tile_m", "tile_l", "tile_n", "max_count",
+                     "precision", "interpret"),
+)
+def banded_matmul_pallas(B, X, starts, *, tile_m=BAND_TILE_M,
+                         tile_l=BAND_TILE_L, tile_n=BAND_TILE_N,
+                         max_count=1, precision=None, interpret=False):
+    """``B @ X`` visiting only the band's live blocks. ``B`` is the
+    (tile-padded) band matrix, ``X`` the (row-padded) dense operand,
+    ``starts`` the per-row-tile first live column tile from
+    :func:`band_tile_map`. Grid: (row tiles, X column tiles, live band
+    tiles); the live-band extent iterates innermost so each (tile_m,
+    tile_n) output block stays VMEM-resident across its accumulation —
+    the kernel's footprint is three fixed tiles, independent of the
+    operand shapes."""
+    mp = B.shape[0]
+    n = X.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(mp // tile_m, n // tile_n, max_count),
+        in_specs=[
+            pl.BlockSpec((tile_l, tile_n), lambda i, c, j, s: (s[i] + j, c)),
+            pl.BlockSpec((tile_m, tile_l), lambda i, c, j, s: (i, s[i] + j)),
+        ],
+        out_specs=pl.BlockSpec((tile_m, tile_n), lambda i, c, j, s: (i, c)),
+    )
+    kernel = functools.partial(_banded_kernel, precision=precision)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((mp, n), jnp.float32),
+        interpret=interpret,
+    )(starts, X, B)
+
+
+def banded_fits_vmem(m: int, l: int, n: int) -> bool:
+    """VMEM footprint of one banded call: shape-INDEPENDENT by design
+    (three fixed tiles, double-buffered), so this normally always
+    passes — the predicate exists so the banded dispatcher obeys the
+    same fits-vmem contract as every other kernel and falls back when
+    a hand-shrunk budget (``KEYSTONE_GRAM_VMEM_SLOTS``) says the chip
+    cannot hold even the fixed tiles."""
+    del m, l, n  # footprint is tile-constant
+    slots = 2 * (BAND_TILE_M * BAND_TILE_N + BAND_TILE_L * BAND_TILE_N
+                 + BAND_TILE_M * BAND_TILE_L)
+    return fits_vmem(slots)
+
+
+def banded_matmul(band: np.ndarray, X: jax.Array, precision=None,
+                  interpret: bool = False) -> jax.Array:
+    """Banded ``band @ X`` for a HOST band matrix (a numpy constant —
+    the SIFT operators are lru_cached per config): pads both operands
+    to tile alignment, computes the live-tile map at trace time, runs
+    the kernel, slices the padding back off. The caller owns dispatch
+    (``use_pallas()`` + :func:`banded_fits_vmem`); this function always
+    takes the kernel path."""
+    m, l = band.shape
+    n = X.shape[1]
+    mp = _round_up(max(m, BAND_TILE_M), BAND_TILE_M)
+    lp = _round_up(max(l, BAND_TILE_L), BAND_TILE_L)
+    np_cols = _round_up(max(n, _LANE), _LANE)
+    bp = np.zeros((mp, lp), np.float32)
+    bp[:m, :l] = band
+    starts, max_count = band_tile_map(bp)
+    Xp = _pad_to(X.astype(jnp.float32), lp, np_cols)
+    out = banded_matmul_pallas(
+        jnp.asarray(bp), Xp, jnp.asarray(starts),
+        max_count=max_count, precision=precision, interpret=interpret)
+    return out[:m, :n]
+
+
+# -- fused GMM-posterior + Fisher-vector moments ---------------------------
+#
+# The FV stage's split form (nodes/images/fisher_vector.py) runs the
+# posterior program, writes the (nDesc, K) responsibility matrix q to
+# HBM, then reads it back for the three moment GEMMs — at ImageNet
+# shapes (~1e4 descriptors x K) that round trip made the stage
+# memory-bound on the PR 9 roofline. The fused kernel computes q one
+# descriptor tile at a time entirely in VMEM and accumulates the moment
+# sums (s0 = sum q, s1 = X q, s2 = (X*X) q) into VMEM-resident
+# accumulators; q never exists in HBM. s0 rides as an extra all-ones
+# row of X (row D of the padded operand), so the kernel has exactly two
+# outputs and the sums stay exact.
+
+FV_TILE = 512  # descriptor columns per grid step
+
+
+def _fv_moments_kernel(x_ref, a_ref, b_ref, c_ref, s1_ref, s2_ref, *,
+                       n_valid, tile, threshold):
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        s1_ref[:] = jnp.zeros_like(s1_ref)
+        s2_ref[:] = jnp.zeros_like(s2_ref)
+
+    x = x_ref[:]                                  # (Dp, T) tile of X
+    xsq = x * x
+    # sq_mahl/llh exactly as _posteriors (gmm.py): XSq A - X B + const,
+    # with the per-k constants folded host-side into c (padded K
+    # columns carry -1e30 so they vanish under the max-shift)
+    mahl = jax.lax.dot_general(
+        xsq, a_ref[:], dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    mahl -= jax.lax.dot_general(
+        x, b_ref[:], dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    llh = c_ref[0, :][None, :] - mahl             # (T, Kp)
+    shifted = llh - jnp.max(llh, axis=1, keepdims=True)
+    q = jnp.exp(shifted)
+    q = q / jnp.sum(q, axis=1, keepdims=True)
+    q = jnp.where(q > threshold, q, 0.0)
+    q = q / jnp.sum(q, axis=1, keepdims=True)
+    # padded descriptor columns: a zero descriptor still has a nonzero
+    # posterior, so mask by global column index (n_valid is static)
+    col = (pl.program_id(0) * tile
+           + jax.lax.broadcasted_iota(jnp.int32, (tile, 1), 0))
+    q = jnp.where(col < n_valid, q, 0.0)
+    s1_ref[:] += jax.lax.dot_general(
+        x, q, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    s2_ref[:] += jax.lax.dot_general(
+        xsq, q, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+@functools.partial(
+    observed_jit, name="fv_moments",
+    static_argnames=("threshold", "interpret"),
+)
+def fv_moments_pallas(X, means, variances, weights, *, threshold,
+                      interpret=False):
+    """Raw moment sums ``(s0, s1, s2)`` of the thresholded GMM
+    posteriors of ``X`` (a (D, nDesc) descriptor matrix) without ever
+    materializing the (nDesc, K) posterior matrix in HBM. Returns SUMS
+    (the caller divides by nDesc, matching the fallback's means)."""
+    d, n = X.shape
+    k = means.shape[1]
+    # one extra all-ones row carries s0 = sum(q) through the s1 GEMM
+    dp = _round_up(max(d + 1, _LANE), _LANE)
+    kp = _round_up(max(k, _LANE), _LANE)
+    tile = min(FV_TILE, _round_up(max(n, _LANE), _LANE))
+    np_cols = _round_up(n, tile)
+    Xp = jnp.zeros((dp, np_cols), jnp.float32)
+    Xp = Xp.at[:d, :n].set(X.astype(jnp.float32))
+    Xp = Xp.at[d, :].set(1.0)
+    A = jnp.zeros((dp, kp), jnp.float32).at[:d, :k].set(0.5 / variances)
+    B = jnp.zeros((dp, kp), jnp.float32).at[:d, :k].set(means / variances)
+    const = (-0.5 * d * jnp.log(2.0 * jnp.pi)
+             - 0.5 * jnp.sum(jnp.log(variances), axis=0)
+             + jnp.log(weights)
+             - 0.5 * jnp.sum(means * means / variances, axis=0))
+    c = jnp.full((1, kp), -1e30, jnp.float32).at[0, :k].set(const)
+
+    kernel = functools.partial(
+        _fv_moments_kernel, n_valid=n, tile=tile,
+        threshold=float(threshold))
+    s1, s2 = pl.pallas_call(
+        kernel,
+        grid=(np_cols // tile,),
+        in_specs=[
+            pl.BlockSpec((dp, tile), lambda i: (0, i)),
+            pl.BlockSpec((dp, kp), lambda i: (0, 0)),
+            pl.BlockSpec((dp, kp), lambda i: (0, 0)),
+            pl.BlockSpec((1, kp), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((dp, kp), lambda i: (0, 0)),
+            pl.BlockSpec((dp, kp), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((dp, kp), jnp.float32),
+            jax.ShapeDtypeStruct((dp, kp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(Xp, A, B, c)
+    return s1[d, :k], s1[:d, :k], s2[:d, :k]
+
+
+def fv_fits_vmem(d: int, k: int) -> bool:
+    """VMEM footprint of the fused FV kernel: two (Dp, Kp) moment
+    accumulators resident across the grid, the (Dp, Kp) A/B parameter
+    blocks, double-buffered (Dp, tile) descriptor tiles, and the
+    (tile, Kp) q/llh working set (~3 live temps)."""
+    dp = _round_up(max(d + 1, _LANE), _LANE)
+    kp = _round_up(max(k, _LANE), _LANE)
+    slots = (4 * dp * kp + 2 * dp * FV_TILE + 3 * FV_TILE * kp + kp)
+    return fits_vmem(slots)
+
+
+# -- quantized predict (serving plane) -------------------------------------
+#
+# The fitted-model apply is one affine program (linear.py
+# _affine_apply_batch); at serving batch sizes it is weight-bandwidth
+# bound: every request batch re-reads the full f32 (d, k) weight
+# matrix from HBM. The quantized kernel holds W VMEM-resident at bf16
+# or int8 (per-column scales — the PR 5 wire_dtype discipline applied
+# to weights), dequantizes on the fly, and accumulates in f32.
+
+QUANT_TILE = 128  # batch rows per grid step
+
+
+def _quantized_affine_kernel(x_ref, w_ref, scale_ref, mean_ref, inv_ref,
+                             b_ref, o_ref):
+    xn = (x_ref[:] - mean_ref[0, :][None, :]) * inv_ref[0, :][None, :]
+    w = w_ref[:].astype(jnp.float32) * scale_ref[0, :][None, :]
+    o_ref[:] = jnp.dot(xn, w, preferred_element_type=jnp.float32) \
+        + b_ref[0, :][None, :]
+
+
+@functools.partial(observed_jit, name="quantized_affine",
+                   static_argnames=("interpret",))
+def quantized_affine_pallas(X, Wq, scale, mean, inv_std, b,
+                            interpret=False):
+    """``((X - mean) * inv_std) @ dequant(Wq) + b`` with ``Wq`` in bf16
+    or int8 and ``scale`` the per-column dequantization scales (ones
+    for bf16). W stays VMEM-resident across the whole batch; only the
+    batch tiles stream."""
+    n, d = X.shape
+    k = Wq.shape[1]
+    dp = _round_up(max(d, _LANE), _LANE)
+    kp = _round_up(max(k, _LANE), _LANE)
+    tile = min(QUANT_TILE, _round_up(max(n, _SUBLANE), _SUBLANE))
+    np_rows = _round_up(n, tile)
+    Xp = _pad_to(X.astype(jnp.float32), np_rows, dp)
+    Wp = _pad_to(Wq, dp, kp)
+    def row(v, width):
+        return _pad_to(v.astype(jnp.float32).reshape(1, -1), 1, width)
+
+    scale_p, mean_p, inv_p, b_p = (row(scale, kp), row(mean, dp),
+                                   row(inv_std, dp), row(b, kp))
+    out = pl.pallas_call(
+        _quantized_affine_kernel,
+        grid=(np_rows // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, dp), lambda i: (i, 0)),
+            pl.BlockSpec((dp, kp), lambda i: (0, 0)),
+            pl.BlockSpec((1, kp), lambda i: (0, 0)),
+            pl.BlockSpec((1, dp), lambda i: (0, 0)),
+            pl.BlockSpec((1, dp), lambda i: (0, 0)),
+            pl.BlockSpec((1, kp), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile, kp), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((np_rows, kp), jnp.float32),
+        interpret=interpret,
+    )(Xp, Wp, scale_p, mean_p, inv_p, b_p)
+    return out[:n, :k]
+
+
+def quant_fits_vmem(d: int, k: int, weight_itemsize: int = 1) -> bool:
+    """VMEM footprint of the quantized-affine kernel: the narrow (Dp,
+    Kp) weight block plus its f32 dequantized copy resident, and
+    double-buffered (tile, Dp) input / (tile, Kp) output tiles."""
+    dp = _round_up(max(d, _LANE), _LANE)
+    kp = _round_up(max(k, _LANE), _LANE)
+    slots = (dp * kp * (1.0 + weight_itemsize / 4.0)
+             + 2 * QUANT_TILE * (dp + kp) + 2 * (dp + kp))
+    return fits_vmem(slots)

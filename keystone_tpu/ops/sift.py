@@ -169,13 +169,53 @@ def _sampling_operator(length: int, lo: int, step: int,
 _PRECISION = jax.lax.Precision.HIGH
 
 
+@functools.lru_cache(maxsize=128)
+def _sampling_operator_interleaved(length: int, lo: int, step: int,
+                                   bin_size: int) -> Tuple[np.ndarray, int]:
+    """Row-permuted :func:`_sampling_operator` for the banded kernel:
+    rows ordered keypoint-major (``i * NBP + b``) instead of bin-major
+    (``b * n + i``). Bin-major rows sweep the whole axis within one bin
+    block, so a 128-row tile's band support spans nearly every column
+    tile; keypoint-major rows advance ``step`` columns per keypoint and
+    the NBP bin offsets differ by only ``bin_size``, so a row tile's
+    support stays a narrow contiguous band — the structure
+    :func:`~keystone_tpu.ops.pallas_kernels.band_tile_map` exploits."""
+    T, n = _sampling_operator(length, lo, step, bin_size)
+    if n == 0:
+        return T, 0
+    Ti = np.ascontiguousarray(
+        T.reshape(NBP, n, length).transpose(1, 0, 2).reshape(
+            NBP * n, length))
+    return Ti, n
+
+
+def _resolve_kernel_mode(kernel_mode, height: int, width: int) -> str:
+    """Dispatch for the SIFT band matmuls: ``None`` auto-selects the
+    Pallas banded kernel on TPU when the fixed tile footprint fits VMEM
+    and the image is big enough for the band to skip tiles (more than
+    one 128-column tile per axis — at CIFAR sizes the 'band' IS the
+    whole matrix and the kernel would only add launch overhead).
+    Explicit modes: ``"banded"`` (compiled kernel), ``"banded_interpret"``
+    (kernel body on the CPU interpreter — the tier-1/parity-gate path),
+    ``"einsum"`` (the XLA fallback, bit-identical to the pre-kernel
+    implementation)."""
+    if kernel_mode is not None:
+        return kernel_mode
+    from .pallas_kernels import banded_fits_vmem, use_pallas
+
+    if (use_pallas() and banded_fits_vmem(height, width, width)
+            and min(height, width) > 128):
+        return "banded"
+    return "einsum"
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("height", "width", "step", "bin_size", "lo",
-                     "precision"),
+                     "precision", "kernel_mode"),
 )
 def _dsift_one_scale(img, height, width, step, bin_size, lo,
-                     precision=None):
+                     precision=None, kernel_mode=None):
     """Dense SIFT at one scale. Returns (128, numDesc) NORMALIZED,
     quantized descriptors. All heavy lifting is band-matrix matmuls
     (MXU): smoothing via ``_smooth_band``, spatial binning + sampling
@@ -184,8 +224,16 @@ def _dsift_one_scale(img, height, width, step, bin_size, lo,
 
     ``precision`` overrides the module default for the band matmuls —
     static, so each precision gets its own compiled program (the parity
-    gate compares HIGH against HIGHEST on identical inputs)."""
+    gate compares HIGH against HIGHEST on identical inputs).
+    ``kernel_mode`` picks the band-matmul implementation (see
+    :func:`_resolve_kernel_mode`; None = auto — the Pallas banded
+    kernel on TPU where it fits VMEM, the einsum fallback elsewhere)."""
     precision = _PRECISION if precision is None else precision
+    mode = _resolve_kernel_mode(kernel_mode, height, width)
+    if mode in ("banded", "banded_interpret"):
+        return _dsift_one_scale_banded(
+            img, height, width, step, bin_size, lo, precision,
+            interpret=(mode == "banded_interpret"))
     Gy = jnp.asarray(_smooth_band(height, bin_size))
     Gx = jnp.asarray(_smooth_band(width, bin_size))
     smoothed = jnp.einsum("ih,hw,jw->ij", Gy, img, Gx,
@@ -201,6 +249,43 @@ def _dsift_one_scale(img, height, width, step, bin_size, lo,
                       jnp.asarray(Tx), precision=precision)
     return _normalize_quantize_binned(
         bins.reshape(NBO, NBP, ny, NBP, nx))
+
+
+def _dsift_one_scale_banded(img, height, width, step, bin_size, lo,
+                            precision, interpret=False):
+    """The banded-kernel body of :func:`_dsift_one_scale`: the same
+    three band contractions (smooth rows, smooth cols, bin+sample both
+    axes) with each matmul visiting only the band's live MXU tiles
+    (``ops.pallas_kernels.banded_matmul``). The sampling operators use
+    the keypoint-major row order so their band stays narrow; the final
+    transpose restores the bin-major (o, by, iy, bx, ix) layout the
+    normalizer expects — descriptors are bit-compatible with the einsum
+    path up to matmul reduction order."""
+    from .pallas_kernels import banded_matmul
+
+    Gy = _smooth_band(height, bin_size)
+    Gx = _smooth_band(width, bin_size)
+    z = banded_matmul(Gy, img, precision=precision, interpret=interpret)
+    smoothed = banded_matmul(Gx, z.T, precision=precision,
+                             interpret=interpret).T
+    omaps = _orientation_maps(smoothed)            # (8, H, W)
+
+    Ty, ny = _sampling_operator_interleaved(height, lo, step, bin_size)
+    Tx, nx = _sampling_operator_interleaved(width, lo, step, bin_size)
+    if ny == 0 or nx == 0:
+        return jnp.zeros((DIMS, 0), smoothed.dtype)
+    py, px = NBP * ny, NBP * nx
+    # contract over h: (py, H) @ (H, 8W) — o rides the column axis
+    x1 = omaps.transpose(1, 0, 2).reshape(height, NBO * width)
+    z1 = banded_matmul(Ty, x1, precision=precision, interpret=interpret)
+    # contract over w: (px, W) @ (W, 8*py)
+    x2 = z1.reshape(py, NBO, width).transpose(2, 1, 0).reshape(
+        width, NBO * py)
+    z2 = banded_matmul(Tx, x2, precision=precision, interpret=interpret)
+    bins = z2.reshape(px, NBO, py).transpose(1, 2, 0)  # (o, py, px)
+    # keypoint-major rows (i*NBP + b) -> the (o, by, iy, bx, ix) layout
+    b5 = bins.reshape(NBO, ny, NBP, nx, NBP).transpose(0, 2, 1, 4, 3)
+    return _normalize_quantize_binned(b5)
 
 
 def _normalize_quantize_binned(b5: jax.Array) -> jax.Array:
@@ -241,13 +326,17 @@ def dense_sift(
     num_scales: int = 5,
     scale_step: int = 0,
     precision=None,
+    kernel_mode=None,
 ) -> jax.Array:
     """Multi-scale dense SIFT of a grayscale (H, W) image in [0, 1].
 
     Returns (128, numDesc) float32, scales concatenated in order —
     matching ``VLFeat.getSIFTs`` (reference
     ``utils/external/VLFeat.scala:17-27``). ``precision`` overrides the
-    band-matmul default (parity gating; None = module default HIGH).
+    band-matmul default (parity gating; None = module default HIGH);
+    ``kernel_mode`` overrides the banded-kernel dispatch (parity gating
+    and CPU interpreter tests; None = auto, see
+    :func:`_resolve_kernel_mode`).
     """
     height, width = int(img_gray.shape[0]), int(img_gray.shape[1])
     outs: List[jax.Array] = []
@@ -256,7 +345,7 @@ def dense_sift(
             scale, step, bin_size, num_scales, scale_step)
         outs.append(_dsift_one_scale(
             img_gray, height, width, s, scale_value, lo,
-            precision=precision))
+            precision=precision, kernel_mode=kernel_mode))
     return jnp.concatenate(outs, axis=1)  # (128, N)
 
 

@@ -73,14 +73,15 @@ class Operator:
         return Unknown(f"{type(self).__name__} has no abstract_eval")
 
     def resource_effect(self, dep_specs: Sequence[Any],
-                        out_spec: Any) -> Any:
+                        out_spec: Any, data_shards: int = 1) -> Any:
         """Static resource annotation for the HBM planner
         (``analysis.resources.plan_graph``): return a ``ResourceEffect``
         describing this node's device-memory contribution, or None to
         let the planner derive it from ``out_spec`` (output bytes from
         the dataset/datum element, stream residency from chunk
         geometry). Estimators override to add their accumulator carry
-        and fitted-model footprint."""
+        and fitted-model footprint; Delegate nodes add the fitted
+        transformer's declared apply-kernel workspace."""
         return None
 
     def label(self) -> str:
@@ -249,7 +250,7 @@ class EstimatorOperator(Operator):
 
     # -- static analysis ---------------------------------------------------
     def resource_effect(self, dep_specs: Sequence[Any],
-                        out_spec: Any) -> Any:
+                        out_spec: Any, data_shards: int = 1) -> Any:
         """Estimator nodes charge their accumulator carry (the Gram /
         cross / moment buffers a streamed fit keeps resident — the same
         workspace a resident normal-equations solve materializes) as a
@@ -270,11 +271,21 @@ class EstimatorOperator(Operator):
         scalers: identity, PCA: d -> dims) override this."""
         return None
 
+    def abstract_apply_transient(self, dep_specs: Sequence[Any]):
+        """Describe the fitted apply's per-item device workspace:
+        return a callable mapping an input element spec to bytes (or
+        None), or None when this estimator declares none. Estimators
+        whose fitted apply dispatches a Pallas kernel override this so
+        the HBM planner charges the kernel (or fallback) scratch at the
+        Delegate node."""
+        return None
+
     def abstract_eval(self, dep_specs: Sequence[Any]) -> Any:
         from ..analysis.spec import TransformerSpec
 
         return TransformerSpec(
-            self.abstract_fit(dep_specs), label=self.label())
+            self.abstract_fit(dep_specs), label=self.label(),
+            apply_transient_nbytes=self.abstract_apply_transient(dep_specs))
 
 
 class DelegatingOperator(Operator):
@@ -319,6 +330,12 @@ class DelegatingOperator(Operator):
                            streaming=data[0].streaming,
                            geometry=_shared_geometry([data[0]]),
                            sharded=data[0].sharded)
+
+    def resource_effect(self, dep_specs: Sequence[Any],
+                        out_spec: Any, data_shards: int = 1) -> Any:
+        from ..analysis.resources import delegate_resource_effect
+
+        return delegate_resource_effect(dep_specs, out_spec, data_shards)
 
     def label(self) -> str:
         return "Delegate"

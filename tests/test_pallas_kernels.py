@@ -112,3 +112,314 @@ def test_gram_vmem_guard_counts_input_tiles():
     from keystone_tpu.ops.pallas_kernels import gram_fits_vmem
 
     assert not gram_fits_vmem(128, 6912)
+
+
+# -- shared fits-vmem predicate (PR 13 satellite) ---------------------------
+
+
+def test_fits_vmem_boundary_is_exact(monkeypatch):
+    """Every kernel dispatcher asks the ONE shared predicate; pin the
+    fallback trigger exactly at the boundary via the env override
+    (read live, so setting it mid-process takes effect)."""
+    from keystone_tpu.ops import pallas_kernels as pk
+
+    cases = {
+        "gram": (lambda: pk.gram_fits_vmem(512, 16),
+                 (512 + 2 * pk.ROW_TILE) * (512 + 128)),
+        "banded": (lambda: pk.banded_fits_vmem(480, 480, 5120),
+                   2 * (pk.BAND_TILE_M * pk.BAND_TILE_N
+                        + pk.BAND_TILE_L * pk.BAND_TILE_N
+                        + pk.BAND_TILE_M * pk.BAND_TILE_L)),
+        "fv": (lambda: pk.fv_fits_vmem(64, 16),
+               4 * 128 * 128 + 2 * 128 * pk.FV_TILE
+               + 3 * pk.FV_TILE * 128 + 128),
+        "quant": (lambda: pk.quant_fits_vmem(64, 16, 1),
+                  128 * 128 * 1.25 + 2 * pk.QUANT_TILE * 256 + 2 * 256),
+    }
+    for name, (predicate, slots) in cases.items():
+        monkeypatch.setenv("KEYSTONE_GRAM_VMEM_SLOTS", str(int(slots)))
+        assert predicate(), f"{name}: must fit AT its own footprint"
+        monkeypatch.setenv("KEYSTONE_GRAM_VMEM_SLOTS", str(int(slots) - 1))
+        assert not predicate(), f"{name}: must fall back one slot under"
+
+
+# -- banded GEMM (PR 13 tentpole 1) -----------------------------------------
+
+
+def _random_band(rng, m, l, bw):
+    band = np.zeros((m, l), np.float32)
+    for j in range(m):
+        lo = max(0, min(j, l - 1) - bw)
+        hi = min(l, min(j, l - 1) + bw + 1)
+        band[j, lo:hi] = rng.randn(hi - lo)
+    return band
+
+
+@pytest.mark.parametrize("m,l,n,bw", [
+    (128, 128, 64, 9),    # single tile pair
+    (300, 300, 70, 21),   # ragged everything
+    (97, 97, 33, 5),      # all dims under one tile
+    (256, 512, 130, 41),  # rectangular, multi-tile band
+])
+def test_banded_matmul_interpret(m, l, n, bw):
+    from keystone_tpu.ops.pallas_kernels import banded_matmul
+
+    rng = np.random.RandomState(0)
+    band = _random_band(rng, m, l, bw)
+    X = rng.randn(l, n).astype(np.float32)
+    out = np.asarray(banded_matmul(band, jnp.asarray(X), interpret=True))
+    np.testing.assert_allclose(out, band @ X, rtol=2e-4, atol=2e-4)
+
+
+def test_band_tile_map_covers_every_live_tile():
+    """Correctness invariant of the trace-time tile map: every nonzero
+    (row tile, col tile) block of the band is visited by some inner
+    step, and no column tile is visited twice for one row tile."""
+    from keystone_tpu.ops.pallas_kernels import (
+        BAND_TILE_L,
+        BAND_TILE_M,
+        band_tile_map,
+    )
+
+    rng = np.random.RandomState(1)
+    band = np.zeros((512, 640), np.float32)
+    for j in range(512):
+        c = min(int(j * 1.2), 639)
+        band[j, max(0, c - 30):c + 31] = 1.0
+    band[250:260, :] = 0.0  # an all-zero row tile region
+    starts, max_count = band_tile_map(band)
+    n_col_tiles = 640 // BAND_TILE_L
+    for i in range(512 // BAND_TILE_M):
+        visited = {int(starts[i]) + j for j in range(max_count)}
+        assert len(visited) == max_count  # distinct -> never double-added
+        assert all(0 <= c < n_col_tiles for c in visited)
+        rows = band[i * BAND_TILE_M:(i + 1) * BAND_TILE_M]
+        for c in range(n_col_tiles):
+            if rows[:, c * BAND_TILE_L:(c + 1) * BAND_TILE_L].any():
+                assert c in visited, (i, c)
+
+
+@pytest.mark.parametrize("h,w", [(96, 128), (90, 110)])
+def test_dense_sift_banded_matches_einsum(h, w):
+    """The banded kernel's descriptors must sit inside the golden
+    envelope of the einsum path (max <= 2 quantization levels, mean <=
+    0.15 — the same bound the HIGH-vs-HIGHEST gate uses); measured
+    deltas are ~1e-5."""
+    from keystone_tpu.ops.sift import dense_sift
+
+    rng = np.random.RandomState(0)
+    img = jnp.asarray(rng.rand(h, w).astype(np.float32))
+    kw = dict(step=4, bin_size=4, num_scales=2, scale_step=1)
+    a = np.asarray(dense_sift(img, kernel_mode="einsum", **kw))
+    b = np.asarray(dense_sift(img, kernel_mode="banded_interpret", **kw))
+    assert a.shape == b.shape and a.shape[1] > 0
+    diff = np.abs(a - b)
+    assert diff.max() <= 2.0 and diff.mean() <= 0.15
+    np.testing.assert_allclose(b, a, atol=5e-3)
+
+
+def test_sift_kernel_mode_auto_dispatch(monkeypatch):
+    """Auto mode: einsum on CPU; banded on (mocked) TPU for images big
+    enough to skip tiles, einsum for CIFAR-size images where the band
+    IS the whole matrix."""
+    from keystone_tpu.ops import pallas_kernels as pk
+    from keystone_tpu.ops import sift as S
+
+    assert S._resolve_kernel_mode(None, 480, 640) == "einsum"  # CPU
+    monkeypatch.setattr(pk, "use_pallas", lambda: True)
+    assert S._resolve_kernel_mode(None, 480, 640) == "banded"
+    assert S._resolve_kernel_mode(None, 32, 32) == "einsum"
+    monkeypatch.setenv("KEYSTONE_GRAM_VMEM_SLOTS", "1")
+    assert S._resolve_kernel_mode(None, 480, 640) == "einsum"
+
+
+# -- fused GMM-posterior + FV moments (PR 13 tentpole 2) --------------------
+
+
+def _gmm_params(rng, d, k):
+    return (rng.randn(d, k).astype(np.float32),
+            (0.5 + rng.rand(d, k)).astype(np.float32),
+            (rng.dirichlet(np.ones(k))).astype(np.float32))
+
+
+@pytest.mark.parametrize("d,k,n", [(64, 16, 513), (32, 8, 100), (7, 3, 12)])
+def test_fv_moments_pallas_interpret(d, k, n):
+    """Kernel moments == fallback (posterior matrix) moments at mixed
+    shapes including ragged descriptor counts (n not a tile multiple:
+    the kernel must mask padded descriptor columns — a zero descriptor
+    still has a nonzero posterior)."""
+    from keystone_tpu.nodes.learning.gmm import _posteriors
+    from keystone_tpu.ops.pallas_kernels import fv_moments_pallas
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(d, n).astype(np.float32)
+    means, variances, weights = _gmm_params(rng, d, k)
+    q = np.asarray(_posteriors(
+        jnp.asarray(X.T), jnp.asarray(means.T), jnp.asarray(variances.T),
+        jnp.asarray(weights), 1e-4))
+    s0, s1, s2 = fv_moments_pallas(
+        jnp.asarray(X), jnp.asarray(means), jnp.asarray(variances),
+        jnp.asarray(weights), threshold=1e-4, interpret=True)
+    np.testing.assert_allclose(np.asarray(s0), q.sum(0),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(s1), X @ q, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(s2), (X * X) @ q,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_fisher_vector_fused_matches_fallback():
+    """End-to-end FV parity, per item and under vmap (the production
+    featurizer vmaps the encoder over an image batch)."""
+    import jax
+
+    from keystone_tpu.nodes.images.fisher_vector import _fisher_vector
+
+    rng = np.random.RandomState(1)
+    d, k, n, batch = 64, 16, 200, 3
+    Xb = rng.randn(batch, d, n).astype(np.float32)
+    means, variances, weights = _gmm_params(rng, d, k)
+    args = (jnp.asarray(means), jnp.asarray(variances),
+            jnp.asarray(weights))
+
+    def fused(x):
+        return _fisher_vector(x, *args, 1e-4,
+                              kernel_mode="pallas_interpret")
+
+    def fallback(x):
+        return _fisher_vector(x, *args, 1e-4, kernel_mode="einsum")
+
+    a = np.asarray(jax.vmap(fallback)(jnp.asarray(Xb)))
+    b = np.asarray(jax.vmap(fused)(jnp.asarray(Xb)))
+    assert a.shape == (batch, d, 2 * k)
+    np.testing.assert_allclose(b, a, rtol=2e-3, atol=2e-4)
+
+
+# -- quantized predict (PR 13 tentpole 3) -----------------------------------
+
+
+def test_quantized_affine_pallas_interpret():
+    """Kernel == dequantizing-einsum fallback (bit-compatible: the same
+    dequantize-then-f32-matmul math) for int8 and bf16 weights at a
+    ragged batch size."""
+    from keystone_tpu.ops.pallas_kernels import quantized_affine_pallas
+
+    rng = np.random.RandomState(0)
+    n, d, k = 77, 50, 11
+    X = rng.randn(n, d).astype(np.float32)
+    W = rng.randn(d, k).astype(np.float32)
+    mean = rng.randn(d).astype(np.float32)
+    inv = (1.0 + rng.rand(d)).astype(np.float32)
+    b = rng.randn(k).astype(np.float32)
+    scale = (np.abs(W).max(axis=0) / 127.0).astype(np.float32)
+    Wq = np.clip(np.round(W / scale), -127, 127).astype(np.int8)
+    got = np.asarray(quantized_affine_pallas(
+        jnp.asarray(X), jnp.asarray(Wq), jnp.asarray(scale),
+        jnp.asarray(mean), jnp.asarray(inv), jnp.asarray(b),
+        interpret=True))
+    want = ((X - mean) * inv) @ (Wq.astype(np.float32) * scale) + b
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    Wb = jnp.asarray(W, jnp.bfloat16)
+    got = np.asarray(quantized_affine_pallas(
+        jnp.asarray(X), Wb, jnp.ones((k,), jnp.float32),
+        jnp.asarray(mean), jnp.asarray(inv), jnp.asarray(b),
+        interpret=True))
+    want = ((X - mean) * inv) @ np.asarray(Wb.astype(jnp.float32)) + b
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("weight_dtype,min_agree,max_rel", [
+    ("bf16", 1.0, 0.02), ("int8", 0.98, 0.03)])
+def test_quantized_predict_parity_gate(weight_dtype, min_agree, max_rel,
+                                       mesh8):
+    """The serving-plane parity bar: quantized apply must agree with
+    the f32 apply on argmax and stay inside a relative error bound,
+    per item AND on the batched dataset path, with the quantization
+    error recorded into the numerics funnel."""
+    from keystone_tpu.nodes.learning.linear import LinearMapEstimator
+    from keystone_tpu.observability import MetricsRegistry
+    from keystone_tpu.parallel.dataset import ArrayDataset
+
+    rng = np.random.RandomState(0)
+    n, d, k = 256, 64, 10
+    X = rng.randn(n, d).astype(np.float32)
+    # a separable teacher task: agreement on pure-noise labels would
+    # measure near-tie argmax flips, not quantization quality
+    teacher = rng.randn(d, k).astype(np.float32)
+    Y = -np.ones((n, k), np.float32)
+    Y[np.arange(n), (X @ teacher).argmax(1)] = 1.0
+    model = LinearMapEstimator(1e-3).fit(
+        ArrayDataset.from_numpy(X), ArrayDataset.from_numpy(Y))
+    quant = LinearMapEstimator(1e-3, weight_dtype=weight_dtype).fit(
+        ArrayDataset.from_numpy(X), ArrayDataset.from_numpy(Y))
+    assert quant.weight_dtype == weight_dtype
+
+    reg = MetricsRegistry.get_or_create()
+    events0 = reg.counter("numerics.quant_error").value
+    a = model.apply_dataset(ArrayDataset.from_numpy(X)).numpy()
+    b = quant.apply_dataset(ArrayDataset.from_numpy(X)).numpy()
+    assert (a.argmax(1) == b.argmax(1)).mean() >= min_agree
+    assert np.abs(a - b).max() / np.abs(a).max() <= max_rel
+    # the quantization error landed in the numerics funnel
+    assert reg.counter("numerics.quant_error").value >= events0 + 1
+    assert reg.gauge("numerics.quant_rel_error").value > 0.0
+    # per-item path agrees with the batch path
+    pi = np.asarray(quant.apply(jnp.asarray(X[0])))
+    np.testing.assert_allclose(pi, b[0], rtol=1e-4, atol=1e-4)
+
+
+def test_weight_dtype_contract():
+    """Config validation + program identity: a typo fails eagerly;
+    differently-quantized models never share struct-keyed programs;
+    pickling re-quantizes on first use (the cache is a _jit_ key)."""
+    import pickle
+
+    from keystone_tpu.nodes.learning.linear import (
+        BlockLinearMapper,
+        LinearMapper,
+        _canon_weight_dtype,
+    )
+
+    with pytest.raises(ValueError):
+        _canon_weight_dtype("float16")
+    assert _canon_weight_dtype("bfloat16") == "bf16"
+    assert _canon_weight_dtype(np.int8) == "int8"
+    assert _canon_weight_dtype(None) is None
+
+    W = np.eye(4, dtype=np.float32)
+    m32 = LinearMapper(W)
+    m8 = LinearMapper(W, weight_dtype="int8")
+    assert m32.struct_key() != m8.struct_key()
+    assert m32.eq_key() != m8.eq_key()
+    bm = BlockLinearMapper([W[:2], W[2:]], 2, weight_dtype="bf16")
+    assert bm.struct_key() != BlockLinearMapper([W[:2], W[2:]], 2).struct_key()
+
+    m8.apply_params()  # builds + caches the quantized params
+    clone = pickle.loads(pickle.dumps(m8))
+    assert clone.weight_dtype == "int8"
+    assert "_jit_affine_params" not in clone.__dict__
+    x = np.ones(4, np.float32)
+    np.testing.assert_allclose(np.asarray(clone.apply(jnp.asarray(x))),
+                               np.asarray(m8.apply(jnp.asarray(x))))
+
+
+def test_bench_metric_names_catalogued():
+    """The rename protection BENCH_METRIC_NAMES promises, enforced:
+    every catalogued kernel bench line must appear in bench.py (a
+    rename without touching the catalogue fails here, instead of
+    silently resetting the benchdiff baseline as a 'new' metric)."""
+    import pathlib
+
+    from keystone_tpu.observability.names import BENCH_METRIC_NAMES
+
+    src = pathlib.Path(__file__).parent.parent.joinpath(
+        "bench.py").read_text()
+    for name in BENCH_METRIC_NAMES:
+        # the predict lines are emitted via one f-string over the
+        # dtype tags: check the f-string spelling for those
+        head, _, tail = name.partition("_quantized_")
+        pattern = name if not tail else \
+            f'{head}_quantized_{{tag}}_{tail.split("_", 1)[1]}'
+        assert name in src or pattern in src, (
+            f"{name}: catalogued in names.BENCH_METRIC_NAMES but not "
+            f"emitted by bench.py — rename both sides together")

@@ -172,6 +172,50 @@ def test_estimator_carry_rides_the_plan(mesh8):
     assert report.plan.model_nbytes >= est[0]["out_nbytes"]
 
 
+# -- Pallas kernel workspace charges (PR 13 satellite) -----------------------
+
+def test_fv_apply_workspace_rides_the_plan(mesh8):
+    """The FV apply's kernel/fallback workspace is charged at the
+    Delegate node: on CPU (no Pallas dispatch) that is the (nDesc, K)
+    posterior matrix the split fallback materializes, scaled by the
+    padded batch inside the one batched program."""
+    from keystone_tpu.analysis import spec_dataset
+    from keystone_tpu.analysis.resources import fv_apply_transient_nbytes
+    from keystone_tpu.nodes.images.fisher_vector import (
+        GMMFisherVectorEstimator,
+    )
+
+    d, nd, k, n = 64, 200, 33, 32
+    train = spec_dataset((d, nd), np.float32, n=n)
+    pipe = GMMFisherVectorEstimator(k).with_data(train)
+    report = pipe.check(jax.ShapeDtypeStruct((d, nd), np.float32))
+    delegates = [e for e in report.plan.entries
+                 if e["operator"] == "Delegate"
+                 and "kernel workspace" in e["note"]]
+    assert delegates, report.plan.entries
+    per_item = fv_apply_transient_nbytes(d, k, nd)
+    assert per_item == 4.0 * nd * k  # CPU: the fallback's q matrix
+    # the apply-path source has unknown n -> charged once per item
+    assert delegates[0]["transient_nbytes"] == per_item
+
+
+def test_sift_band_constants_ride_the_plan(mesh8):
+    """A SIFT node charges its per-config band-operator constants as a
+    transient (same arrays feed the einsum and the banded kernel)."""
+    from keystone_tpu.analysis.resources import sift_band_operator_nbytes
+    from keystone_tpu.nodes.images.extractors import SIFTExtractor
+
+    h, w = 64, 80
+    node = SIFTExtractor(step=8, bin_size=4, num_scales=2, scale_step=1)
+    report = node.check(jax.ShapeDtypeStruct((h, w), np.float32))
+    entries = [e for e in report.plan.entries
+               if e["operator"] == "SIFTExtractor"]
+    assert len(entries) == 1
+    want = sift_band_operator_nbytes(h, w, 8, 4, 2, 1)
+    assert want > 0
+    assert entries[0]["transient_nbytes"] == want
+
+
 # -- streamed plan vs measured ledger (satellite: parity test) ---------------
 
 def _slow(ad):

@@ -195,6 +195,86 @@ def main():
     assert diff.max() <= 2.0, diff.max()
     assert diff.mean() <= 0.15, diff.mean()
 
+    kernel_gates(imgs, gmm)
+
+
+def kernel_gates(imgs, gmm):
+    """PR 13 parity gates: every Pallas kernel must reproduce its
+    einsum fallback inside its envelope ON THIS DEVICE, every profile —
+    the banded SIFT against the descriptor golden envelope, the fused
+    FV against a tight absolute bound, the quantized predict against
+    argmax agreement + an error bound. On TPU the compiled kernels run;
+    elsewhere the kernel bodies run on the interpreter over a cropped
+    batch (interpret-mode at full VGA is minutes per image)."""
+    from keystone_tpu.nodes.images.fisher_vector import _fisher_vector
+    from keystone_tpu.ops.pallas_kernels import use_pallas
+
+    on_tpu = use_pallas()
+    banded_mode = "banded" if on_tpu else "banded_interpret"
+    fv_mode = "pallas" if on_tpu else "pallas_interpret"
+
+    # banded SIFT GEMM vs einsum: the golden envelope (quantized
+    # descriptor levels), same bound as the precision gate above
+    crop = imgs[:2] if on_tpu else imgs[:1, :96, :128]
+    def sift_mode(mode):
+        return jax.jit(jax.vmap(
+            lambda g: S.dense_sift(g, STEP, BIN, NSCALES, SSTEP,
+                                   kernel_mode=mode)))(crop)
+
+    banded = np.asarray(sift_mode(banded_mode))
+    ref = np.asarray(sift_mode("einsum"))
+    diff = np.abs(banded - ref)
+    print(f"banded-kernel parity vs einsum: max {diff.max():.3f} "
+          f"mean {diff.mean():.4f} (envelope: max <= 2.0, mean <= 0.15)",
+          flush=True)
+    assert diff.max() <= 2.0, diff.max()
+    assert diff.mean() <= 0.15, diff.mean()
+
+    # fused GMM-posterior + FV kernel vs the split fallback
+    rng = np.random.RandomState(7)
+    proj = jnp.asarray(rng.randn(DESC_DIM, 2048).astype(np.float32))
+    fused = np.asarray(_fisher_vector(proj, *gmm, 1e-2,
+                                      kernel_mode=fv_mode))
+    split = np.asarray(_fisher_vector(proj, *gmm, 1e-2,
+                                      kernel_mode="einsum"))
+    err = np.abs(fused - split)
+    print(f"fused-FV parity vs fallback: max {err.max():.2e} "
+          f"mean {err.mean():.2e} (envelope: max <= 1e-3)", flush=True)
+    assert err.max() <= 1e-3, err.max()
+
+    # quantized predict: argmax agreement + error bound vs f32 apply
+    # at the rehearsal solve shape (separable teacher labels — ties on
+    # noise would measure argmax fragility, not quantization). The
+    # quantized leg goes through apply_dataset — the PRODUCTION batch
+    # dispatch, which is the path that actually reaches
+    # quantized_affine_pallas on TPU (per-item apply is always the
+    # dequantizing fallback).
+    from keystone_tpu.nodes.learning.linear import LinearMapper
+    from keystone_tpu.parallel.dataset import ArrayDataset
+
+    n, d, k = 512, 1024, 100
+    X = rng.randn(n, d).astype(np.float32)
+    W = rng.randn(d, k).astype(np.float32) / np.sqrt(d)
+    b = rng.randn(k).astype(np.float32) * 0.01
+    ds = ArrayDataset.from_numpy(X)
+    f32 = LinearMapper(W, intercept=b).apply_dataset(ds).numpy()
+    for dtype, min_agree, max_rel in (("bf16", 0.999, 0.02),
+                                      ("int8", 0.98, 0.03)):
+        q = LinearMapper(W, intercept=b, weight_dtype=dtype)
+        out = q.apply_dataset(ds).numpy()
+        agree = float((f32.argmax(1) == out.argmax(1)).mean())
+        rel = float(np.abs(out - f32).max() / np.abs(f32).max())
+        # the per-item path must match the batched kernel path too
+        item = np.asarray(q.apply(jnp.asarray(X[0])))
+        item_delta = float(np.abs(item - out[0]).max())
+        print(f"quantized predict {dtype} (apply_dataset dispatch): "
+              f"argmax agreement {agree:.4f} (>= {min_agree}), max rel "
+              f"err {rel:.4f} (<= {max_rel}), item-vs-batch "
+              f"{item_delta:.2e}", flush=True)
+        assert agree >= min_agree, (dtype, agree)
+        assert rel <= max_rel, (dtype, rel)
+        assert item_delta <= 1e-4, (dtype, item_delta)
+
 
 if __name__ == "__main__":
     main()
