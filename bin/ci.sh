@@ -43,6 +43,14 @@
 #                                  host_death fault, world relaunched,
 #                                  resumed from the shared StreamCheckpoint;
 #                                  resumed weights must be bit-identical
+#   2b'''. serving gate            tools/serving_gate.py — start
+#                                  `python -m keystone_tpu serve` on an
+#                                  ephemeral port with 2 saved models,
+#                                  wait on the readiness-gated /healthz,
+#                                  drive requests across >= 2 shapes and
+#                                  both models, and fail on any fenced
+#                                  steady-state recompile or a
+#                                  /healthz-not-ready timeout
 #   2c. bounded-seed stress        the deterministic-interleaving suite
 #                                  (tests/test_concurrency_sched.py):
 #                                  historical-race regression schedules +
@@ -127,6 +135,15 @@ if (( run_tests )); then
   # the uninterrupted run with the warmup fence clean throughout
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     "$PY" "$KEYSTONE_HOME/tools/elastic_gate.py"
+
+  echo "== ci: serving gate (2 models, 2 shapes, fence-clean, readiness-gated) =="
+  # the dynamic pin for the serving plane (tools/serving_gate.py): the
+  # real subprocess + HTTP deployment shape — server binds, /healthz
+  # reports warming until every admitted model's warmup compile
+  # completed, requests across >= 2 buckets and both models, and the
+  # armed observatory fence must record ZERO steady-state recompiles
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    "$PY" "$KEYSTONE_HOME/tools/serving_gate.py"
 
   echo "== ci: bounded-seed concurrency stress (regression schedules + fuzz) =="
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \

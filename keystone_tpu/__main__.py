@@ -6,8 +6,17 @@
     python -m keystone_tpu check --all [--budget BYTES]
     python -m keystone_tpu benchdiff BASE.json CURRENT.json [--force]
     python -m keystone_tpu numerics POSTMORTEM.json
+    python -m keystone_tpu serve NAME=PATH@SHAPE[:DTYPE] ... [--port P]
 
 Run with no arguments to list the available applications.
+
+``serve`` is the online serving plane (``keystone_tpu/serving``):
+saved fitted pipelines admitted as warm device-resident executables
+under an HBM budget, request micro-batching behind a bounded queue
+(pad-to-bucket, zero steady-state recompiles asserted by the compile
+observatory fence), ``POST /predict/<model>`` + readiness-gated
+``/healthz`` + Prometheus ``/metrics`` on one port. See README
+"Serving".
 
 ``benchdiff`` is the statistical bench-regression gate
 (``observability/benchdiff.py``): it classifies every metric shared by
@@ -245,7 +254,9 @@ def main(argv=None) -> int:
               "       python -m keystone_tpu benchdiff BASE.json "
               "CURRENT.json\n"
               "       python -m keystone_tpu numerics "
-              "POSTMORTEM.json\n\napps:")
+              "POSTMORTEM.json\n"
+              "       python -m keystone_tpu serve "
+              "NAME=PATH@SHAPE[:DTYPE] ...\n\napps:")
         for name in sorted(APPS):
             print(f"  {name}")
         return 0
@@ -262,6 +273,17 @@ def main(argv=None) -> int:
         from keystone_tpu.observability.numerics import postmortem_report
 
         return postmortem_report(rest)
+    if app == "serve":
+        import os as _os
+
+        plat = _os.environ.get("JAX_PLATFORMS")
+        if plat:
+            import jax
+
+            jax.config.update("jax_platforms", plat)
+        from keystone_tpu.serving.http import main as serve_main
+
+        return serve_main(rest)
     import os
 
     # Environments that import jax at interpreter start (device-plugin

@@ -59,8 +59,8 @@ from ..utils.guarded import GUARDED_FIELDS
 #: and locks. The guarded-by and sequence passes run tree-wide — they
 #: only fire on classes that *declared* a discipline.
 CONCURRENCY_SCOPES = (
-    "loaders", "observability", "parallel", "resilience", "utils",
-    "workflow",
+    "loaders", "observability", "parallel", "resilience", "serving",
+    "utils", "workflow",
 )
 
 #: deliberate exceptions — every entry needs a comment saying WHY the

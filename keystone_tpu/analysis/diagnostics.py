@@ -182,7 +182,7 @@ def host_coercions_in_funcdef(fdef) -> List[tuple]:
 #: the error and keep going" turns a flaky disk or corrupt record into
 #: silent data loss — the resilience layer (retry / quarantine) is the
 #: sanctioned way to tolerate failures there. tools/lint.py enforces.
-SWALLOW_ALL_SCOPES = ("loaders", "parallel", "workflow")
+SWALLOW_ALL_SCOPES = ("loaders", "parallel", "serving", "workflow")
 
 #: directories where the cast-before-transfer rule applies: loader and
 #: device-staging code is where a host-side float widening right before

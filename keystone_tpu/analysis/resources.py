@@ -370,6 +370,76 @@ def estimator_resource_effect(estimator: Any,
               else "estimator declares no carry/fitted size"))
 
 
+# -- serving residency (PR 15) ----------------------------------------------
+#
+# The serving plane admits fitted pipelines under an explicit HBM
+# budget; its admission charge is the static-planner arithmetic the
+# HbmPlan docstring promises: persistent fitted state plus the widest
+# per-item activation times the largest request bucket. Both helpers
+# live here so the admission math and the fit-path planning share one
+# accounting model (and one review surface).
+
+
+def fitted_model_nbytes(graph: Any) -> float:
+    """Bytes of the fitted parameters a transformer-only pipeline keeps
+    resident while served warm: every >0-d array leaf stored on the
+    graph's operators (weights, intercepts, scaler moments, codebooks),
+    jit-cache attributes excluded. Counted at the STORED width — a
+    ``weight_dtype``-quantized mapper stores f32 and narrows on the
+    apply path, so this is a deliberate upper bound (the narrow copy
+    and the master copy co-exist while the quantized program runs)."""
+    import types
+
+    import jax
+
+    def walk(value, seen) -> float:
+        total = 0.0
+        for leaf in jax.tree_util.tree_leaves(value):
+            if getattr(leaf, "ndim", 0) > 0 and hasattr(leaf, "nbytes"):
+                total += float(leaf.nbytes)
+            elif id(leaf) not in seen and hasattr(leaf, "__dict__") \
+                    and not isinstance(leaf, (types.FunctionType,
+                                              types.MethodType,
+                                              types.ModuleType, type)):
+                # opaque config objects (a nested StandardScalerModel
+                # riding a mapper) carry fitted arrays the pytree walk
+                # cannot see; recurse one attribute level at a time
+                seen.add(id(leaf))
+                state = {k: v for k, v in vars(leaf).items()
+                         if not k.startswith("_jit_")
+                         and k != "_eq_key_val"}
+                total += walk(state, seen)
+        return total
+
+    total = 0.0
+    seen: set = set()
+    for node in graph.nodes:
+        op = graph.get_operator(node)
+        attrs = getattr(op, "__dict__", None)
+        if not attrs:
+            continue
+        state = {k: v for k, v in attrs.items()
+                 if not k.startswith("_jit_") and k != "_eq_key_val"}
+        total += walk(state, seen)
+    return total
+
+
+def serving_residency_nbytes(model_nbytes: float, plan: "HbmPlan",
+                             bucket_rows: int) -> Optional[float]:
+    """The admission charge for one served model at its largest request
+    bucket: ``model_nbytes + bucket_rows x apply_item_nbytes`` — the
+    serving-residency approximation the :class:`HbmPlan` docstring
+    documents, now the enforced admission-control arithmetic
+    (``serving/residency.py``). Returns None when the plan could not
+    size the per-item activation (``apply_item_nbytes == 0`` with
+    unresolved nodes): the caller must fall back to a measured probe
+    rather than admit on an invented number."""
+    item = float(plan.apply_item_nbytes)
+    if item <= 0.0 and plan.unresolved:
+        return None
+    return float(model_nbytes) + float(bucket_rows) * item
+
+
 # -- the plan ----------------------------------------------------------------
 
 @dataclass

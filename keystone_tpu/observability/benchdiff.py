@@ -65,10 +65,22 @@ BAND_MARGIN = 1.5
 #: byte counts — h2d_bytes_per_image shrinking is the PR 5 win, not a
 #: regression — and the PR 10 numerics-health keys: NaN/breakdown
 #: totals, the drift score, and the measured numerics overhead share
-#: are all failure/cost measures)
-_LOWER_BETTER_MARKERS = ("error", "stall", "_ms", "_latency", "_bytes",
-                         "_nan_total", "_breakdown_total", "drift_score",
-                         "overhead_share")
+#: are all failure/cost measures). ``_ms``/``_p99``/``_latency`` cover
+#: the serving plane's tail-latency lines (``serve_p50_ms``,
+#: ``serve_p99_ms``): a p99 that RISES is the regression, the PR 9
+#: ``_bytes`` lesson applied BEFORE the first serving bench round ever
+#: records a baseline.
+_LOWER_BETTER_MARKERS = ("error", "stall", "_ms", "_p99", "_latency",
+                         "_bytes", "_nan_total", "_breakdown_total",
+                         "drift_score", "overhead_share")
+
+#: markers that force "higher is better" and WIN over any lower-better
+#: marker in the same name: throughput lines like ``serve_qps_per_chip``
+#: must never flip direction because some other substring (a future
+#: ``p99_bounded_qps``-style name, an error-rate companion key) happens
+#: to match the lower-better list — a direction flip silently blesses a
+#: throughput collapse as an "improvement"
+_HIGHER_BETTER_MARKERS = ("_qps",)
 
 #: metrics banded in ABSOLUTE units (plain difference, not
 #: percent-of-base): signed shares that hover at ~0, where a relative
@@ -95,6 +107,8 @@ _NON_METRIC_KEYS = frozenset({
 
 
 def lower_is_better(metric: str) -> bool:
+    if any(m in metric for m in _HIGHER_BETTER_MARKERS):
+        return False
     return any(m in metric for m in _LOWER_BETTER_MARKERS)
 
 

@@ -73,6 +73,40 @@ METRIC_NAMES: FrozenSet[str] = frozenset({
     "coord.barrier_wait_s",  # histogram: time spent waiting for peers
                              # at a round boundary / named barrier — a
                              # persistently hot host here is a straggler
+    # keystone_tpu/serving — the low-latency multi-tenant serving plane
+    # (PR 15). Catalogued from day one: these names cross the scrape
+    # surface into dashboards AND the serving CI gate reads them back
+    # from /metrics (tools/serving_gate.py), so a rename breaks both.
+    "serving.requests_total",    # counter: requests served (one per
+                                 # submitted request, not per batch)
+    "serving.rows_total",        # counter: items (rows) served
+    "serving.batches_total",     # counter: micro-batches executed
+    "serving.rejected_total",    # counter: submits refused at the slot
+                                 # gate (bounded queue full — the
+                                 # backpressure signal)
+    "serving.errors_total",      # counter: batches that raised
+    "serving.evictions_total",   # counter: models evicted for HBM space
+    "serving.admission_rejected_total",  # counter: admissions refused
+                                 # (over the HBM budget even after
+                                 # every allowed eviction)
+    "serving.queue_depth",       # gauge: pending requests behind the
+                                 # slot gate at last submit/take
+    "serving.models_resident",   # gauge: warm device-resident models
+    "serving.models_warming",    # gauge: admissions mid-warmup
+    "serving.hbm_budget_bytes",  # gauge: the configured residency budget
+    "serving.hbm_charged_bytes",  # gauge: admission-charged bytes
+                                 # (model_nbytes + bucket activation
+                                 # bound, analysis/resources.py)
+    "serving.request_ms",        # histogram: per-request latency,
+                                 # enqueue -> result (all models; the
+                                 # per-model family rides the prefix)
+    "serving.batch_ms",          # histogram: device execution wall per
+                                 # micro-batch
+    "serving.batch_fill",        # histogram: true rows / bucket rows of
+                                 # each executed micro-batch (all
+                                 # models; per-model family below)
+    "serving.warmup_s",          # histogram: per-admission warmup wall
+                                 # (every bucket compiled, fence-clean)
 })
 
 #: catalogued name FAMILIES: a dynamic metric name must start with one
@@ -83,6 +117,12 @@ METRIC_PREFIXES: Tuple[str, ...] = (
     "lock.wait_s.",  # utils/guarded.py: one histogram per traced lock
     "numerics.",     # observability/numerics.py: one counter per
                      # numerics event kind (record_numerics_event)
+    # serving/plane.py: the per-MODEL latency/fill families
+    # (f"serving.request_ms.{model}"). Deliberately the two narrow
+    # families rather than a blanket "serving." prefix — a typo'd
+    # literal serving counter name must still fail the drift lint.
+    "serving.request_ms.",
+    "serving.batch_fill.",
 )
 
 
@@ -105,6 +145,14 @@ BENCH_METRIC_NAMES: FrozenSet[str] = frozenset({
     "predict_quantized_bf16_rows_per_sec_per_chip",  # (f32 line is the
     "predict_quantized_int8_rows_per_sec_per_chip",  # baseline the
                                                      # parity keys cite)
+    # serving plane (PR 15): sustained micro-batched QPS plus the tail
+    # latencies — benchdiff bands the p50/p99 lines lower-is-better
+    # (``_ms``/``_p99`` markers) and the qps line higher-is-better
+    # (``_qps`` override), both landed BEFORE these names first
+    # appeared in a BENCH artifact
+    "serve_qps_per_chip",
+    "serve_p50_ms",
+    "serve_p99_ms",
 })
 
 

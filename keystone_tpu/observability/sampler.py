@@ -230,9 +230,33 @@ class TelemetrySampler:
 
 class _MetricsHandler(BaseHTTPRequestHandler):
     registry: Optional[MetricsRegistry] = None
+    #: zero-arg readiness probe (None = always ready, the historical
+    #: behaviour). With a probe installed ``/healthz`` is a REAL
+    #: readiness gate: 503 "warming" until the probe returns True — the
+    #: serving plane wires ``ServingPlane.ready`` here so a load
+    #: balancer never routes to a process whose admitted models have
+    #: not finished their warmup compiles. A probe that RAISES reports
+    #: not-ready (fail closed): a broken readiness check must not
+    #: admit traffic.
+    ready_probe: Optional[Callable[[], bool]] = None
 
     def do_GET(self):  # noqa: N802 (stdlib handler API)
         if self.path.split("?")[0] == "/healthz":
+            probe = type(self).ready_probe
+            if probe is not None:
+                try:
+                    ready = bool(probe())
+                except Exception:
+                    ready = False
+                if not ready:
+                    body = b"warming\n"
+                    self.send_response(503)
+                    self.send_header("Content-Type",
+                                     "text/plain; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
             body = b"ok\n"
             ctype = "text/plain; charset=utf-8"
         elif self.path.split("?")[0] == "/metrics":
@@ -269,15 +293,24 @@ class _MetricsServer(ThreadingHTTPServer):
 
 
 def serve_metrics(port: int = 0, host: str = "127.0.0.1",
-                  registry: Optional[MetricsRegistry] = None
+                  registry: Optional[MetricsRegistry] = None,
+                  ready_probe: Optional[Callable[[], bool]] = None
                   ) -> ThreadingHTTPServer:
     """Serve ``GET /metrics`` (Prometheus text exposition of the
     process registry) and ``GET /healthz`` on ``host:port`` from a
     daemon thread. ``port=0`` binds an ephemeral port — read it back
     from ``server.server_port``. Returns the server; ``.shutdown()``
-    stops it, joins the serve thread, and releases the port."""
+    stops it, joins the serve thread, and releases the port.
+
+    ``ready_probe`` (zero-arg -> bool) turns ``/healthz`` into a real
+    readiness gate: 503 until it returns True (the serving plane passes
+    ``ServingPlane.ready`` so not-ready lasts exactly until every
+    admitted model's warmup compile completed). Without a probe the
+    endpoint stays the historical always-200 liveness ping."""
     handler = type("_BoundMetricsHandler", (_MetricsHandler,),
-                   {"registry": registry})
+                   {"registry": registry,
+                    "ready_probe": (staticmethod(ready_probe)
+                                    if ready_probe is not None else None)})
     server = _MetricsServer((host, port), handler)
     t = threading.Thread(target=server.serve_forever,
                          name="keystone-metrics-http", daemon=True)

@@ -202,6 +202,42 @@ def _padded_rows(n: int, mesh: Mesh) -> int:
     return padded_rows(n, num_data_shards(mesh))
 
 
+def bucketed_dataset(data: Any, n: int, bucket_rows: int,
+                     mesh: Optional[Mesh] = None) -> ArrayDataset:
+    """Stage a host batch of ``n`` items padded to exactly
+    ``bucket_rows`` rows (not merely the shard-multiple minimum).
+
+    The serving micro-batcher's pad-to-bucket primitive: every batch in
+    a bucket shares ONE padded shape, so one compiled executable per
+    bucket serves every request size that lands in it (the compile
+    caches key on shapes — per-request shapes would recompile per
+    size). The result is a normal :class:`ArrayDataset` with
+    ``padded_n == bucket_rows`` and the true ``n``, so the existing
+    mask machinery (``mask`` / ``_apply_mask`` re-zeroing after maps)
+    treats the extra pad rows exactly like shard pad — linear
+    reductions stay exact and ``numpy()``/``collect()`` strip them.
+    """
+    mesh = mesh or get_mesh()
+    shards = num_data_shards(mesh)
+    if bucket_rows % shards:
+        raise ValueError(
+            f"bucket_rows={bucket_rows} must be a multiple of the mesh "
+            f"data-shard count ({shards}) — buckets come from a "
+            "shard-rounded policy (serving.BucketPolicy)")
+    if n > bucket_rows:
+        raise ValueError(f"n={n} items do not fit bucket_rows={bucket_rows}")
+    sh = batch_sharding(mesh)
+
+    def put(x):
+        x = np.asarray(x)
+        if x.shape[0] != n:
+            raise ValueError(f"leading dim {x.shape[0]} != n={n}")
+        return shard_put(_pad_to(x, bucket_rows), sh, h2d_pool())
+
+    staged = jax.tree_util.tree_map(put, data)
+    return ArrayDataset(staged, n, mesh, _already_sharded=True)
+
+
 def _shard_pytree(data: Any, n: int, mesh: Mesh) -> Any:
     rows = _padded_rows(n, mesh)
     sh = batch_sharding(mesh)

@@ -1,0 +1,33 @@
+"""Low-latency multi-tenant serving plane (ROADMAP item 1).
+
+Fitted pipelines admitted as warm device-resident compiled executables,
+request micro-batching behind a slot-gated bounded queue (pad-to-bucket,
+one executable per bucket, zero steady-state recompiles asserted by the
+compile-observatory fence), and multi-model residency under an explicit
+HBM budget with static-planner admission charges and LRU-with-cost
+eviction. ``python -m keystone_tpu serve`` is the CLI;
+``ServingPlane`` the embeddable core. See README "Serving".
+"""
+from .batcher import BucketPolicy, MicroBatcher, QueueFullError, Request
+from .plane import (
+    ModelNotAdmitted,
+    ModelWarming,
+    ServedModel,
+    ServingPlane,
+)
+from .residency import AdmissionError, ModelCharge, ResidencyLedger, model_charge
+
+__all__ = [
+    "AdmissionError",
+    "BucketPolicy",
+    "MicroBatcher",
+    "ModelCharge",
+    "ModelNotAdmitted",
+    "ModelWarming",
+    "QueueFullError",
+    "Request",
+    "ResidencyLedger",
+    "ServedModel",
+    "ServingPlane",
+    "model_charge",
+]

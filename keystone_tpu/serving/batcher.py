@@ -1,0 +1,201 @@
+"""Request micro-batching behind a slot-gated bounded queue.
+
+Online serving gets its throughput from batching: one device dispatch
+over 32 coalesced requests costs barely more than one over a single
+item. The batcher is the waiting room where that coalescing happens:
+
+* :class:`BucketPolicy` — the fixed ladder of padded batch sizes.
+  Every executed batch is padded to a BUCKET (shard-rounded powers of
+  two up to ``max_batch``), so variable request sizes hit one compiled
+  executable per bucket (``parallel.dataset.bucketed_dataset`` + the
+  existing mask machinery) and the PR 9 warmup fence can assert zero
+  steady-state recompiles per request shape.
+* :class:`MicroBatcher` — the bounded queue. Admission is SLOT-GATED
+  before enqueue with a :class:`~keystone_tpu.utils.guarded.
+  TracedSemaphore` (the ``parallel/streaming.py`` staging discipline:
+  backpressure is an explicit counted gate, not implicit queue
+  blocking), so pending work is provably bounded at ``queue_depth``
+  requests and an overloaded plane rejects fast (429-shaped
+  :class:`QueueFullError`) instead of queueing unboundedly. The worker
+  side (:meth:`take`) pops the oldest request and greedily coalesces
+  same-model requests behind it up to the bucket ceiling, preserving
+  FIFO order for everything it leaves behind.
+
+Thread model: HTTP handler threads (or test threads) call ``submit``;
+ONE plane worker calls ``take``/``done``. ``_pending``/``_closed`` are
+``@guarded_by`` the batcher lock; the ready-event wait runs OUTSIDE it
+(the blocking-under-lock pass checks this).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Deque, List, Optional, Tuple
+
+from ..parallel.dataset import padded_rows
+from ..utils.guarded import TracedLock, TracedSemaphore, guarded_by
+
+
+class QueueFullError(RuntimeError):
+    """The bounded request queue is full (slot gate refused within the
+    submit timeout) — the caller should shed load / retry later."""
+
+
+@dataclass(frozen=True)
+class BucketPolicy:
+    """The pad-to-bucket ladder: shard-rounded powers of two from one
+    shard's worth of rows up to ``max_batch`` (always included, so the
+    ceiling is exact). Fewer buckets = fewer executables resident but
+    more pad waste; powers of two cap the waste at <2x while keeping
+    the executable count logarithmic in ``max_batch``."""
+
+    max_batch: int = 64
+
+    def __post_init__(self):
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+
+    def rows(self, shards: int) -> Tuple[int, ...]:
+        """Ascending bucket row counts for a ``shards``-way data mesh
+        (every entry a shard multiple, via the one padding-arithmetic
+        home ``parallel.dataset.padded_rows``)."""
+        sizes = set()
+        b = 1
+        while b < self.max_batch:
+            sizes.add(padded_rows(b, shards))
+            b *= 2
+        sizes.add(padded_rows(self.max_batch, shards))
+        return tuple(sorted(sizes))
+
+    def bucket_for(self, n: int, shards: int) -> int:
+        """Smallest bucket holding ``n`` rows (ValueError above the
+        ceiling — the worker never builds a batch beyond ``max_rows``)."""
+        for b in self.rows(shards):
+            if n <= b:
+                return b
+        raise ValueError(
+            f"batch of {n} rows exceeds the largest bucket "
+            f"({self.rows(shards)[-1]}) — split it before staging")
+
+    def max_rows(self, shards: int) -> int:
+        return self.rows(shards)[-1]
+
+
+@dataclass
+class Request:
+    """One submitted request: ``x`` is a host pytree whose leaves have
+    leading dim ``n``; the future resolves to the model output for
+    exactly those ``n`` rows (pad stripped)."""
+
+    model: str
+    x: Any
+    n: int
+    enqueued_s: float = field(default_factory=time.perf_counter)
+    future: Future = field(default_factory=Future)
+
+
+@guarded_by("_lock", "_pending", "_closed")
+class MicroBatcher:
+    """Slot-gated bounded request queue; see module docstring."""
+
+    def __init__(self, queue_depth: int = 128,
+                 submit_timeout_s: float = 2.0):
+        if queue_depth < 1:
+            raise ValueError("queue_depth must be >= 1")
+        self.queue_depth = int(queue_depth)
+        self.submit_timeout_s = float(submit_timeout_s)
+        self._slots = TracedSemaphore("serving.queue_slots", queue_depth)
+        self._lock = TracedLock("serving.batcher")
+        self._pending: Deque[Request] = deque()
+        self._closed = False
+        self._ready = threading.Event()
+
+    # -- producer side (handler threads) -----------------------------------
+    def submit(self, model: str, x: Any, n: int,
+               timeout_s: Optional[float] = None) -> Future:
+        """Enqueue one request behind the slot gate; returns its
+        future. Raises :class:`QueueFullError` when no slot frees
+        within the timeout (bounded queue = bounded latency: better an
+        honest 429 than an unbounded wait)."""
+        timeout = self.submit_timeout_s if timeout_s is None else timeout_s
+        if not self._slots.acquire(timeout=timeout):
+            from ..observability.metrics import MetricsRegistry
+
+            MetricsRegistry.get_or_create().counter(
+                "serving.rejected_total").inc()
+            raise QueueFullError(
+                f"serving queue full ({self.queue_depth} slots) — "
+                f"request for {model!r} rejected after {timeout:.1f}s")
+        req = Request(model=model, x=x, n=int(n))
+        with self._lock:
+            if self._closed:
+                self._slots.release()
+                raise RuntimeError("batcher is closed")
+            self._pending.append(req)
+            depth = len(self._pending)
+        self._ready.set()
+        from ..observability.metrics import MetricsRegistry
+
+        MetricsRegistry.get_or_create().gauge(
+            "serving.queue_depth").set(depth)
+        return req.future
+
+    # -- consumer side (the plane worker) ----------------------------------
+    def take(self, max_rows: int, timeout_s: float = 0.05) -> List[Request]:
+        """Pop the oldest pending request plus every later SAME-model
+        request that fits within ``max_rows`` total rows; requests for
+        other models (and overflow) keep their FIFO positions. Returns
+        [] on timeout. The event wait runs outside the lock."""
+        if not self._ready.wait(timeout_s):
+            return []
+        out: List[Request] = []
+        with self._lock:
+            if not self._pending:
+                self._ready.clear()
+                return []
+            first = self._pending.popleft()
+            out.append(first)
+            rows = first.n
+            rest: Deque[Request] = deque()
+            while self._pending:
+                req = self._pending.popleft()
+                if req.model == first.model and rows + req.n <= max_rows:
+                    out.append(req)
+                    rows += req.n
+                else:
+                    rest.append(req)
+            self._pending = rest
+            if not self._pending:
+                self._ready.clear()
+            depth = len(self._pending)
+        from ..observability.metrics import MetricsRegistry
+
+        MetricsRegistry.get_or_create().gauge(
+            "serving.queue_depth").set(depth)
+        return out
+
+    def done(self, count: int) -> None:
+        """Free ``count`` slots once their requests' futures resolved —
+        the release half of the staging discipline: live queue
+        occupancy provably never exceeds ``queue_depth``."""
+        if count > 0:
+            self._slots.release(count)
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def close(self) -> List[Request]:
+        """Refuse new submits and drain the queue; returns the drained
+        requests so the owner can fail their futures loudly."""
+        with self._lock:
+            self._closed = True
+            drained = list(self._pending)
+            self._pending = deque()
+            self._ready.clear()
+        if drained:
+            self._slots.release(len(drained))
+        return drained

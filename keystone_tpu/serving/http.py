@@ -1,0 +1,237 @@
+"""The HTTP surface of the serving plane + the ``serve`` CLI.
+
+Endpoints (stdlib ``ThreadingHTTPServer``, same machinery as the PR 8
+scrape endpoint — one server carries both the data plane and the
+telemetry plane):
+
+* ``POST /predict/<model>`` — body ``{"instances": [...]}`` (or a bare
+  JSON array). Instances are item-shaped rows for the admitted sample;
+  the handler thread submits them as ONE request to the micro-batcher
+  and blocks on the future, so concurrent requests coalesce into
+  padded-bucket batches. Response: ``{"model", "rows", "predictions"}``.
+  Errors map to honest statuses: 404 unknown model, 503 warming,
+  429 bounded-queue full, 400 shape/JSON errors.
+* ``GET /healthz`` — the REAL readiness gate: 503 ``warming`` until
+  every admitted model's warmup compile completed
+  (``ServingPlane.ready`` via the ``serve_metrics`` ready-probe).
+* ``GET /metrics`` — Prometheus text exposition of the process
+  registry (``serving.*`` families included).
+* ``GET /models`` — JSON plane state (residency charges, buckets,
+  per-model QPS, evicted set).
+
+CLI::
+
+    python -m keystone_tpu serve NAME=PATH@SHAPE[:DTYPE] ... \
+        [--port P] [--host H] [--hbm-budget BYTES] [--max-batch N] \
+        [--queue-depth N] [--weight-dtype bf16|int8|f32] \
+        [--drift-every N]
+
+``SHAPE`` is the per-item shape (comma-separated, e.g. ``784`` or
+``32,32,3``), ``DTYPE`` defaults to float32. The server binds BEFORE
+admitting (so ``/healthz`` observably reports warming during the
+warmup compiles), prints ``serving on HOST:PORT`` then
+``serving ready (N models)`` — the lines the CI gate
+(``tools/serving_gate.py``) parses. ``--weight-dtype`` defaults to
+bf16: the PR 13 quantized predict is the serving default; pass ``f32``
+to opt out.
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ..observability.metrics import MetricsRegistry
+from ..observability.sampler import _MetricsHandler, _MetricsServer
+from .batcher import QueueFullError
+from .plane import ModelNotAdmitted, ModelWarming, ServingPlane
+from .residency import AdmissionError
+
+
+class ServingHandler(_MetricsHandler):
+    """Extends the metrics/healthz handler with the predict data plane
+    (``plane`` is bound per server by :func:`serve`)."""
+
+    plane: Optional[ServingPlane] = None
+
+    def do_GET(self):  # noqa: N802 (stdlib handler API)
+        if self.path.split("?")[0] == "/models":
+            self._reply(200, json.dumps(self.plane.state()).encode(),
+                        "application/json")
+            return
+        super().do_GET()
+
+    def do_POST(self):  # noqa: N802 (stdlib handler API)
+        path = self.path.split("?")[0]
+        if not path.startswith("/predict/"):
+            self._reply(404, b'{"error": "unknown endpoint"}\n')
+            return
+        name = path[len("/predict/"):]
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            blob = json.loads(self.rfile.read(length) or b"null")
+            instances = (blob.get("instances")
+                         if isinstance(blob, dict) else blob)
+            if not isinstance(instances, list) or not instances:
+                raise ValueError(
+                    'body must be {"instances": [...]} or a JSON array')
+            out = self.plane.predict(name, np.asarray(instances))
+            body = json.dumps({
+                "model": name,
+                "rows": len(instances),
+                "predictions": _jsonable(out),
+            }).encode()
+            self._reply(200, body, "application/json")
+        except ModelNotAdmitted as exc:
+            self._reply(404, _err(exc))
+        except ModelWarming as exc:
+            self._reply(503, _err(exc))
+        except QueueFullError as exc:
+            self._reply(429, _err(exc))
+        except (ValueError, TypeError, json.JSONDecodeError) as exc:
+            self._reply(400, _err(exc))
+        except Exception as exc:  # batch execution failure: honest 500
+            self._reply(500, _err(exc))
+
+    def _reply(self, status: int, body: bytes,
+               ctype: str = "application/json") -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def _err(exc: BaseException) -> bytes:
+    return json.dumps({"error": f"{type(exc).__name__}: {exc}"}).encode()
+
+
+def _jsonable(out: Any) -> Any:
+    if isinstance(out, np.ndarray):
+        return out.tolist()
+    if isinstance(out, (list, tuple)):
+        return [_jsonable(o) for o in out]
+    if isinstance(out, dict):
+        return {k: _jsonable(v) for k, v in out.items()}
+    if hasattr(out, "tolist"):
+        return out.tolist()
+    return out
+
+
+def serve(plane: ServingPlane, port: int = 0, host: str = "127.0.0.1",
+          registry: Optional[MetricsRegistry] = None) -> _MetricsServer:
+    """Bind the serving endpoints for ``plane`` on ``host:port``
+    (``port=0`` = ephemeral; read ``server.server_port`` back) and
+    start serving from a daemon thread. ``/healthz`` is readiness-gated
+    on ``plane.ready``. Returns the server; ``.shutdown()`` releases
+    the port."""
+    import threading
+
+    handler = type("_BoundServingHandler", (ServingHandler,),
+                   {"registry": registry, "plane": plane,
+                    "ready_probe": staticmethod(plane.ready)})
+    server = _MetricsServer((host, port), handler)
+    t = threading.Thread(target=server.serve_forever,
+                         name="keystone-serving-http", daemon=True)
+    server._keystone_thread = t
+    t.start()
+    return server
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def _parse_model_spec(spec: str):
+    """``NAME=PATH@SHAPE[:DTYPE]`` -> (name, path, shape tuple, dtype)."""
+    if "=" not in spec or "@" not in spec:
+        raise ValueError(
+            f"model spec {spec!r} must look like "
+            "NAME=PATH@SHAPE[:DTYPE] (e.g. mnist=model.pkl@784:float32)")
+    name, rest = spec.split("=", 1)
+    path, shape_spec = rest.rsplit("@", 1)
+    dtype = "float32"
+    if ":" in shape_spec:
+        shape_spec, dtype = shape_spec.split(":", 1)
+    shape = tuple(int(d) for d in shape_spec.split(",") if d)
+    return name, path, shape, np.dtype(dtype)
+
+
+def _pop_flag(argv: List[str], flag: str,
+              default: Optional[str] = None) -> Optional[str]:
+    if flag not in argv:
+        return default
+    i = argv.index(flag)
+    if i + 1 >= len(argv):
+        raise ValueError(f"{flag} requires a value")
+    value = argv[i + 1]
+    del argv[i:i + 2]
+    return value
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m keystone_tpu serve`` — see the module docstring."""
+    import jax
+
+    from ..__main__ import _parse_bytes
+    from ..utils.checkpoint import load_pipeline
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    try:
+        port = int(_pop_flag(argv, "--port", "9100"))
+        host = _pop_flag(argv, "--host", "127.0.0.1")
+        budget_text = _pop_flag(argv, "--hbm-budget")
+        budget = None if budget_text is None else _parse_bytes(budget_text)
+        max_batch = int(_pop_flag(argv, "--max-batch", "64"))
+        queue_depth = int(_pop_flag(argv, "--queue-depth", "256"))
+        wd = _pop_flag(argv, "--weight-dtype", "bf16")
+        weight_dtype = None if wd in ("f32", "none", "f32/none") else wd
+        drift_every = int(_pop_flag(argv, "--drift-every", "32"))
+        specs = [_parse_model_spec(s) for s in argv if not
+                 s.startswith("-")]
+    except ValueError as exc:
+        print(f"serve: {exc}", file=sys.stderr)
+        return 2
+    if not specs:
+        print("usage: python -m keystone_tpu serve "
+              "NAME=PATH@SHAPE[:DTYPE] ... [--port P] [--host H] "
+              "[--hbm-budget BYTES] [--max-batch N] [--queue-depth N] "
+              "[--weight-dtype bf16|int8|f32] [--drift-every N]",
+              file=sys.stderr)
+        return 2
+
+    plane = ServingPlane(
+        hbm_budget=budget, max_batch=max_batch, queue_depth=queue_depth,
+        default_weight_dtype=weight_dtype, drift_every=drift_every)
+    # readiness waits for every listed model BEFORE the port opens:
+    # a scrape between bind and the last warmup sees 503 warming
+    plane.expect_models(len(specs))
+    plane.start()
+    server = serve(plane, port=port, host=host)
+    print(f"serving on {host}:{server.server_port}", flush=True)
+    try:
+        for name, path, shape, dtype in specs:
+            fitted = load_pipeline(path)
+            entry = plane.admit(
+                name, fitted, jax.ShapeDtypeStruct(shape, dtype))
+            mib = 1 << 20
+            print(f"admitted {name!r}: "
+                  f"{entry.charge.total_nbytes() / mib:.2f} MiB "
+                  f"({entry.charge.source}), buckets "
+                  f"{list(entry.buckets)}, warmup "
+                  f"{entry.warmup_s:.2f}s, weight_dtype "
+                  f"{entry.weight_dtype or 'f32'}", flush=True)
+        print(f"serving ready ({len(specs)} models) on "
+              f"{host}:{server.server_port}", flush=True)
+        import threading
+
+        threading.Event().wait()  # serve until interrupted
+    except AdmissionError as exc:
+        print(f"serve: admission refused: {exc}", file=sys.stderr)
+        return 3
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        plane.close()
+    return 0

@@ -1,0 +1,778 @@
+"""The serving plane: warm multi-model residency + the batch worker.
+
+``ServingPlane`` is the process-level object behind
+``python -m keystone_tpu serve``: fitted pipelines are ADMITTED into it
+(charged against the HBM budget, warmed bucket by bucket), requests are
+SUBMITTED to it (micro-batched behind the bounded queue), and the whole
+thing reports through the existing funnels — nothing here invents a new
+telemetry channel:
+
+* **Warm executables.** Admission warms every request bucket (full AND
+  partial fill, so the mask program compiles too) before the model is
+  marked ready; the compiled programs live in the same global caches
+  every pipeline apply uses (``_JIT_CACHE`` / ``_VMAP_JIT_CACHE``,
+  keyed on eq/struct keys), so steady-state requests re-dispatch warm
+  XLA executables. After warmup the PR 9 observatory fence stays armed
+  (``serving:steady-state``): any runtime compile is counted in
+  ``compile.unexpected_total`` — zero steady-state recompiles per
+  request shape is an asserted invariant, not a hope (PERFORMANCE.md
+  rule 14).
+* **Admission control.** The charge is the static planner's
+  ``model_nbytes + bucket x apply_item_nbytes`` bound
+  (``serving/residency.py``); placement/eviction under the budget
+  reuses the auto-cache profile-under-budget greedy
+  (``workflow/optimizer/auto_cache.py:greedy_select``) with
+  LRU-with-cost retention value: observed QPS x recompute (warmup)
+  cost, recency as the tiebreak. Evicted models keep their canonical
+  pickled bytes host-side, so eviction + readmission round-trips to
+  bit-identical predictions.
+* **Observability.** Per-model ``serving.request_ms.<model>`` /
+  ``serving.batch_fill.<model>`` histograms (plus the aggregate
+  families) land in the PR 8 registry and scrape surface; every
+  ``drift_every`` batches a model with a fit-time sketch
+  (``model.numerics_baseline``, PR 10) has its live inputs scored via
+  ``score_drift`` — a stale model trips ``numerics.drift_warn`` before
+  its accuracy visibly drops. The PR 13 ``weight_dtype`` bf16/int8
+  quantized predict is applied at admission when requested (the serve
+  CLI defaults to bf16).
+
+Thread model: handler/caller threads run ``admit``/``submit``; one
+worker thread drains the batcher. ``_models``/``_evicted``/
+``_warming``/``_expected`` are ``@guarded_by`` the plane lock; device
+work (warmup, batch execution) always runs OUTSIDE it.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.guarded import TracedLock, guarded_by
+from .batcher import BucketPolicy, MicroBatcher, Request
+from .residency import AdmissionError, ModelCharge, ResidencyLedger, model_charge
+
+
+class ModelNotAdmitted(LookupError):
+    """The named model is not resident (never admitted, or evicted)."""
+
+
+class ModelWarming(RuntimeError):
+    """The named model is admitted but its warmup has not completed —
+    retry after ``/healthz`` reports ready."""
+
+
+#: seconds of request history the QPS estimate looks back over
+_QPS_WINDOW_S = 30.0
+
+
+@dataclass
+class ServedModel:
+    """One warm resident model. Mutable serving stats are only touched
+    under the owning plane's lock (the plane declares the guard; this
+    record carries no lock of its own)."""
+
+    name: str
+    fitted: Any                      # the working FittedPipeline
+    blob: bytes                      # canonical pickle (readmission source)
+    sample: Any                      # ShapeDtypeStruct pytree of ONE item
+    charge: ModelCharge
+    buckets: Tuple[int, ...]
+    weight_dtype: Optional[str] = None
+    ready: bool = False
+    warmup_s: float = 0.0
+    last_used_s: float = field(default_factory=time.perf_counter)
+    served_rows: int = 0
+    served_requests: int = 0
+    batches: int = 0
+    baseline: Any = None             # DriftBaseline or None
+    drift_disabled: bool = False
+    _recent: Deque[Tuple[float, int]] = field(default_factory=deque)
+
+    def note_served(self, rows: int, requests: int, now: float) -> None:
+        self.last_used_s = now
+        self.served_rows += rows
+        self.served_requests += requests
+        self.batches += 1
+        self._recent.append((now, rows))
+        while self._recent and self._recent[0][0] < now - _QPS_WINDOW_S:
+            self._recent.popleft()
+
+    def qps(self, now: Optional[float] = None) -> float:
+        """Observed rows/sec over the recent window (0 before any
+        traffic) — the demand half of the retention value."""
+        if not self._recent:
+            return 0.0
+        now = time.perf_counter() if now is None else now
+        t0 = self._recent[0][0]
+        span = max(now - t0, 1e-3)
+        return sum(r for _, r in self._recent) / span
+
+    def retention_value(self, now: Optional[float] = None) -> float:
+        """LRU-with-cost: observed QPS x recompute (warmup) cost, with
+        recency as an epsilon tiebreak so two idle models evict
+        least-recently-used first."""
+        return (self.qps(now) * max(self.warmup_s, 1e-3)
+                + 1e-9 * self.last_used_s)
+
+    def state(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "ready": self.ready,
+            "weight_dtype": self.weight_dtype,
+            "charge_nbytes": self.charge.total_nbytes(),
+            "charge_source": self.charge.source,
+            "buckets": list(self.buckets),
+            "warmup_s": round(self.warmup_s, 4),
+            "served_rows": self.served_rows,
+            "served_requests": self.served_requests,
+            "batches": self.batches,
+            "qps": round(self.qps(), 3),
+            "drift_baseline": self.baseline is not None
+            and not self.drift_disabled,
+        }
+
+
+@dataclass
+class _EvictedModel:
+    """Host-side remainder of an evicted model: everything readmission
+    needs to restore bit-identical serving."""
+
+    blob: bytes
+    sample: Any
+    weight_dtype: Optional[str]
+    evicted_s: float = field(default_factory=time.perf_counter)
+
+
+def _zeros_batch(sample: Any, rows: int) -> Any:
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda leaf: np.zeros((rows,) + tuple(leaf.shape),
+                              np.dtype(leaf.dtype)),
+        sample,
+        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+
+
+def _apply_weight_dtype(graph: Any, weight_dtype: Optional[str]) -> int:
+    """Narrow every quantizable mapper in ``graph`` that did not choose
+    a dtype itself (explicit per-model choices always win). Mirrors the
+    LinearMapper constructor's constraint: only a plain (or absent)
+    StandardScalerModel feature scaler keeps the quantized apply one
+    fused affine program — other scalers stay f32 rather than raise."""
+    from ..nodes.learning.linear import (
+        BlockLinearMapper,
+        LinearMapper,
+        StandardScalerModel,
+        _canon_weight_dtype,
+    )
+
+    wd = _canon_weight_dtype(weight_dtype)
+    if wd is None:
+        return 0
+    changed = 0
+    for node in graph.nodes:
+        op = graph.get_operator(node)
+        if not isinstance(op, (LinearMapper, BlockLinearMapper)):
+            continue
+        if op.weight_dtype is not None:
+            continue
+        scaler = getattr(op, "feature_scaler", None)
+        if scaler is not None and type(scaler) is not StandardScalerModel:
+            continue
+        op.weight_dtype = wd
+        # drop memoized programs/eq keys: the quantized apply is a
+        # different program family (struct keys carry weight_dtype)
+        for attr in [k for k in op.__dict__ if k.startswith("_jit_")]:
+            del op.__dict__[attr]
+        op.__dict__.pop("_eq_key_val", None)
+        changed += 1
+    return changed
+
+
+def _evicted_record(entry: ServedModel) -> _EvictedModel:
+    """Host-side remainder for one eviction (also counts it); the dict
+    mutations stay inline at the call sites, under the plane lock."""
+    from ..observability.metrics import MetricsRegistry
+
+    MetricsRegistry.get_or_create().counter(
+        "serving.evictions_total").inc()
+    return _EvictedModel(blob=entry.blob, sample=entry.sample,
+                         weight_dtype=entry.weight_dtype)
+
+
+def _find_baseline(graph: Any) -> Any:
+    """First fit-time drift sketch riding the fitted operators
+    (``model.numerics_baseline``, attached by ``fit_streaming``)."""
+    for node in graph.nodes:
+        baseline = getattr(graph.get_operator(node),
+                           "numerics_baseline", None)
+        if baseline is not None:
+            return baseline
+    return None
+
+
+@guarded_by("_lock", "_models", "_evicted", "_warming", "_expected",
+            "_admitted_total")
+class ServingPlane:
+    """Warm multi-model serving under an HBM budget; see module
+    docstring. Usable as a context manager (``close`` disarms the
+    steady-state fence and stops the worker)."""
+
+    def __init__(self, hbm_budget: Optional[float] = None,
+                 max_batch: int = 64, queue_depth: int = 128,
+                 default_weight_dtype: Optional[str] = None,
+                 drift_every: int = 32,
+                 policy: Optional[BucketPolicy] = None,
+                 mesh: Any = None, steady_fence: bool = True):
+        from ..parallel.mesh import get_mesh, num_data_shards
+
+        self.mesh = mesh or get_mesh()
+        self._shards = num_data_shards(self.mesh)
+        self.policy = policy or BucketPolicy(max_batch)
+        self.ledger = ResidencyLedger(hbm_budget)
+        self.batcher = MicroBatcher(queue_depth)
+        self.drift_every = max(int(drift_every), 1)
+        self.default_weight_dtype = default_weight_dtype
+        self.steady_fence = steady_fence
+        self._models: Dict[str, ServedModel] = {}
+        self._evicted: Dict[str, _EvictedModel] = {}
+        self._warming = 0
+        self._expected = 0
+        self._admitted_total = 0
+        self._fence_armed = False
+        self._lock = TracedLock("serving.plane")
+        self._stop = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+        self._closed = False
+        if hbm_budget is not None:
+            from ..observability.metrics import MetricsRegistry
+
+            MetricsRegistry.get_or_create().gauge(
+                "serving.hbm_budget_bytes").set(float(hbm_budget))
+
+    # -- lifecycle ---------------------------------------------------------
+    def __enter__(self) -> "ServingPlane":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def start(self) -> "ServingPlane":
+        """Start the batch worker (idempotent)."""
+        with self._lock:
+            if self._worker is None and not self._closed:
+                self._stop = threading.Event()
+                t = threading.Thread(target=self._worker_loop,
+                                     name="keystone-serving-worker",
+                                     daemon=True)
+                self._worker = t
+                t.start()
+        return self
+
+    def close(self) -> None:
+        """Stop the worker, fail queued requests loudly, and disarm the
+        steady-state fence (a long-lived armed fence would mislabel the
+        process's later compiles as serving recompiles)."""
+        with self._lock:
+            self._closed = True
+            worker = self._worker
+            self._worker = None
+            self._stop.set()
+            if self._fence_armed:
+                self._fence_armed = False
+                self._observatory().disarm_fence()
+        if worker is not None:
+            worker.join(timeout=10.0)
+        for req in self.batcher.close():
+            if not req.future.done():
+                req.future.set_exception(
+                    RuntimeError("serving plane closed"))
+
+    @staticmethod
+    def _observatory():
+        from ..observability.compilelog import compile_observatory
+
+        return compile_observatory()
+
+    # -- readiness ---------------------------------------------------------
+    def expect_models(self, count: int) -> None:
+        """Declare how many admissions readiness must wait for — the
+        serve CLI calls this BEFORE binding the port, so ``/healthz``
+        reports not-ready from the first byte until the last admitted
+        model finished warming (the readiness-gate contract)."""
+        with self._lock:
+            self._expected = max(int(count), 0)
+
+    def ready(self) -> bool:
+        """True when every admitted model's warmup compile completed
+        and at least ``expect_models`` admissions have COMPLETED.
+        Completed is counted cumulatively (``_admitted_total``), not as
+        current residents: a startup admission that evicts an earlier
+        model must not wedge readiness at 503 forever (review
+        finding)."""
+        with self._lock:
+            entries = list(self._models.values())
+            return (self._warming == 0
+                    and self._admitted_total >= self._expected
+                    and all(e.ready for e in entries))
+
+    # -- admission ---------------------------------------------------------
+    def admit(self, name: str, fitted: Any, sample: Any,
+              weight_dtype: Optional[str] = "default") -> ServedModel:
+        """Admit a fitted pipeline as a warm served model.
+
+        ``sample`` describes ONE request item (array, ShapeDtypeStruct,
+        or ``(shape, dtype)``). The pipeline is canonicalized through a
+        pickle round-trip (the caller's object is never mutated), the
+        requested ``weight_dtype`` (``"default"`` = the plane default)
+        is applied to quantizable mappers, the admission charge is
+        derived from the static plan, budget space is made by
+        LRU-with-cost eviction where allowed, and every bucket is
+        warmed before the model turns ready. Raises
+        :class:`~.residency.AdmissionError` — mutating nothing — when
+        the model cannot fit even after every allowed eviction."""
+        sample = self._as_sample_struct(sample)
+        wd = (self.default_weight_dtype if weight_dtype == "default"
+              else weight_dtype)
+        try:
+            working = pickle.loads(pickle.dumps(fitted))
+        except Exception as exc:
+            raise TypeError(
+                f"model {name!r} is not picklable ({exc}) — serving "
+                "keeps a canonical pickled copy so eviction/readmission "
+                "round-trips bit-identically (the same constraint "
+                "utils.checkpoint.save_pipeline imposes). Replace "
+                "closures/lambdas in the pipeline with named "
+                "module-level functions or Transformer subclasses."
+            ) from exc
+        # normalize to a Pipeline so .apply means dataset-bind (a bare
+        # fitted Transformer from fit_streaming reserves .apply for its
+        # per-item function); the mutated operators are SHARED with
+        # `working`, so the canonical blob below carries the applied
+        # weight_dtype and readmission round-trips bit-identically
+        pipeline = working.to_pipeline()
+        _apply_weight_dtype(pipeline.graph, wd)
+        blob = pickle.dumps(working)
+        buckets = self.policy.rows(self._shards)
+        charge = model_charge(pipeline, sample, buckets[-1], name=name)
+        entry = ServedModel(
+            name=name, fitted=pipeline, blob=blob, sample=sample,
+            charge=charge, buckets=buckets, weight_dtype=wd,
+            baseline=_find_baseline(pipeline.graph))
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("serving plane closed")
+            if name in self._models:
+                raise ValueError(f"model {name!r} is already admitted")
+            victims = self._plan_evictions_locked(charge.total_nbytes())
+            for victim in victims:
+                dropped = self._models.pop(victim)
+                self.ledger.release(victim)
+                self._evicted[victim] = _evicted_record(dropped)
+            # the backstop: the ledger re-checks atomically and raises
+            # without mutating if the plan raced anything
+            self.ledger.admit(name, charge.total_nbytes())
+            self._models[name] = entry
+            # a readmitted name leaves the evicted set: its stale blob
+            # must not shadow the live entry in /models or stay
+            # host-resident forever (review finding); kept aside so a
+            # FAILED warmup can restore it instead of losing the model
+            prior_evicted = self._evicted.pop(name, None)
+            self._warming += 1
+            if self._fence_armed:
+                # warmup compiles are EXPECTED: the steady-state fence
+                # steps aside until every in-flight warmup completes
+                self._fence_armed = False
+                self._observatory().disarm_fence()
+            self._publish_locked()
+        try:
+            t0 = time.perf_counter()
+            self._warm(entry)
+            entry.warmup_s = time.perf_counter() - t0
+        except BaseException:
+            self._finish_warmup(entry, ok=False,
+                                restore_evicted=prior_evicted)
+            raise
+        from ..observability.metrics import MetricsRegistry
+
+        MetricsRegistry.get_or_create().histogram(
+            "serving.warmup_s").observe(entry.warmup_s)
+        self._finish_warmup(entry, ok=True)
+        return entry
+
+    def _finish_warmup(self, entry: ServedModel, ok: bool,
+                       restore_evicted: Optional[_EvictedModel] = None
+                       ) -> None:
+        """One admission's warmup epilogue: mark ready (or roll the
+        registration back on failure, restoring the evicted record a
+        readmission popped), leave the warming count, and re-arm the
+        steady-state fence when no warmup remains in flight — one lock
+        hold, so readiness and the fence can never disagree."""
+        with self._lock:
+            if ok:
+                entry.ready = True
+                self._admitted_total += 1
+            else:
+                self._models.pop(entry.name, None)
+                self.ledger.release(entry.name)
+                if restore_evicted is not None:
+                    self._evicted[entry.name] = restore_evicted
+            self._warming -= 1
+            self._sync_fence()
+            self._publish_locked()
+
+    def evict(self, name: str) -> None:
+        """Explicitly evict a resident model (its canonical bytes stay
+        host-side for :meth:`readmit`)."""
+        with self._lock:
+            if name not in self._models:
+                raise ModelNotAdmitted(f"model {name!r} is not resident")
+            entry = self._models.pop(name)
+            self.ledger.release(name)
+            self._evicted[name] = _evicted_record(entry)
+            self._publish_locked()
+
+    def readmit(self, name: str) -> ServedModel:
+        """Re-admit a previously evicted model from its canonical
+        pickled bytes — the round-trip is bit-identical by construction
+        (same bytes, same quantization, same programs)."""
+        with self._lock:
+            evicted = self._evicted.get(name)
+        if evicted is None:
+            raise ModelNotAdmitted(
+                f"model {name!r} was never evicted from this plane")
+        fitted = pickle.loads(evicted.blob)
+        return self.admit(name, fitted, evicted.sample,
+                          weight_dtype=evicted.weight_dtype)
+
+    def _plan_evictions_locked(self, needed: float) -> List[str]:
+        """Which ready residents to evict so ``needed`` bytes fit:
+        keep the highest retention-value set that fits in the remaining
+        budget (the auto-cache greedy, value-maximizing), evict the
+        rest. Warming models are never victims. Raises AdmissionError
+        when ``needed`` exceeds the whole budget (refusal — documented
+        admission math, README "Serving")."""
+        budget = self.ledger.budget
+        if budget is None:
+            return []
+        if needed > budget:
+            from ..observability.metrics import MetricsRegistry
+
+            MetricsRegistry.get_or_create().counter(
+                "serving.admission_rejected_total").inc()
+            mib = 1 << 20
+            raise AdmissionError(
+                f"model charge {needed / mib:.2f} MiB exceeds the whole "
+                f"serving HBM budget {budget / mib:.2f} MiB — refusing "
+                "admission (shrink the model, quantize weights, or "
+                "lower max_batch)")
+        free = budget - self.ledger.used()
+        if free >= needed:
+            return []
+        from ..workflow.optimizer.auto_cache import greedy_select
+
+        now = time.perf_counter()
+        evictable = {n: e for n, e in self._models.items() if e.ready}
+        pinned_bytes = sum(self.ledger.charge_of(n)
+                           for n in self._models if n not in evictable)
+
+        def candidates(selected, space_left):
+            return [n for n in evictable if n not in selected
+                    and self.ledger.charge_of(n) < space_left]
+
+        keep = greedy_select(
+            (), candidates,
+            lambda n: self.ledger.charge_of(n),
+            lambda sel: -sum(evictable[n].retention_value(now)
+                             for n in sel),
+            budget - needed - pinned_bytes)
+        victims = [n for n in evictable if n not in keep]
+        kept_bytes = pinned_bytes + sum(self.ledger.charge_of(n)
+                                        for n in keep)
+        if kept_bytes + needed > budget:
+            from ..observability.metrics import MetricsRegistry
+
+            MetricsRegistry.get_or_create().counter(
+                "serving.admission_rejected_total").inc()
+            mib = 1 << 20
+            raise AdmissionError(
+                f"cannot make room for {needed / mib:.2f} MiB under the "
+                f"{budget / mib:.2f} MiB budget: "
+                f"{kept_bytes / mib:.2f} MiB is pinned by warming/"
+                "unevictable models")
+        return victims
+
+    def _sync_fence(self) -> None:
+        """Arm the steady-state fence exactly when no warmup is in
+        flight. Called with the plane lock held; writes only the
+        (undeclared) fence flag."""
+        if not self.steady_fence or self._closed:
+            return
+        if self._warming == 0 and not self._fence_armed:
+            self._observatory().arm_fence("serving:steady-state")
+            self._fence_armed = True
+
+    def _publish_locked(self) -> None:
+        from ..observability.metrics import MetricsRegistry
+
+        reg = MetricsRegistry.get_or_create()
+        reg.gauge("serving.models_resident").set(
+            sum(1 for e in self._models.values() if e.ready))
+        reg.gauge("serving.models_warming").set(self._warming)
+
+    # -- warmup ------------------------------------------------------------
+    def _warm(self, entry: ServedModel) -> None:
+        """Compile every steady-state program for this model: each
+        bucket at FULL fill (the unmasked program) and at partial fill
+        (the mask program — ``n < padded_n`` routes through
+        ``_zero_masked_rows``), plus the drift-sketch program when a
+        baseline rides the model. Runs with the fence disarmed; the
+        numerics gauges stay untouched (a zeros warmup batch is not
+        traffic)."""
+        for bucket in entry.buckets:
+            self._execute(entry, _zeros_batch(entry.sample, bucket), bucket)
+            if bucket > 1:
+                partial = bucket - 1
+                self._execute(
+                    entry, _zeros_batch(entry.sample, partial), partial)
+        if entry.baseline is not None:
+            from ..observability.numerics import numerics_suppressed
+
+            # the sketch program compiles per (bucket, d) shape like
+            # the apply programs: warm it for EVERY bucket, or the
+            # first drift score on a larger bucket would compile under
+            # the armed steady-state fence (review finding)
+            for bucket in entry.buckets:
+                ds = self._bucketed(
+                    entry, _zeros_batch(entry.sample, bucket), bucket)
+                try:
+                    with numerics_suppressed():
+                        self._score_drift(entry, ds)
+                except ValueError:
+                    self._disable_drift(entry)
+                    break
+
+    # -- request path ------------------------------------------------------
+    def submit(self, name: str, x: Any,
+               timeout_s: Optional[float] = None):
+        """Enqueue one request; returns a Future resolving to the model
+        output for exactly the submitted rows (pad stripped). ``x`` is
+        one item (the admitted sample shape) or a leading-dim batch of
+        them, up to the largest bucket."""
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is None:
+                known = sorted(self._models) + [
+                    f"{k} (evicted)" for k in sorted(self._evicted)]
+                raise ModelNotAdmitted(
+                    f"model {name!r} is not resident "
+                    f"(known: {known or 'none'})")
+            if not entry.ready:
+                raise ModelWarming(f"model {name!r} is still warming")
+            sample = entry.sample
+        x_tree, n = self._normalize(name, sample, x)
+        return self.batcher.submit(name, x_tree, n, timeout_s=timeout_s)
+
+    def predict(self, name: str, x: Any, timeout_s: float = 60.0):
+        """Synchronous convenience: submit + wait."""
+        return self.submit(name, x).result(timeout=timeout_s)
+
+    def _normalize(self, name: str, sample: Any,
+                   x: Any) -> Tuple[Any, int]:
+        import jax
+
+        structs = jax.tree_util.tree_leaves(
+            sample,
+            is_leaf=lambda s: isinstance(s, jax.ShapeDtypeStruct))
+        leaves = jax.tree_util.tree_leaves(x)
+        if len(leaves) != len(structs):
+            raise ValueError(
+                f"request for {name!r} has {len(leaves)} leaves, the "
+                f"admitted sample has {len(structs)}")
+        ns = set()
+        out_leaves = []
+        for leaf, struct in zip(leaves, structs):
+            arr = np.asarray(leaf, dtype=struct.dtype)
+            item = tuple(struct.shape)
+            if arr.shape == item:
+                arr = arr[None]
+            elif arr.shape[1:] != item:
+                raise ValueError(
+                    f"request leaf shape {arr.shape} matches neither "
+                    f"item {item} nor (n, *item) for model {name!r}")
+            ns.add(arr.shape[0])
+            out_leaves.append(arr)
+        if len(ns) != 1:
+            raise ValueError(
+                f"request leaves disagree on row count: {sorted(ns)}")
+        n = ns.pop()
+        if n > self.policy.max_rows(self._shards):
+            raise ValueError(
+                f"request of {n} rows exceeds the largest bucket "
+                f"({self.policy.max_rows(self._shards)}) — split it")
+        rebuilt = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(
+                sample,
+                is_leaf=lambda s: isinstance(s, jax.ShapeDtypeStruct)),
+            out_leaves)
+        return rebuilt, int(n)
+
+    @staticmethod
+    def _as_sample_struct(sample: Any) -> Any:
+        import jax
+
+        if isinstance(sample, jax.ShapeDtypeStruct):
+            return sample
+        if (isinstance(sample, tuple) and len(sample) == 2
+                and isinstance(sample[0], (tuple, list))):
+            return jax.ShapeDtypeStruct(tuple(sample[0]),
+                                        np.dtype(sample[1]))
+        if hasattr(sample, "shape") and hasattr(sample, "dtype"):
+            return jax.ShapeDtypeStruct(tuple(sample.shape), sample.dtype)
+        leaves = jax.tree_util.tree_leaves(sample)
+        if leaves and all(isinstance(l, jax.ShapeDtypeStruct)
+                          for l in leaves):
+            return sample
+        raise TypeError(
+            "sample must describe ONE request item: a "
+            "jax.ShapeDtypeStruct (pytree), (shape, dtype), or array")
+
+    # -- execution ---------------------------------------------------------
+    def _bucketed(self, entry: ServedModel, x_tree: Any, n: int):
+        from ..parallel.dataset import bucketed_dataset
+
+        bucket = self.policy.bucket_for(max(n, 1), self._shards)
+        return bucketed_dataset(x_tree, n, bucket, self.mesh)
+
+    def _execute(self, entry: ServedModel, x_tree: Any, n: int):
+        """One padded-bucket apply; returns ``(outputs, ds)`` where
+        outputs carries exactly ``n`` rows (pad stripped)."""
+        from ..parallel.dataset import ArrayDataset, Dataset
+
+        ds = self._bucketed(entry, x_tree, n)
+        out = entry.fitted.apply(ds).get()
+        if isinstance(out, ArrayDataset):
+            return out.numpy(), ds
+        if isinstance(out, Dataset):
+            return out.collect()[:n], ds
+        return np.asarray(out), ds
+
+    def _score_drift(self, entry: ServedModel, ds) -> None:
+        from ..observability.numerics import score_drift
+
+        score_drift(entry.baseline, ds)
+
+    def _disable_drift(self, entry: ServedModel) -> None:
+        entry.drift_disabled = True
+        entry.baseline = None
+        from ..observability.numerics import record_numerics_event
+
+        record_numerics_event(
+            "drift_unscorable", model=entry.name,
+            reason="request space is not the sketched feature space "
+                   "(baseline rides an upstream stage)")
+
+    # -- the worker --------------------------------------------------------
+    def _worker_loop(self) -> None:
+        max_rows = self.policy.max_rows(self._shards)
+        while not self._stop.is_set():
+            batch = self.batcher.take(max_rows, timeout_s=0.05)
+            if batch:
+                self._serve_batch(batch)
+
+    def _serve_batch(self, requests: List[Request]) -> None:
+        import jax
+
+        from ..observability.metrics import MetricsRegistry
+
+        name = requests[0].model
+        reg = MetricsRegistry.get_or_create()
+        try:
+            with self._lock:
+                entry = self._models.get(name)
+            if entry is None or not entry.ready:
+                raise ModelNotAdmitted(
+                    f"model {name!r} was evicted while queued")
+            rows = sum(r.n for r in requests)
+            merged = jax.tree_util.tree_map(
+                lambda *leaves: np.concatenate(leaves, axis=0),
+                *[r.x for r in requests])
+            t0 = time.perf_counter()
+            outputs, ds = self._execute(entry, merged, rows)
+            batch_ms = (time.perf_counter() - t0) * 1e3
+            bucket = ds.padded_n
+            offset = 0
+            for req in requests:
+                req.future.set_result(self._slice_rows(
+                    outputs, offset, req.n))
+                offset += req.n
+            now = time.perf_counter()
+            reg.counter("serving.requests_total").inc(len(requests))
+            reg.counter("serving.rows_total").inc(rows)
+            reg.counter("serving.batches_total").inc()
+            reg.histogram("serving.batch_ms").observe(batch_ms)
+            fill = rows / float(bucket)
+            reg.histogram("serving.batch_fill").observe(fill)
+            reg.histogram(f"serving.batch_fill.{name}").observe(fill)
+            for req in requests:
+                wait_ms = (now - req.enqueued_s) * 1e3
+                reg.histogram("serving.request_ms").observe(wait_ms)
+                reg.histogram(
+                    f"serving.request_ms.{name}").observe(wait_ms)
+            with self._lock:
+                entry.note_served(rows, len(requests), now)
+                score_now = (not entry.drift_disabled
+                             and entry.baseline is not None
+                             and entry.batches % self.drift_every == 0)
+            if score_now:
+                try:
+                    self._score_drift(entry, ds)
+                except ValueError:
+                    self._disable_drift(entry)
+        except BaseException as exc:
+            reg.counter("serving.errors_total").inc()
+            for req in requests:
+                if not req.future.done():
+                    req.future.set_exception(exc)
+        finally:
+            self.batcher.done(len(requests))
+
+    @staticmethod
+    def _slice_rows(outputs: Any, offset: int, n: int) -> Any:
+        import jax
+
+        if isinstance(outputs, list):  # host collect() output
+            return outputs[offset:offset + n]
+        return jax.tree_util.tree_map(
+            lambda leaf: leaf[offset:offset + n], outputs)
+
+    # -- introspection -----------------------------------------------------
+    def state(self) -> Dict[str, Any]:
+        """JSON-able plane state (the ``/models`` endpoint body)."""
+        ready = self.ready()  # takes the lock itself; not reentrant
+        with self._lock:
+            models = [e.state() for e in self._models.values()]
+            evicted = sorted(self._evicted)
+        return {
+            "ready": ready,
+            "hbm_budget_bytes": self.ledger.budget,
+            "hbm_charged_bytes": self.ledger.used(),
+            "buckets": list(self.policy.rows(self._shards)),
+            "queue_depth": self.batcher.depth(),
+            "models": sorted(models, key=lambda m: m["name"]),
+            "evicted": evicted,
+        }
+
+    def unexpected_recompiles(self) -> float:
+        """The ``compile.unexpected_total`` counter — with the
+        steady-state fence armed, any nonzero delta across a serving
+        window is a recompile bug, not noise."""
+        from ..observability.metrics import MetricsRegistry
+
+        return MetricsRegistry.get_or_create().counter(
+            "compile.unexpected_total").value

@@ -1,0 +1,186 @@
+"""HBM admission control for the serving plane.
+
+Multi-model residency runs under an EXPLICIT budget: a fitted pipeline
+is only admitted when its charge — persistent fitted state plus the
+per-bucket activation bound, both from the static planner
+(``analysis/resources.py``) — fits next to the models already warm.
+The arithmetic is the one the :class:`~keystone_tpu.analysis.resources.
+HbmPlan` docstring documents (``serving residency ~= model_nbytes +
+batch x apply_item_nbytes``); this module turns it from a comment into
+the enforced contract:
+
+* :func:`model_charge` — derive one model's :class:`ModelCharge` from a
+  device-free ``fitted.check(sample)`` static plan; when the plan
+  cannot size the per-item activation (opaque host stages), fall back
+  to a measured one-item probe apply, with the provenance recorded on
+  the charge (``source``) so an operator can see which models are
+  planned vs probed.
+* :class:`ResidencyLedger` — the charged-bytes ledger
+  (``@guarded_by``-declared, like the streaming ``_Residency`` ledger
+  it mirrors): admission atomically applies the planned evictions and
+  charges the newcomer, or raises :class:`AdmissionError` without
+  mutating anything — over-budget admission is REFUSED, never absorbed.
+
+Placement/eviction policy (which models to keep when space runs out)
+lives in ``serving/plane.py`` and reuses the auto-cache
+profile-under-budget greedy (``workflow/optimizer/auto_cache.py:
+greedy_select``); this module only accounts and enforces.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from ..utils.guarded import TracedLock, guarded_by
+
+
+class AdmissionError(MemoryError):
+    """A model admission would exceed the serving HBM budget (even
+    after every allowed eviction). The message names the charge, the
+    budget, and what is currently resident."""
+
+
+@dataclass(frozen=True)
+class ModelCharge:
+    """One served model's HBM admission charge.
+
+    ``model_nbytes`` is the persistent fitted state
+    (:func:`~keystone_tpu.analysis.resources.fitted_model_nbytes`);
+    ``item_nbytes`` the widest per-item activation along the apply path
+    (``HbmPlan.apply_item_nbytes``, or a probed measurement);
+    ``bucket_rows`` the LARGEST request bucket the model will serve —
+    the activation bound is charged at the worst case, so a full bucket
+    arriving never busts the budget at runtime. ``source`` records the
+    provenance (``static-plan`` | ``probed``)."""
+
+    model_nbytes: float
+    item_nbytes: float
+    bucket_rows: int
+    source: str = "static-plan"
+
+    def activation_nbytes(self) -> float:
+        return float(self.item_nbytes) * float(self.bucket_rows)
+
+    def total_nbytes(self) -> float:
+        return float(self.model_nbytes) + self.activation_nbytes()
+
+
+def _probe_item_nbytes(fitted, sample_struct) -> float:
+    """Measured fallback for plan-unresolved pipelines: apply ONE
+    zero item and read the device bytes of input + output — honest
+    device evidence instead of an invented number (the plan's
+    ``unresolved`` contract), at the cost of one tiny apply before the
+    admission decision."""
+    import jax
+    import numpy as np
+
+    from ..parallel.dataset import ArrayDataset, device_nbytes
+
+    def zero(leaf):
+        return np.zeros((1,) + tuple(leaf.shape), np.dtype(leaf.dtype))
+
+    data = jax.tree_util.tree_map(
+        zero, sample_struct,
+        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+    ds = ArrayDataset.from_numpy(data)
+    out = fitted.apply(ds).get()
+    rows = max(getattr(out, "padded_n", len(out)), 1)
+    return (device_nbytes(ds) / max(ds.padded_n, 1)
+            + device_nbytes(out) / rows)
+
+
+def model_charge(fitted, sample_struct, bucket_rows: int,
+                 name: str = "model") -> ModelCharge:
+    """Derive the admission charge for ``fitted`` serving items of
+    ``sample_struct`` (a ``jax.ShapeDtypeStruct`` pytree describing ONE
+    request item) at a largest bucket of ``bucket_rows`` rows.
+
+    Device-free when the static plan resolves: the pipeline is
+    ``check``-ed on the item spec with unknown ``n`` (the apply-path
+    view), ``apply_item_nbytes`` sizes the activation and
+    ``fitted_model_nbytes`` the resident parameters. A plan that cannot
+    size the activation falls back to the one-item probe."""
+    from ..analysis.resources import (
+        fitted_model_nbytes,
+        serving_residency_nbytes,
+    )
+
+    report = fitted.check(sample_struct, name=f"serve:{name}")
+    model_b = fitted_model_nbytes(fitted.to_pipeline().graph)
+    total = serving_residency_nbytes(model_b, report.plan, bucket_rows)
+    if total is not None:
+        return ModelCharge(model_nbytes=model_b,
+                           item_nbytes=float(report.plan.apply_item_nbytes),
+                           bucket_rows=int(bucket_rows),
+                           source="static-plan")
+    item_b = _probe_item_nbytes(fitted, sample_struct)
+    return ModelCharge(model_nbytes=model_b, item_nbytes=item_b,
+                       bucket_rows=int(bucket_rows), source="probed")
+
+
+@guarded_by("_lock", "_charges")
+class ResidencyLedger:
+    """Charged-bytes accounting for warm served models. Every mutation
+    runs under ``_lock`` (declared, so the concurrency passes check
+    it); :meth:`admit` re-checks the budget and charges in one lock
+    hold, raising :class:`AdmissionError` without mutating when the
+    charge would not fit. The plan-evict-charge SEQUENCE is serialized
+    by the owning plane's lock (``serving/plane.py``) — this ledger is
+    the accounting backstop, not the planner."""
+
+    def __init__(self, budget: Optional[float]):
+        self.budget = None if budget is None else float(budget)
+        self._charges: Dict[str, float] = {}
+        self._lock = TracedLock("serving.residency")
+
+    def used(self) -> float:
+        with self._lock:
+            return sum(self._charges.values())
+
+    def charge_of(self, name: str) -> float:
+        with self._lock:
+            return self._charges.get(name, 0.0)
+
+    def resident(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._charges)
+
+    def admit(self, name: str, nbytes: float) -> None:
+        """Charge ``nbytes`` for ``name`` after re-checking the budget
+        under the ledger lock; raises :class:`AdmissionError` (and
+        mutates NOTHING) when the result would exceed it. Eviction
+        releases happen via :meth:`release` BEFORE this call, all
+        under the plane lock — so a refusal here means the planner's
+        arithmetic was wrong, and it leaves the victims released and
+        the newcomer uncharged (a consistent, conservative state)."""
+        nbytes = float(nbytes)
+        with self._lock:
+            after = dict(self._charges)
+            used = sum(after.values())
+            if self.budget is not None and used + nbytes > self.budget:
+                mib = 1 << 20
+                raise AdmissionError(
+                    f"admitting {name!r} ({nbytes / mib:.2f} MiB) would "
+                    f"put serving residency at {(used + nbytes) / mib:.2f}"
+                    f" MiB > budget {self.budget / mib:.2f} MiB "
+                    f"(resident: {sorted(after) or 'none'})")
+            after[name] = nbytes
+            self._charges = after
+        self._publish()
+
+    def release(self, name: str) -> float:
+        with self._lock:
+            freed = self._charges.pop(name, 0.0)
+        self._publish()
+        return freed
+
+    def _publish(self) -> None:
+        # gauges are published OUTSIDE the ledger lock: the metrics
+        # layer takes its own plain locks and the scrape surface only
+        # needs eventually-fresh totals
+        from ..observability.metrics import MetricsRegistry
+
+        reg = MetricsRegistry.get_or_create()
+        reg.gauge("serving.hbm_charged_bytes").set(self.used())
+        if self.budget is not None:
+            reg.gauge("serving.hbm_budget_bytes").set(self.budget)

@@ -242,6 +242,38 @@ def make_cached_graph(graph: Graph, to_cache: frozenset) -> Graph:
     return graph
 
 
+def greedy_select(initial, candidates_fn, mem_of, objective,
+                  budget: float) -> frozenset:
+    """The profile-under-budget greedy selection loop (reference
+    ``AutoCacheRule.scala:526-549``), decoupled from Cacher insertion so
+    one algorithm serves both residency planners: intermediate-result
+    caching here (:meth:`AutoCacheRule._greedy`: minimize the estimated
+    pipeline runtime of the cache set) and the serving plane's
+    multi-model placement/eviction (``serving/plane.py``: maximize the
+    retained LRU-with-cost value — observed QPS x recompute cost —
+    under the HBM budget).
+
+    Starting from ``initial``, repeatedly add the candidate whose
+    addition MINIMIZES ``objective(selected | {c})`` while the summed
+    ``mem_of`` stays under ``budget``; ``candidates_fn(selected,
+    space_left)`` returns the admissible additions for this step (it is
+    re-evaluated every step, so run counts / recency may shift as the
+    set grows). Returns the selected frozenset."""
+    selected = set(initial)
+
+    def used() -> float:
+        return sum(mem_of(n) for n in selected)
+
+    while used() < budget:
+        cands = candidates_fn(frozenset(selected), budget - used())
+        if not cands:
+            break
+        best = min(cands,
+                   key=lambda c: objective(frozenset(selected | {c})))
+        selected.add(best)
+    return frozenset(selected)
+
+
 def _device_mem_budget() -> float:
     """75% of free device memory (reference ``AutoCacheRule.scala:480``),
     read from the first accelerator's memory stats when available."""
@@ -304,36 +336,32 @@ class AutoCacheRule(Rule):
         profiles = profile_graph(graph, self.scales, self.num_trials)
         children = _children_with_multiplicity(graph)
         weights = {n: node_weight(graph.get_operator(n)) for n in graph.nodes}
-        cached = set(init_cache_set(graph))
         # per-input runtime nodes can never be reused across inputs
         downstream_of_source = graph.source_descendants()
         budget = self.max_mem if self.max_mem is not None else _device_mem_budget()
 
-        def used() -> float:
-            return sum(profiles.get(n, Profile()).mem for n in cached)
-
-        runs = get_runs(graph, children, frozenset(cached), weights)
-
-        def candidates(space_left: float):
+        def candidates(selected: frozenset, space_left: float):
+            # run counts shift as the cache set grows, so they are
+            # recomputed per selection step (the original loop's
+            # post-add get_runs refresh, folded into the candidate fn)
+            runs = get_runs(graph, children, selected, weights)
             return [
                 n for n in graph.nodes
-                if n not in cached and runs[n] > 1
+                if n not in selected and runs[n] > 1
                 and n not in downstream_of_source
                 and profiles.get(n, Profile()).mem < space_left
                 and _data_outputting(graph, n)
             ]
 
-        while used() < budget:
-            cands = candidates(budget - used())
-            if not cands:
-                break
-            best = min(
-                cands,
-                key=lambda n: estimate_cached_run_time(
-                    graph, children, frozenset(cached | {n}), profiles),
-            )
-            cached.add(best)
-            runs = get_runs(graph, children, frozenset(cached), weights)
+        cached = set(greedy_select(
+            init_cache_set(graph), candidates,
+            lambda n: profiles.get(n, Profile()).mem,
+            lambda sel: estimate_cached_run_time(
+                graph, children, sel, profiles),
+            budget))
+
+        def used() -> float:
+            return sum(profiles.get(n, Profile()).mem for n in cached)
 
         to_cache = frozenset(cached - init_cache_set(graph))
         from ...observability.trace import current_trace

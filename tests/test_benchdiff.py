@@ -126,6 +126,48 @@ def test_direction_markers():
     assert lower_is_better("solver_breakdown_total")
     assert lower_is_better("numerics_drift_score")
     assert lower_is_better("numerics_overhead_share")
+    # serving latency (PR 15): landed BEFORE the first serving bench
+    # round, the PR 9 _bytes lesson
+    assert lower_is_better("serve_p50_ms")
+    assert lower_is_better("serve_p99_ms")
+    assert lower_is_better("serve_p99")
+    assert lower_is_better("serving_request_latency")
+    # throughput: _qps is higher-better and WINS over any lower-better
+    # substring sharing the name
+    assert not lower_is_better("serve_qps_per_chip")
+    assert not lower_is_better("p99_bounded_qps")
+    assert not lower_is_better("stall_free_qps")
+
+
+def test_serving_latency_regression_fixture(tmp_path, capsys):
+    """The serving direction markers as an end-to-end synthetic
+    fixture: a p99 that RISES 30% exits 2 (regressed), a qps that
+    DROPS 30% exits 2, and a qps that rises classifies improved —
+    pinned before BENCH_r08 records the first serving baseline."""
+    base = _artifact(tmp_path / "BENCH_r01.json", 1,
+                     {"serve_qps_per_chip": 1000.0, "serve_p99_ms": 8.0})
+    worse = _artifact(tmp_path / "BENCH_r02.json", 2,
+                      {"serve_qps_per_chip": 1000.0,
+                       "serve_p99_ms": 10.4})
+    rc = benchdiff_main([str(base), str(worse)])
+    out = capsys.readouterr().out
+    assert rc == 2 and "regressed" in out
+
+    slow = _artifact(tmp_path / "BENCH_r03.json", 3,
+                     {"serve_qps_per_chip": 700.0, "serve_p99_ms": 8.0})
+    rc = benchdiff_main([str(base), str(slow)])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert any("serve_qps_per_chip" in line and "regressed" in line
+               for line in out.splitlines())
+
+    fast = _artifact(tmp_path / "BENCH_r04.json", 4,
+                     {"serve_qps_per_chip": 1400.0, "serve_p99_ms": 8.0})
+    rc = benchdiff_main([str(base), str(fast)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert any("serve_qps_per_chip" in line and "improved" in line
+               for line in out.splitlines())
 
 
 def test_overhead_share_bands_absolutely(tmp_path):

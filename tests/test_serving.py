@@ -1,0 +1,637 @@
+"""The serving plane (``keystone_tpu/serving`` + ``python -m
+keystone_tpu serve``): warm device-resident executables, pad-to-bucket
+micro-batching behind the slot-gated bounded queue, HBM-budgeted
+multi-model residency, and the funnel wiring (per-model latency/fill
+histograms, drift scoring, the readiness-gated scrape surface).
+
+The acceptance pins (ISSUE 15):
+
+* load test — >= 3 pipelines hot under an ASSERTED HBM budget, with
+  the over-budget admission REFUSED (and nothing mutated);
+* eviction + readmission round-trips to bit-identical predictions;
+* zero steady-state recompiles per bucket, asserted via the compile
+  observatory fence (``compile.unexpected_total`` delta == 0 across a
+  multi-shape request storm);
+* the admission-vs-eviction interleaving, pinned under the
+  deterministic scheduler (``tests/sched.py``) on the real TracedLock
+  yield points.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from keystone_tpu.nodes.learning.linear import LinearMapEstimator
+from keystone_tpu.observability.metrics import MetricsRegistry
+from keystone_tpu.parallel.dataset import ArrayDataset, bucketed_dataset
+from keystone_tpu.parallel.mesh import get_mesh, num_data_shards
+from keystone_tpu.serving import (
+    AdmissionError,
+    BucketPolicy,
+    MicroBatcher,
+    ModelNotAdmitted,
+    QueueFullError,
+    ServingPlane,
+    model_charge,
+)
+
+
+def _make_fitted(d, k, seed=0, n=96, **est_kw):
+    r = np.random.RandomState(seed)
+    X = r.rand(n, d).astype(np.float32)
+    Y = r.rand(n, k).astype(np.float32)
+    fitted = LinearMapEstimator(lam=1e-3, **est_kw).with_data(
+        ArrayDataset.from_numpy(X), ArrayDataset.from_numpy(Y)).fit()
+    return fitted, X, Y
+
+
+def _sample(d):
+    return jax.ShapeDtypeStruct((d,), np.float32)
+
+
+@pytest.fixture
+def plane_factory():
+    planes = []
+
+    def make(**kw):
+        kw.setdefault("max_batch", 16)
+        plane = ServingPlane(**kw)
+        planes.append(plane)
+        return plane
+
+    yield make
+    for plane in planes:
+        plane.close()
+
+
+# -- bucket policy & pad-to-bucket -------------------------------------------
+
+def test_bucket_policy_ladder_is_shard_rounded():
+    policy = BucketPolicy(max_batch=64)
+    rows = policy.rows(8)
+    assert rows == (8, 16, 32, 64)
+    assert all(b % 8 == 0 for b in rows)
+    # non-power-of-two ceiling is included exactly (shard-rounded)
+    assert BucketPolicy(max_batch=48).rows(8)[-1] == 48
+    assert BucketPolicy(max_batch=5).rows(1) == (1, 2, 4, 5)
+
+
+def test_bucket_for_picks_smallest_fit_and_refuses_overflow():
+    policy = BucketPolicy(max_batch=64)
+    assert policy.bucket_for(1, 8) == 8
+    assert policy.bucket_for(9, 8) == 16
+    assert policy.bucket_for(64, 8) == 64
+    with pytest.raises(ValueError, match="exceeds the largest bucket"):
+        policy.bucket_for(65, 8)
+
+
+def test_bucketed_dataset_pads_to_bucket_with_true_n():
+    X = np.arange(5 * 3, dtype=np.float32).reshape(5, 3)
+    ds = bucketed_dataset(X, 5, 16)
+    assert ds.padded_n == 16 and ds.n == 5
+    np.testing.assert_array_equal(ds.numpy(), X)  # pad stripped
+    assert bool(np.asarray(ds.mask).sum() == 5)
+    with pytest.raises(ValueError, match="multiple of the mesh"):
+        bucketed_dataset(X, 5, 10)  # 10 is not a multiple of 8 shards
+    with pytest.raises(ValueError, match="do not fit"):
+        bucketed_dataset(X, 5, 0)
+
+
+# -- admission charges --------------------------------------------------------
+
+def test_model_charge_uses_static_plan():
+    fitted, _, _ = _make_fitted(32, 4)
+    charge = model_charge(fitted, _sample(32), bucket_rows=16)
+    assert charge.source == "static-plan"
+    # fitted linear model: W (32,4) + intercept (4,) + scaler mean (32,)
+    assert charge.model_nbytes >= 4 * (32 * 4 + 4 + 32)
+    assert charge.item_nbytes > 0
+    assert charge.total_nbytes() == pytest.approx(
+        charge.model_nbytes + 16 * charge.item_nbytes)
+
+
+# -- the load test (acceptance) ----------------------------------------------
+
+def test_three_models_hot_under_asserted_budget(plane_factory):
+    """>= 3 pipelines warm under an asserted HBM budget; the 4th
+    (over-budget) admission is REFUSED without mutating the plane;
+    eviction + readmission round-trips bit-identical; zero steady-state
+    recompiles across every bucket of every model."""
+    dims = [(24, 3, 1), (32, 4, 2), (40, 5, 3)]
+    models = {f"m{d}": _make_fitted(d, k, seed) for d, k, seed in dims}
+    # the over-budget model AND the reference outputs are built before
+    # the steady-state fence arms: the fence is process-global, so a
+    # mid-test fit would honestly count as an unexpected compile
+    big, _, _ = _make_fitted(512, 64, seed=9)
+    sizes = (1, 3, 7, 8, 9, 15, 16)
+    refs = {
+        (name, n): fitted.apply(ArrayDataset.from_numpy(X[:n])).numpy()
+        for name, (fitted, X, _) in models.items() for n in (*sizes, 6)}
+    charges = {
+        name: model_charge(fitted, _sample(fitted_x.shape[1]), 16,
+                           name=name)
+        for name, (fitted, fitted_x, _) in models.items()}
+    budget = sum(c.total_nbytes() for c in charges.values()) + 1024
+    plane = plane_factory(hbm_budget=budget, queue_depth=64)
+    plane.start()
+    for name, (fitted, X, _) in models.items():
+        plane.admit(name, fitted, _sample(X.shape[1]))
+    state = plane.state()
+    assert state["ready"] and len(state["models"]) == 3
+    assert state["hbm_charged_bytes"] <= budget  # the asserted budget
+
+    # over-budget admission refused, nothing mutated
+    reg = MetricsRegistry.get_or_create()
+    rejected0 = reg.counter("serving.admission_rejected_total").value
+    with pytest.raises(AdmissionError, match="refusing"):
+        plane.admit("big", big, _sample(512))
+    assert reg.counter(
+        "serving.admission_rejected_total").value == rejected0 + 1
+    after = plane.state()
+    assert sorted(m["name"] for m in after["models"]) == sorted(models)
+    assert after["hbm_charged_bytes"] == state["hbm_charged_bytes"]
+
+    # zero steady-state recompiles: every model, every bucket, many n
+    u0 = plane.unexpected_recompiles()
+    outputs = {}
+    for name, (fitted, X, _) in models.items():
+        for n in sizes:
+            out = plane.predict(name, X[:n])
+            np.testing.assert_allclose(out, refs[(name, n)],
+                                       rtol=1e-5, atol=1e-5)
+        outputs[name] = plane.predict(name, X[:6])
+    assert plane.unexpected_recompiles() - u0 == 0, (
+        "steady-state serving recompiled — the pad-to-bucket warmup "
+        "missed a program")
+
+    # eviction + readmission round-trips bit-identical
+    victim = "m32"
+    plane.evict(victim)
+    with pytest.raises(ModelNotAdmitted):
+        plane.predict(victim, models[victim][1][:2])
+    plane.readmit(victim)
+    again = plane.predict(victim, models[victim][1][:6])
+    assert np.array_equal(outputs[victim], again), (
+        "evicted+readmitted model must serve bit-identical predictions")
+    final = plane.state()
+    assert victim not in final["evicted"], (
+        "a readmitted model must leave the evicted set (stale blob "
+        "retention + double-listing in /models)")
+
+
+def test_admission_evicts_lowest_value_resident(plane_factory):
+    """When space runs out, admission evicts by LRU-with-cost: the
+    model with the lowest observed-QPS x recompute-cost value goes
+    first, and the admission then succeeds."""
+    a, aX, _ = _make_fitted(24, 3, seed=1)
+    b, bX, _ = _make_fitted(24, 3, seed=2)
+    c, cX, _ = _make_fitted(24, 3, seed=3)
+    ca = model_charge(a, _sample(24), 16)
+    cb = model_charge(b, _sample(24), 16)
+    # equal-dim models: room for exactly two of the three
+    budget = ca.total_nbytes() + cb.total_nbytes() + 64
+    plane = plane_factory(hbm_budget=budget)
+    plane.start()
+    plane.admit("a", a, _sample(24))
+    plane.admit("b", b, _sample(24))
+    for _ in range(4):  # give b observed QPS (a stays idle: value 0)
+        plane.predict("b", bX[:4])
+    plane.admit("c", c, _sample(24))
+    state = plane.state()
+    names = sorted(m["name"] for m in state["models"])
+    assert "c" in names and "b" in names and "a" not in names
+    assert state["evicted"] == ["a"]
+    assert state["hbm_charged_bytes"] <= budget
+
+
+def test_refused_admission_leaves_existing_models_serving(plane_factory):
+    fitted, X, _ = _make_fitted(24, 3, seed=5)
+    charge = model_charge(fitted, _sample(24), 16)
+    plane = plane_factory(hbm_budget=charge.total_nbytes() + 64)
+    plane.start()
+    plane.admit("only", fitted, _sample(24))
+    big, _, _ = _make_fitted(256, 32, seed=6)
+    with pytest.raises(AdmissionError):
+        plane.admit("big", big, _sample(256))
+    out = plane.predict("only", X[:3])
+    assert out.shape == (3, 3)
+
+
+def test_unpicklable_pipeline_admission_names_the_constraint(
+        plane_factory):
+    """A lambda-bearing pipeline cannot round-trip through the
+    canonical pickle; admission must say WHY instead of leaking a raw
+    PicklingError (found by the verify drive)."""
+    from keystone_tpu.workflow.transformer import transformer
+
+    fitted, X, _ = _make_fitted(16, 3, seed=6)
+    pipe = transformer(lambda x: x * 2.0).to_pipeline().and_then(
+        fitted.to_pipeline())
+    plane = plane_factory()
+    with pytest.raises(TypeError, match="not picklable"):
+        plane.admit("bad", pipe, _sample(16))
+
+
+# -- quantized predict --------------------------------------------------------
+
+def test_default_weight_dtype_quantizes_and_round_trips(plane_factory):
+    fitted, X, _ = _make_fitted(32, 4, seed=7)
+    plane = plane_factory(default_weight_dtype="bf16")
+    plane.start()
+    entry = plane.admit("q", fitted, _sample(32))
+    assert entry.weight_dtype == "bf16"
+    quantized = plane.predict("q", X[:8])
+    f32 = fitted.apply(ArrayDataset.from_numpy(X[:8])).numpy()
+    # bf16 weights: close but not equal to the f32 path
+    np.testing.assert_allclose(quantized, f32, rtol=0.05, atol=0.05)
+    plane.evict("q")
+    plane.readmit("q")
+    assert np.array_equal(quantized, plane.predict("q", X[:8])), (
+        "re-quantization after readmission must be deterministic")
+
+
+def test_explicit_model_weight_dtype_wins_over_plane_default(
+        plane_factory):
+    fitted, X, _ = _make_fitted(32, 4, seed=8, weight_dtype="int8")
+    plane = plane_factory(default_weight_dtype="bf16")
+    plane.start()
+    entry = plane.admit("m", fitted, _sample(32))
+    ops = [entry.fitted.graph.get_operator(n)
+           for n in entry.fitted.graph.nodes]
+    dtypes = {getattr(op, "weight_dtype", None) for op in ops
+              if hasattr(op, "weight_dtype")}
+    assert dtypes == {"int8"}  # the fit-time choice survives admission
+
+
+# -- micro-batcher ------------------------------------------------------------
+
+def test_batcher_coalesces_same_model_fifo_for_others():
+    batcher = MicroBatcher(queue_depth=16)
+    futs = [batcher.submit("a", np.zeros((2, 4)), 2) for _ in range(3)]
+    batcher.submit("b", np.zeros((1, 4)), 1)
+    batcher.submit("a", np.zeros((2, 4)), 2)
+    batch = batcher.take(max_rows=16)
+    # oldest request's model wins; later same-model requests coalesce
+    # around the interleaved b, which keeps its FIFO position
+    assert [r.model for r in batch] == ["a"] * 4
+    assert sum(r.n for r in batch) == 8
+    nxt = batcher.take(max_rows=16)
+    assert [r.model for r in nxt] == ["b"]
+    batcher.done(len(batch) + len(nxt))
+    assert len(futs) == 3  # futures are per-request handles
+
+
+def test_batcher_respects_bucket_ceiling():
+    batcher = MicroBatcher(queue_depth=16)
+    for _ in range(5):
+        batcher.submit("a", np.zeros((3, 2)), 3)
+    batch = batcher.take(max_rows=8)
+    assert sum(r.n for r in batch) <= 8 and len(batch) == 2
+    assert batcher.depth() == 3  # overflow kept, FIFO intact
+    batcher.done(len(batch))
+
+
+def test_batcher_slot_gate_bounds_queue_and_rejects_fast():
+    batcher = MicroBatcher(queue_depth=2, submit_timeout_s=0.05)
+    reg = MetricsRegistry.get_or_create()
+    rejected0 = reg.counter("serving.rejected_total").value
+    batcher.submit("a", np.zeros((1, 2)), 1)
+    batcher.submit("a", np.zeros((1, 2)), 1)
+    with pytest.raises(QueueFullError):
+        batcher.submit("a", np.zeros((1, 2)), 1)
+    assert reg.counter("serving.rejected_total").value == rejected0 + 1
+    taken = batcher.take(max_rows=8)
+    batcher.done(len(taken))  # slots freed -> submit admits again
+    batcher.submit("a", np.zeros((1, 2)), 1)
+
+
+def test_batcher_close_drains_and_refuses():
+    batcher = MicroBatcher(queue_depth=4)
+    fut = batcher.submit("a", np.zeros((1, 2)), 1)
+    drained = batcher.close()
+    assert [r.future for r in drained] == [fut]
+    with pytest.raises(RuntimeError, match="closed"):
+        batcher.submit("a", np.zeros((1, 2)), 1)
+
+
+def test_concurrent_submits_coalesce_into_batches(plane_factory):
+    """Real threads + the worker: concurrent requests for one model
+    coalesce (batches < requests) and every future resolves to its own
+    rows."""
+    fitted, X, _ = _make_fitted(24, 3, seed=11)
+    plane = plane_factory(queue_depth=64)
+    plane.start()
+    plane.admit("m", fitted, _sample(24))
+    reg = MetricsRegistry.get_or_create()
+    req0 = reg.counter("serving.requests_total").value
+    batch0 = reg.counter("serving.batches_total").value
+    results = {}
+    errors = []
+
+    def client(i):
+        try:
+            results[i] = plane.predict("m", X[i:i + 2])
+        except Exception as exc:  # noqa: BLE001 - recorded for assert
+            errors.append(exc)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+    for i, out in results.items():
+        ref = fitted.apply(ArrayDataset.from_numpy(X[i:i + 2])).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+    served = reg.counter("serving.requests_total").value - req0
+    batches = reg.counter("serving.batches_total").value - batch0
+    assert served == 12
+    assert batches <= served  # coalescing can only shrink the count
+    assert reg.histogram("serving.batch_fill.m").count >= 1
+    assert reg.histogram("serving.request_ms.m").count >= 12
+
+
+# -- scripted admission-vs-eviction interleaving (tests/sched.py) ------------
+
+def _interleaving_invariants(plane, budget):
+    state = plane.state()
+    assert state["hbm_charged_bytes"] <= budget
+    assert "a" in state["evicted"]
+    names = sorted(m["name"] for m in state["models"])
+    assert "c" in names and "b" in names and "a" not in names
+
+
+@pytest.mark.parametrize("schedule", [
+    {"picks": ["admit-c", "evict-a", "admit-c", "evict-a"] * 40},
+    {"seed": 0}, {"seed": 1}, {"seed": 2}, {"seed": 3}, {"seed": 4},
+])
+def test_admission_vs_eviction_interleaving(schedule, plane_factory):
+    """An admission that must evict `a` races an explicit evict of
+    `a`: under scripted AND seeded schedules on the real TracedLock
+    yield points, exactly one eviction wins (the loser sees
+    ModelNotAdmitted), the ledger never exceeds the budget, and the
+    plane converges to {b, c} resident with `a` evicted once."""
+    from tests.sched import DeterministicScheduler
+
+    a, _, _ = _make_fitted(24, 3, seed=1)
+    b, _, _ = _make_fitted(24, 3, seed=2)
+    c, _, _ = _make_fitted(24, 3, seed=3)
+    ca = model_charge(a, _sample(24), 16)
+    cb = model_charge(b, _sample(24), 16)
+    budget = ca.total_nbytes() + cb.total_nbytes() + 64
+    plane = plane_factory(hbm_budget=budget, steady_fence=False)
+    plane.admit("a", a, _sample(24))
+    plane.admit("b", b, _sample(24))
+    # touch b so LRU-with-cost prefers evicting the idle a
+    plane.start()
+    outcomes = {}
+
+    def admit_c():
+        plane.admit("c", c, _sample(24))
+
+    def evict_a():
+        try:
+            plane.evict("a")
+            outcomes["explicit-evict"] = "won"
+        except ModelNotAdmitted:
+            outcomes["explicit-evict"] = "lost"
+
+    sched = DeterministicScheduler(**({"picks": schedule["picks"]}
+                                      if "picks" in schedule
+                                      else {"seed": schedule["seed"]}))
+    sched.spawn(admit_c, name="admit-c")
+    sched.spawn(evict_a, name="evict-a")
+    with sched:
+        sched.run()
+    assert outcomes["explicit-evict"] in ("won", "lost")
+    _interleaving_invariants(plane, budget)
+    reg = MetricsRegistry.get_or_create()
+    # exactly one eviction of `a` happened, whichever thread won
+    assert reg.counter("serving.evictions_total").value >= 1
+
+
+# -- readiness ----------------------------------------------------------------
+
+def test_drift_scoring_is_warm_on_every_bucket(plane_factory):
+    """Drift scoring compiles per (bucket, d) shape like the apply
+    programs: a drift-enabled model serving a request that lands in a
+    LARGER bucket than the smallest must not compile under the armed
+    fence (review finding)."""
+    from keystone_tpu.parallel.streaming import (
+        StreamingDataset,
+        fit_streaming,
+    )
+
+    r = np.random.RandomState(1)
+    X = r.rand(128, 24).astype(np.float32)
+    Y = r.rand(128, 3).astype(np.float32)
+    stream = StreamingDataset.from_numpy(X, chunk_size=32,
+                                         tag="serve-drift-buckets")
+    model = fit_streaming(LinearMapEstimator(lam=1e-3), stream, Y)
+    plane = plane_factory(drift_every=1)  # max_batch=16: buckets 8, 16
+    plane.start()
+    plane.admit("m", model, _sample(24))
+    u0 = plane.unexpected_recompiles()
+    plane.predict("m", X[:10])  # lands in bucket 16, not buckets[0]=8
+    deadline = time.monotonic() + 10.0
+    reg = MetricsRegistry.get_or_create()
+    while time.monotonic() < deadline:  # wait for the async scoring
+        if reg.snapshot()["gauges"].get("numerics.drift_score") \
+                is not None:
+            break
+        time.sleep(0.02)
+    assert plane.unexpected_recompiles() - u0 == 0
+
+
+def test_startup_eviction_does_not_wedge_readiness(plane_factory):
+    """expect_models counts COMPLETED admissions, not residents: a
+    startup admission that evicts an earlier expected model must not
+    leave /healthz at 503 forever (review finding)."""
+    a, _, _ = _make_fitted(24, 3, seed=1)
+    b, _, _ = _make_fitted(24, 3, seed=2)
+    ca = model_charge(a, _sample(24), 16)
+    plane = plane_factory(hbm_budget=ca.total_nbytes() + 64)
+    plane.expect_models(2)
+    plane.admit("a", a, _sample(24))
+    assert not plane.ready()
+    plane.admit("b", b, _sample(24))  # evicts a: only room for one
+    state = plane.state()
+    assert [m["name"] for m in state["models"]] == ["b"]
+    assert state["evicted"] == ["a"]
+    assert plane.ready(), (
+        "both expected admissions completed their warmups — readiness "
+        "must not require the evicted model to still be resident")
+
+
+def test_ready_waits_for_expected_admissions(plane_factory):
+    fitted, _, _ = _make_fitted(24, 3, seed=4)
+    plane = plane_factory()
+    plane.expect_models(2)
+    assert not plane.ready()
+    plane.admit("one", fitted, _sample(24))
+    assert not plane.ready()  # one of two expected
+    fitted2, _, _ = _make_fitted(24, 4, seed=5)
+    plane.admit("two", fitted2, _sample(24))
+    assert plane.ready()
+
+
+def test_serve_metrics_ready_probe_gates_healthz():
+    from keystone_tpu.observability.sampler import serve_metrics
+
+    ready = {"v": False}
+    server = serve_metrics(port=0, ready_probe=lambda: ready["v"])
+    try:
+        url = f"http://127.0.0.1:{server.server_port}/healthz"
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(url)
+        assert exc.value.code == 503
+        assert exc.value.read() == b"warming\n"
+        ready["v"] = True
+        with urllib.request.urlopen(url) as rsp:
+            assert rsp.status == 200
+    finally:
+        server.shutdown()
+
+
+def test_serve_metrics_without_probe_keeps_liveness_semantics():
+    from keystone_tpu.observability.sampler import serve_metrics
+
+    server = serve_metrics(port=0)
+    try:
+        url = f"http://127.0.0.1:{server.server_port}/healthz"
+        with urllib.request.urlopen(url) as rsp:
+            assert rsp.status == 200
+    finally:
+        server.shutdown()
+
+
+def test_serve_metrics_raising_probe_fails_closed():
+    from keystone_tpu.observability.sampler import serve_metrics
+
+    def broken():
+        raise RuntimeError("probe exploded")
+
+    server = serve_metrics(port=0, ready_probe=broken)
+    try:
+        url = f"http://127.0.0.1:{server.server_port}/healthz"
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(url)
+        assert exc.value.code == 503
+    finally:
+        server.shutdown()
+
+
+# -- HTTP data plane ----------------------------------------------------------
+
+def test_http_predict_two_models_and_error_statuses(plane_factory):
+    from keystone_tpu.serving.http import serve
+
+    f1, X1, _ = _make_fitted(24, 3, seed=1)
+    f2, X2, _ = _make_fitted(32, 4, seed=2)
+    plane = plane_factory(queue_depth=32)
+    plane.start()
+    plane.admit("alpha", f1, _sample(24))
+    plane.admit("beta", f2, _sample(32))
+    server = serve(plane)
+    base = f"http://127.0.0.1:{server.server_port}"
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            base + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as rsp:
+            return rsp.status, json.loads(rsp.read())
+
+    try:
+        for name, X, fitted in (("alpha", X1, f1), ("beta", X2, f2)):
+            status, out = post(f"/predict/{name}",
+                               {"instances": X[:3].tolist()})
+            assert status == 200 and out["rows"] == 3
+            ref = fitted.apply(ArrayDataset.from_numpy(X[:3])).numpy()
+            np.testing.assert_allclose(
+                np.asarray(out["predictions"]), ref, rtol=1e-5,
+                atol=1e-5)
+        # bare-array body works too
+        status, out = post("/predict/alpha", X1[:2].tolist())
+        assert status == 200 and out["rows"] == 2
+        with urllib.request.urlopen(base + "/models") as rsp:
+            state = json.loads(rsp.read())
+        assert sorted(m["name"] for m in state["models"]) == \
+            ["alpha", "beta"]
+        for path, payload, expect in (
+                ("/predict/ghost", {"instances": [[0.0] * 24]}, 404),
+                ("/predict/alpha", {"instances": []}, 400),
+                ("/predict/alpha", {"instances": [[0.0] * 7]}, 400)):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                post(path, payload)
+            assert exc.value.code == expect
+    finally:
+        server.shutdown()
+
+
+# -- drift wiring -------------------------------------------------------------
+
+def test_serving_scores_drift_against_fit_baseline(plane_factory):
+    """A model fitted through the streamed path carries its fit-time
+    sketch; serving scores live inputs every ``drift_every`` batches —
+    shifted traffic raises ``numerics.drift_score`` and fires the
+    drift_warn event, while the scoring programs compile during warmup
+    (the steady-state fence stays clean)."""
+    from keystone_tpu.parallel.streaming import (
+        StreamingDataset,
+        fit_streaming,
+    )
+
+    r = np.random.RandomState(0)
+    X = r.rand(128, 24).astype(np.float32)
+    Y = r.rand(128, 3).astype(np.float32)
+    stream = StreamingDataset.from_numpy(X, chunk_size=32,
+                                         tag="serve-drift")
+    model = fit_streaming(LinearMapEstimator(lam=1e-3), stream, Y)
+    assert getattr(model, "numerics_baseline", None) is not None
+    plane = plane_factory(drift_every=1)
+    plane.start()
+    plane.admit("m", model, _sample(24))
+    u0 = plane.unexpected_recompiles()
+    plane.predict("m", X[:6] + 3.0)  # shifted: must register as drift
+    reg = MetricsRegistry.get_or_create()
+    deadline = time.monotonic() + 10.0
+    score = None
+    while time.monotonic() < deadline:  # scoring is post-reply, async
+        score = reg.snapshot()["gauges"].get("numerics.drift_score")
+        if score is not None:
+            break
+        time.sleep(0.02)
+    assert score is not None and score > 0.2
+    assert reg.counter("numerics.drift_warn").value >= 1
+    plane.predict("m", X[:6])
+    assert plane.unexpected_recompiles() - u0 == 0, (
+        "drift scoring must compile during warmup, not steady state")
+
+
+# -- residency planner shares the auto-cache greedy ---------------------------
+
+def test_greedy_select_maximizes_value_under_budget():
+    from keystone_tpu.workflow.optimizer.auto_cache import greedy_select
+
+    mem = {"a": 4.0, "b": 4.0, "c": 4.0}
+    value = {"a": 10.0, "b": 6.0, "c": 1.0}
+
+    def candidates(selected, space_left):
+        return [n for n in mem if n not in selected
+                and mem[n] < space_left]
+
+    keep = greedy_select(
+        (), candidates, mem.get,
+        lambda sel: -sum(value[n] for n in sel), budget=9.0)
+    assert keep == frozenset({"a", "b"})
+    # empty-budget edge: nothing fits, nothing selected
+    assert greedy_select((), candidates, mem.get,
+                         lambda sel: 0.0, budget=0.0) == frozenset()

@@ -1,0 +1,206 @@
+#!/usr/bin/env python3
+"""CI smoke gate for the serving plane (``bin/ci.sh``).
+
+End-to-end, out of process — the exact deployment shape:
+
+1. fit two small pipelines, save them with ``utils.checkpoint.
+   save_pipeline`` (the artifact format ``serve`` loads);
+2. start ``python -m keystone_tpu serve`` as a SUBPROCESS on an
+   ephemeral port (the server binds before admitting, so ``/healthz``
+   observably reports 503 warming during the warmup compiles);
+3. wait for readiness (``/healthz`` 200) with a hard timeout — a hung
+   warmup fails the gate, not the CI wall clock;
+4. drive requests across >= 2 request shapes (different buckets) and
+   BOTH models, checking response shapes;
+5. scrape ``/metrics`` and assert ``keystone_compile_unexpected_total``
+   is 0 — the server arms the warmup fence after admission, so ANY
+   steady-state recompile shows up here — and that the serving
+   counters saw the traffic.
+
+Exit 0 clean; exit 1 with a named reason otherwise.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+READY_TIMEOUT_S = 240.0
+DIMS = {"alpha": (24, 3), "beta": (32, 4)}
+
+
+def _fail(proc, reason: str) -> int:
+    print(f"serving gate: FAIL: {reason}", file=sys.stderr)
+    if proc is not None:
+        proc.terminate()
+        try:
+            out = proc.stdout.read() if proc.stdout else ""
+        except Exception:
+            out = ""
+        if out:
+            print(f"server output:\n{out}", file=sys.stderr)
+    return 1
+
+
+def _get(url: str, timeout: float = 5.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as rsp:
+            return rsp.status, rsp.read()
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read()
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from keystone_tpu.nodes.learning.linear import LinearMapEstimator
+    from keystone_tpu.parallel.dataset import ArrayDataset
+    from keystone_tpu.utils.checkpoint import save_pipeline
+
+    tmp = tempfile.mkdtemp(prefix="keystone-serving-gate-")
+    specs = []
+    for name, (d, k) in DIMS.items():
+        r = np.random.RandomState(d)
+        X = r.rand(96, d).astype(np.float32)
+        Y = r.rand(96, k).astype(np.float32)
+        fitted = LinearMapEstimator(lam=1e-3).with_data(
+            ArrayDataset.from_numpy(X), ArrayDataset.from_numpy(Y)).fit()
+        path = os.path.join(tmp, f"{name}.pkl")
+        save_pipeline(fitted, path)
+        specs.append(f"{name}={path}@{d}:float32")
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "keystone_tpu", "serve", *specs,
+         "--port", "0", "--hbm-budget", "64MiB", "--max-batch", "16",
+         "--weight-dtype", "bf16"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=REPO, env=env)
+    try:
+        # 1. the bind line prints BEFORE admission. readline() alone
+        # would block past the deadline if the server wedges before
+        # its first line (jax init hang), so the wait is select-gated:
+        # the hard timeout holds from the first byte, not the second.
+        import select
+
+        deadline = time.monotonic() + READY_TIMEOUT_S
+        port = None
+        while time.monotonic() < deadline:
+            readable, _, _ = select.select(
+                [proc.stdout], [], [],
+                max(0.0, min(1.0, deadline - time.monotonic())))
+            if not readable:
+                if proc.poll() is not None:
+                    return _fail(proc, "server exited before binding")
+                continue
+            line = proc.stdout.readline()
+            if not line:
+                return _fail(proc, "server exited before binding")
+            print(f"  server: {line.rstrip()}")
+            if line.startswith("serving on "):
+                port = int(line.rsplit(":", 1)[1])
+                break
+        if port is None:
+            return _fail(proc, "no 'serving on' line before timeout")
+        base = f"http://127.0.0.1:{port}"
+
+        # 2. /healthz is a REAL readiness gate: poll until 200, with
+        # the not-ready phase (503 warming) logged when observed
+        saw_warming = False
+        while True:
+            if time.monotonic() > deadline:
+                return _fail(
+                    proc, f"/healthz not ready in {READY_TIMEOUT_S:.0f}s")
+            try:
+                status, body = _get(base + "/healthz", timeout=2.0)
+            except (urllib.error.URLError, OSError):
+                time.sleep(0.2)
+                continue
+            if status == 503:
+                saw_warming = True
+                time.sleep(0.2)
+                continue
+            if status == 200:
+                break
+            return _fail(proc, f"/healthz returned {status}")
+        print(f"serving gate: ready on port {port} "
+              f"(warming observed: {saw_warming})")
+
+        # 3. drive both models across >= 2 request shapes (buckets)
+        sent = 0
+        for name, (d, k) in DIMS.items():
+            for n in (1, 3, 7, 11):  # buckets 8 and 16 on the sim mesh
+                payload = json.dumps(
+                    {"instances": [[0.5] * d] * n}).encode()
+                req = urllib.request.Request(
+                    f"{base}/predict/{name}", data=payload,
+                    headers={"Content-Type": "application/json"})
+                for _ in range(3):
+                    with urllib.request.urlopen(req, timeout=30) as rsp:
+                        out = json.loads(rsp.read())
+                    preds = out.get("predictions")
+                    if (out.get("rows") != n or len(preds) != n
+                            or len(preds[0]) != k):
+                        return _fail(
+                            proc, f"bad predict response for {name} "
+                                  f"n={n}: rows={out.get('rows')}")
+                    sent += 1
+        print(f"serving gate: {sent} requests served across "
+              f"{len(DIMS)} models and 2 buckets")
+
+        # 4. the fence verdict: zero steady-state recompiles
+        status, body = _get(base + "/metrics")
+        if status != 200:
+            return _fail(proc, f"/metrics returned {status}")
+        metrics = {}
+        for line in body.decode().splitlines():
+            if line.startswith("#") or " " not in line:
+                continue
+            key, value = line.rsplit(" ", 1)
+            try:
+                metrics[key] = float(value)
+            except ValueError:
+                continue
+        # counters gain a "_total" suffix in the exposition
+        # (metrics.to_prometheus), so the dotted catalogue name
+        # compile.unexpected_total scrapes as ..._total_total
+        unexpected = metrics.get(
+            "keystone_compile_unexpected_total_total", 0.0)
+        if unexpected:
+            return _fail(
+                proc, f"{unexpected:.0f} fenced steady-state "
+                      "recompile(s) — pad-to-bucket warmup missed a "
+                      "program")
+        served = metrics.get("keystone_serving_requests_total_total", 0.0)
+        if served < sent:
+            return _fail(
+                proc, f"serving.requests_total={served:.0f} < "
+                      f"{sent} requests the gate sent")
+        print(f"serving gate: PASS (requests={served:.0f}, "
+              "unexpected recompiles=0)")
+        return 0
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
