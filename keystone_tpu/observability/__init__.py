@@ -50,6 +50,19 @@ PR 9 added the hardware denominator:
   ``cost_analysis()``/``memory_analysis()`` against a per-device-kind
   peak catalogue (``*_mfu`` / ``*_membw_util`` bench keys).
 
+PR 16 added the request-path plane for the serving era:
+
+* :mod:`.reqtrace` — per-request span trees through the micro-batcher:
+  a process-unique trace id minted at submit, phase timestamps at each
+  lifecycle edge (queue_wait / coalesce / dispatch / respond, summing
+  exactly to ``serving.request_ms``), Chrome-trace flow links from
+  request spans into their coalesced batch span, and the bounded
+  slowest-N exemplar reservoir behind ``GET /debug/slow``.
+* :mod:`.slo` — error-budget accounting: :class:`SloPolicy` evaluated
+  over rolling per-model windows, availability / burn-rate gauges, and
+  one post-mortem per violated window (model + window + exemplar span
+  trees embedded).
+
 PR 10 added the third plane — the NUMBERS, not the machine:
 
 * :mod:`.numerics` — on-device tensor-health words (finite/NaN/Inf
@@ -81,7 +94,16 @@ from .numerics import (
     score_drift,
 )
 from .postmortem import attach_postmortem, dump_postmortem
+from .reqtrace import (
+    ExemplarReservoir,
+    ReqTrace,
+    exemplar_reservoir,
+    reset_exemplars,
+    tracing_active,
+    tracing_suppressed,
+)
 from .sampler import TelemetrySampler, serve_metrics
+from .slo import SloPolicy, SloTracker, SloViolation, record_slo_event
 from .timeline import (
     FlightRecorder,
     flight_recorder,
@@ -120,6 +142,16 @@ __all__ = [
     "observed_jit",
     "reset_compile_observatory",
     "watch_jit",
+    "ExemplarReservoir",
+    "ReqTrace",
+    "exemplar_reservoir",
+    "reset_exemplars",
+    "tracing_active",
+    "tracing_suppressed",
+    "SloPolicy",
+    "SloTracker",
+    "SloViolation",
+    "record_slo_event",
     "DriftBaseline",
     "NumericsError",
     "health_word",

@@ -69,18 +69,25 @@ BAND_MARGIN = 1.5
 #: the serving plane's tail-latency lines (``serve_p50_ms``,
 #: ``serve_p99_ms``): a p99 that RISES is the regression, the PR 9
 #: ``_bytes`` lesson applied BEFORE the first serving bench round ever
-#: records a baseline.
+#: records a baseline. PR 16 adds ``_share`` (phase shares of the
+#: request wall — a growing queue_wait share is the tail getting
+#: worse) and ``burn_rate`` (error budget spent faster), both landed
+#: before their first BENCH round.
 _LOWER_BETTER_MARKERS = ("error", "stall", "_ms", "_p99", "_latency",
                          "_bytes", "_nan_total", "_breakdown_total",
-                         "drift_score", "overhead_share")
+                         "drift_score", "overhead_share", "_share",
+                         "burn_rate")
 
 #: markers that force "higher is better" and WIN over any lower-better
 #: marker in the same name: throughput lines like ``serve_qps_per_chip``
 #: must never flip direction because some other substring (a future
 #: ``p99_bounded_qps``-style name, an error-rate companion key) happens
 #: to match the lower-better list — a direction flip silently blesses a
-#: throughput collapse as an "improvement"
-_HIGHER_BETTER_MARKERS = ("_qps",)
+#: throughput collapse as an "improvement". ``_fill`` (batch fill, a
+#: utilization fraction) and ``availability`` (good-request fraction;
+#: wins over the ``burn_rate``-style lower-better names should a
+#: future key carry both) joined in PR 16.
+_HIGHER_BETTER_MARKERS = ("_qps", "_fill", "availability")
 
 #: metrics banded in ABSOLUTE units (plain difference, not
 #: percent-of-base): signed shares that hover at ~0, where a relative

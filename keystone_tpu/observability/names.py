@@ -107,6 +107,17 @@ METRIC_NAMES: FrozenSet[str] = frozenset({
                                  # models; per-model family below)
     "serving.warmup_s",          # histogram: per-admission warmup wall
                                  # (every bucket compiled, fence-clean)
+    # keystone_tpu/observability/slo.py — the request-path SLO plane
+    # (PR 16): rolling-window error-budget accounting over the serving
+    # traffic; the serving gate and the /slo endpoint read these back
+    "serving.availability",      # gauge: aggregate rolling good-request
+                                 # fraction (per-model family below)
+    "serving.error_budget_burn_rate",  # gauge: bad fraction over the
+                                 # allowed bad fraction (1.0 = exactly
+                                 # on target; per-model family below)
+    "serving.slo_violations_total",  # counter: windows that crossed the
+                                 # availability target (one post-mortem
+                                 # each)
 })
 
 #: catalogued name FAMILIES: a dynamic metric name must start with one
@@ -118,11 +129,21 @@ METRIC_PREFIXES: Tuple[str, ...] = (
     "numerics.",     # observability/numerics.py: one counter per
                      # numerics event kind (record_numerics_event)
     # serving/plane.py: the per-MODEL latency/fill families
-    # (f"serving.request_ms.{model}"). Deliberately the two narrow
+    # (f"serving.request_ms.{model}"). Deliberately the narrow
     # families rather than a blanket "serving." prefix — a typo'd
     # literal serving counter name must still fail the drift lint.
     "serving.request_ms.",
     "serving.batch_fill.",
+    # the request-path plane (PR 16), same narrow-family rule:
+    "serving.phase_ms.",         # tail attribution histograms —
+                                 # f"serving.phase_ms.{phase}" aggregate
+                                 # and f"...{phase}.{model}" per model
+    "serving.rejected_total.",   # per-model 429 accounting (a rejection
+                                 # storm names its model)
+    "serving.availability.",     # per-model rolling availability gauges
+    "serving.error_budget_burn_rate.",  # per-model burn-rate gauges
+    "slo.",                      # observability/slo.py: one counter per
+                                 # SLO event kind (record_slo_event)
 )
 
 
@@ -153,6 +174,16 @@ BENCH_METRIC_NAMES: FrozenSet[str] = frozenset({
     "serve_qps_per_chip",
     "serve_p50_ms",
     "serve_p99_ms",
+    # the request-path plane (PR 16): where the serving tail lives
+    # (phase totals over request-ms totals), the rolling availability
+    # the SLO tracker observed over the bench window, and the measured
+    # always-on cost of the plane itself (interleaved A/B pairs,
+    # tracing on vs suppressed — banded absolutely like
+    # numerics_overhead_share via the shared "overhead_share" marker)
+    "serve_queue_wait_share",
+    "serve_dispatch_share",
+    "serve_availability",
+    "serving_trace_overhead_share",
 })
 
 

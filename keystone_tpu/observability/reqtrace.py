@@ -1,0 +1,324 @@
+"""Per-request causal tracing for the serving plane (the Dapper shape).
+
+PR 15's serving telemetry stops at one histogram: ``serving.request_ms``
+is enqueue -> done, so a moving p99 names no culprit — exactly the
+blindness "The Tail at Scale" (Dean & Barroso) warns dominates at
+scale. This module is the request-path fix, scaled to this repo:
+
+* :func:`mint_trace_id` — a process-unique trace id minted at
+  ``MicroBatcher.submit`` and carried on the ``Request`` dataclass
+  across the worker-thread hop (the HTTP surface echoes it back as the
+  ``X-Keystone-Trace`` response header, so a slow client request can be
+  joined to its server-side span tree).
+* :class:`ReqTrace` — absolute ``perf_counter`` timestamps stamped at
+  each lifecycle edge (enqueue -> taken -> dispatch -> device done ->
+  respond). Phases are DIFFERENCES of those stamps, so they telescope:
+  ``queue_wait + coalesce + dispatch + respond == request_ms`` exactly
+  (float arithmetic is the only epsilon) — the reconciliation invariant
+  ``tests/test_reqtrace.py`` pins, and what makes "where does p99
+  live" a scrape (``serving.phase_ms.<phase>``) instead of a guess.
+* :class:`ExemplarReservoir` — a bounded per-model reservoir of the
+  SLOWEST-N completed traces (``GET /debug/slow``, and the evidence an
+  SLO post-mortem embeds). Bounded by construction: a long-lived plane
+  holds at most ``cap`` traces per model, ever.
+* :func:`tracing_suppressed` — the runtime off-gate (the
+  ``numerics_suppressed`` depth-counter shape): the serving bench's
+  interleaved A/B overhead pairs run their OFF leg under it, so the
+  measured ``serving_trace_overhead_share`` is purely this plane's
+  stamps + spans + reservoir offers. ``KEYSTONE_REQTRACE=0`` disables
+  the plane process-wide.
+
+Span linkage: the worker records one ``request:<id>`` span per member
+and one ``batch:<model>`` span per executed micro-batch; the request
+spans carry ``flow_out`` ids and the batch span the matching
+``flow_in`` list, which ``timeline.to_chrome_trace`` exports as Chrome
+trace flow events — Perfetto draws each request's causal path through
+the coalesced batch it rode.
+
+Thread model: handler threads mint traces; ONE worker stamps the later
+edges (no stamp is written from two threads). The reservoir is shared
+across flusher/scrape threads — ``_by_model`` is guarded by a
+plain ``threading.Lock`` (offers ride the deferred-telemetry thunks
+and run at recorder flush points; nothing here blocks under the lock).
+"""
+from __future__ import annotations
+
+import bisect
+import contextlib
+import itertools
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from ..utils.guarded import guarded_by
+
+#: the phase vocabulary, in lifecycle order (``drift_score`` is a
+#: BATCH-level phase scored after futures resolve — deliberately outside
+#: the per-request sum, which is why it is not listed here)
+PHASES: Tuple[str, ...] = ("queue_wait", "coalesce", "dispatch", "respond")
+
+# ``next()`` on an itertools.count is a single C call, atomic under the
+# GIL — the mint runs per request on the serving hot path, so it must
+# not take a lock
+_SEQ = itertools.count(1)
+_PID_HEX = "%x" % os.getpid()
+
+_SUPPRESS_DEPTH = 0
+
+
+def mint_flow_id() -> int:
+    """A process-unique monotone integer (Chrome trace flow-event
+    ids, batch ids)."""
+    return next(_SEQ)
+
+
+def mint_trace_id(prefix: str = "req") -> str:
+    """A process-unique trace id: ``<prefix>-<pid hex>-<seq hex>``.
+    The pid makes ids from different serving processes (the CI gate's
+    subprocess server vs its own) visibly distinct."""
+    return f"{prefix}-{_PID_HEX}-{next(_SEQ):x}"
+
+
+# ``os.environ.get`` on an UNSET key (the common case here) raises and
+# catches a KeyError inside the Mapping machinery — ~1.5us, per
+# request, on the submit path. Probing the backing dict with the
+# pre-encoded key is a plain dict.get (~0.05us) and stays LIVE:
+# ``monkeypatch.setenv`` writes through ``os.environ.__setitem__`` into
+# the same ``_data`` dict (pinned by the env-gate test).
+try:
+    _REQTRACE_KEY = os.environ.encodekey("KEYSTONE_REQTRACE")
+    _REQTRACE_OFF = os.environ.encodevalue("0")
+    _ENV_DATA: Any = os.environ._data
+except AttributeError:  # pragma: no cover - exotic os.environ impl
+    _REQTRACE_KEY = _REQTRACE_OFF = None
+    _ENV_DATA = None
+
+
+def tracing_enabled() -> bool:
+    """The process-level switch (``KEYSTONE_REQTRACE=0`` disables the
+    request-path plane entirely — no trace is minted, so the serving
+    path runs the PR 15 shape)."""
+    if _ENV_DATA is not None:
+        return _ENV_DATA.get(_REQTRACE_KEY) != _REQTRACE_OFF
+    return os.environ.get("KEYSTONE_REQTRACE", "1") != "0"
+
+
+def tracing_active() -> bool:
+    """True when request tracing should happen: enabled AND not inside
+    a :func:`tracing_suppressed` block."""
+    return _SUPPRESS_DEPTH == 0 and tracing_enabled()
+
+
+@contextlib.contextmanager
+def tracing_suppressed() -> Iterator[None]:
+    """Suspend request-path tracing (trace minting, phase stamps/
+    histograms, spans, reservoir offers) for the enclosed block without
+    touching any compiled program — the bench A/B overhead pair runs
+    its OFF leg under this."""
+    global _SUPPRESS_DEPTH
+    _SUPPRESS_DEPTH += 1
+    try:
+        yield
+    finally:
+        _SUPPRESS_DEPTH -= 1
+
+
+class ReqTrace:
+    """One request's lifecycle stamps (``time.perf_counter`` seconds).
+
+    Stamp ownership: ``enqueued_s`` is written by the submitting
+    thread at mint time; every later stamp by the ONE plane worker.
+    ``responded_s`` is written BEFORE the request's future resolves, so
+    a trace observed complete (all stamps set) is immutable.
+
+    A ``__slots__`` class, not a dataclass, and ``trace_id`` is a LAZY
+    property over ``flow_id``: one of these is built per request on the
+    serving hot path (the always-on <2% bar, PERFORMANCE.md rule 15),
+    and the id string is only ever read at render time — the response
+    header, ``/debug/slow``, a post-mortem, a span args dict — so the
+    f-string is paid there, not per request."""
+
+    __slots__ = ("flow_id", "model", "n", "enqueued_s", "taken_s",
+                 "dispatch_s", "done_s", "responded_s", "bucket",
+                 "fill", "batch_id")
+
+    def __init__(self, flow_id: int, model: str, n: int,
+                 enqueued_s: float):
+        self.flow_id = flow_id
+        self.model = model
+        self.n = n
+        self.enqueued_s = enqueued_s
+        self.taken_s: Optional[float] = None      # popped by take
+        self.dispatch_s: Optional[float] = None   # device dispatch starts
+        self.done_s: Optional[float] = None       # block_until_ready done
+        self.responded_s: Optional[float] = None  # slice delivered
+        self.bucket: Optional[int] = None         # padded rows of batch
+        self.fill: Optional[float] = None         # true rows / bucket rows
+        self.batch_id: Optional[int] = None       # links batch members
+
+    @property
+    def trace_id(self) -> str:
+        return f"req-{_PID_HEX}-{self.flow_id:x}"
+
+    @classmethod
+    def new(cls, model: str, n: int) -> "ReqTrace":
+        return cls(next(_SEQ), model, int(n), time.perf_counter())
+
+    def complete(self) -> bool:
+        return (self.responded_s is not None
+                and self.done_s is not None
+                and self.dispatch_s is not None
+                and self.taken_s is not None)
+
+    def request_ms(self) -> Optional[float]:
+        if self.responded_s is None:
+            return None
+        return (self.responded_s - self.enqueued_s) * 1e3
+
+    def phases_ms(self) -> Dict[str, float]:
+        """The four-phase decomposition. Phases are differences of
+        adjacent stamps, so ``sum(phases_ms().values()) ==
+        request_ms()`` exactly (telescoping; the pinned invariant).
+        Empty until the trace is complete."""
+        if not self.complete():
+            return {}
+        return {
+            "queue_wait": (self.taken_s - self.enqueued_s) * 1e3,
+            "coalesce": (self.dispatch_s - self.taken_s) * 1e3,
+            "dispatch": (self.done_s - self.dispatch_s) * 1e3,
+            "respond": (self.responded_s - self.done_s) * 1e3,
+        }
+
+    def tree(self) -> Dict[str, Any]:
+        """The JSON-able span tree: the request node, its phase
+        children, and the batch it rode — the ``/debug/slow`` body and
+        what an SLO post-mortem embeds per exemplar."""
+        return {
+            "trace_id": self.trace_id,
+            "model": self.model,
+            "n": self.n,
+            "request_ms": (None if self.request_ms() is None
+                           else round(self.request_ms(), 4)),
+            "phases_ms": {k: round(v, 4)
+                          for k, v in self.phases_ms().items()},
+            "batch": {
+                "id": self.batch_id,
+                "bucket": self.bucket,
+                "fill": None if self.fill is None else round(self.fill, 4),
+            },
+        }
+
+
+def _env_cap() -> int:
+    raw = os.environ.get("KEYSTONE_EXEMPLARS")
+    if not raw:
+        return 8
+    try:
+        cap = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"KEYSTONE_EXEMPLARS must be an integer, got {raw!r}"
+        ) from None
+    if cap < 1:
+        raise ValueError("KEYSTONE_EXEMPLARS must be >= 1")
+    return cap
+
+
+@guarded_by("_lock", "_by_model")
+class ExemplarReservoir:
+    """Slowest-N completed traces per model (N =
+    ``KEYSTONE_EXEMPLARS``, default 8). Offers are O(cap) — one lock,
+    one scan of a tiny list — and the common refusal is a lock-free
+    dict probe. Memory is bounded by construction — ``cap`` traces
+    per model, independent of traffic."""
+
+    def __init__(self, cap: Optional[int] = None):
+        self.cap = _env_cap() if cap is None else int(cap)
+        if self.cap < 1:
+            raise ValueError("cap must be >= 1")
+        # model -> list of (request_ms, flow_id, trace), ascending by
+        # request_ms (index 0 = the fastest retained = first evicted)
+        self._by_model: Dict[str, List[Tuple[float, int, ReqTrace]]] = {}
+        # model -> admission floor (the fastest retained request_ms)
+        # once the model's list is full. Written only under the lock,
+        # read WITHOUT it by offer's refusal fast path: dict reads are
+        # GIL-atomic, a stale floor only costs one lock round-trip,
+        # and steady state is exactly the case where almost every
+        # offer is slower than nothing retained — so the common path
+        # is a lock-free dict probe. Deliberately outside the
+        # ``@guarded_by`` contract for that reason.
+        self._floor: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def offer(self, trace: ReqTrace) -> bool:
+        """Retain ``trace`` if it is among the slowest ``cap`` seen for
+        its model; returns whether it was kept. The common refusal
+        (full reservoir, faster trace) is decided without taking the
+        lock."""
+        ms = trace.request_ms()
+        if ms is None:
+            return False
+        floor = self._floor.get(trace.model)
+        if floor is not None and ms <= floor:
+            return False
+        key = (float(ms), trace.flow_id, trace)
+        with self._lock:
+            kept = self._by_model.setdefault(trace.model, [])
+            if len(kept) >= self.cap:
+                if ms <= kept[0][0]:
+                    return False
+                kept.pop(0)
+            bisect.insort(kept, key)
+            if len(kept) >= self.cap:
+                self._floor[trace.model] = kept[0][0]
+        return True
+
+    def slowest(self, n: int = 8,
+                model: Optional[str] = None) -> List[ReqTrace]:
+        """The slowest ``n`` retained traces (one model, or merged
+        across all), slowest first."""
+        with self._lock:
+            if model is not None:
+                pool = list(self._by_model.get(model, ()))
+            else:
+                pool = [e for kept in self._by_model.values()
+                        for e in kept]
+        pool.sort(key=lambda e: (-e[0], e[1]))
+        return [t for _, _, t in pool[:max(int(n), 0)]]
+
+    def slowest_trees(self, n: int = 8,
+                      model: Optional[str] = None) -> List[Dict[str, Any]]:
+        return [t.tree() for t in self.slowest(n, model=model)]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._by_model = {}
+            self._floor = {}
+
+
+# -- process-global reservoir ------------------------------------------------
+
+_RESERVOIR: Optional[ExemplarReservoir] = None
+_RESERVOIR_LOCK = threading.Lock()
+
+
+def exemplar_reservoir() -> ExemplarReservoir:
+    """The process-global reservoir (lazily built, double-checked —
+    the serving worker offers from its first batch)."""
+    global _RESERVOIR
+    res = _RESERVOIR
+    if res is None:
+        with _RESERVOIR_LOCK:
+            res = _RESERVOIR
+            if res is None:
+                res = _RESERVOIR = ExemplarReservoir()
+    return res
+
+
+def reset_exemplars() -> None:
+    """Drop the global reservoir (tests; the next offer rebuilds it,
+    re-reading ``KEYSTONE_EXEMPLARS``)."""
+    global _RESERVOIR
+    with _RESERVOIR_LOCK:
+        _RESERVOIR = None

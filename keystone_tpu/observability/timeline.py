@@ -52,7 +52,8 @@ import json
 import os
 import threading
 import time
-from typing import Any, Dict, Iterator, List, NamedTuple, Optional
+from collections import deque
+from typing import Any, Deque, Dict, Iterator, List, NamedTuple, Optional
 
 from ..utils.guarded import guarded_by
 
@@ -110,22 +111,64 @@ class FlightRecorder:
         self._idx = 0
         self._total = 0
         self._lock = threading.Lock()  # plain: TracedLock reports in here
+        # span-materialization thunks queued by hot paths (the serving
+        # worker); drained at the next view/export. deque append and
+        # popleft are GIL-atomic, so no lock rides the fast path, and
+        # maxlen bounds memory if no view ever runs.
+        self._deferred: Deque[Any] = deque(maxlen=self.capacity)
         #: perf_counter epoch for chrome-trace timestamps
         self.t0_s = time.perf_counter()
 
     # -- recording ---------------------------------------------------------
     def record(self, name: str, cat: str, start_s: float, dur_s: float,
-               args: Optional[Dict[str, Any]] = None, ph: str = "X") -> None:
-        """Append one span (cheap: thread lookup + lock + two writes)."""
+               args: Optional[Dict[str, Any]] = None, ph: str = "X",
+               tid: Optional[int] = None,
+               thread: Optional[str] = None) -> None:
+        """Append one span (cheap: thread lookup + lock + two writes).
+        ``tid``/``thread`` override the recording thread's identity —
+        deferred materializers pass the identity captured at defer
+        time so spans still land on their originating lane."""
         if not self.enabled:
             return
-        t = threading.current_thread()
+        if tid is None or thread is None:
+            t = threading.current_thread()
+            tid = t.ident or 0 if tid is None else tid
+            thread = t.name if thread is None else thread
         span = Span(name, cat, float(start_s), float(dur_s),
-                    t.ident or 0, t.name, args, ph)
+                    tid, thread, args, ph)
         with self._lock:
             self._ring[self._idx] = span
             self._idx = (self._idx + 1) % self.capacity
             self._total += 1
+
+    def defer(self, materialize: Any) -> None:
+        """Queue a zero-argument thunk that will ``record`` one or more
+        spans when the recorder is next VIEWED (``spans``, export,
+        counters) instead of now. This keeps span construction —
+        f-strings, args dicts, the ring lock — off latency-critical
+        paths: the serving worker queues one thunk per batch between a
+        batch's futures resolving and its next ``take`` (the always-on
+        <2% bar, PERFORMANCE.md rule 15). Thunks must capture immutable
+        data (completed traces) and the originating thread identity."""
+        if self.enabled:
+            self._deferred.append(materialize)
+
+    def flush(self) -> None:
+        """Run queued materializers (oldest first). Every view calls
+        this; the serving worker calls it when idle, the HTTP scrape
+        surface before serializing, so deferred telemetry (spans AND
+        the phase-histogram observes a thunk carries) is visible at
+        every read point. Thunks call ``record``, so this never runs
+        under the ring lock."""
+        while True:
+            try:
+                fn = self._deferred.popleft()
+            except IndexError:
+                return
+            fn()
+
+    # internal alias so views read naturally
+    _drain = flush
 
     def record_instant(self, name: str, cat: str,
                        ts_s: Optional[float] = None,
@@ -150,6 +193,7 @@ class FlightRecorder:
     # -- views -------------------------------------------------------------
     def spans(self) -> List[Span]:
         """Retained spans, oldest first (at most ``capacity``)."""
+        self._drain()
         with self._lock:
             ring = list(self._ring)
             idx = self._idx
@@ -160,15 +204,18 @@ class FlightRecorder:
 
     @property
     def total_recorded(self) -> int:
+        self._drain()
         with self._lock:
             return self._total
 
     def dropped(self) -> int:
         """Spans that fell off the back of the ring."""
+        self._drain()
         with self._lock:
             return max(0, self._total - self.capacity)
 
     def clear(self) -> None:
+        self._deferred.clear()
         with self._lock:
             self._ring = [None] * self.capacity
             self._idx = 0
@@ -184,7 +231,15 @@ class FlightRecorder:
         (nested executor node spans overflow onto ``<thread>
         (nested k)``) — the strictly-non-overlapping-per-lane invariant
         the round-trip test pins, and what keeps the Perfetto render
-        unambiguous. Instants ride lane 0 of their thread."""
+        unambiguous. Instants ride lane 0 of their thread.
+
+        Flow links (PR 16): a span whose args carry ``flow_out`` (one
+        id) emits a flow-start (``ph:"s"``) at its own ts/lane, and a
+        span whose args carry ``flow_in`` (a list of ids) emits one
+        enclosed flow-finish (``ph:"f"``, ``bp:"e"``) per id — Perfetto
+        draws the arrows from each request span into the batch span
+        that served it. Flow events anchor to existing lanes and never
+        affect lane assignment."""
         spans = self.spans()
         events: List[Dict[str, Any]] = []
         # (os thread id, sublane) -> exported integer tid, plus names
@@ -216,13 +271,27 @@ class FlightRecorder:
                 if sub == len(lane_end):
                     lane_end.append(0.0)
                 lane_end[sub] = s.start_s + s.dur_s
+                ts = round((s.start_s - self.t0_s) * 1e6, 3)
+                lid = lane(s.tid, s.thread, sub)
                 events.append({
                     "name": s.name, "cat": s.cat, "ph": "X",
-                    "ts": round((s.start_s - self.t0_s) * 1e6, 3),
-                    "dur": round(s.dur_s * 1e6, 3),
-                    "pid": 1, "tid": lane(s.tid, s.thread, sub),
+                    "ts": ts, "dur": round(s.dur_s * 1e6, 3),
+                    "pid": 1, "tid": lid,
                     "args": s.args or {},
                 })
+                flow_args = s.args or {}
+                if "flow_out" in flow_args:
+                    events.append({
+                        "name": "req", "cat": s.cat, "ph": "s",
+                        "id": int(flow_args["flow_out"]),
+                        "ts": ts, "pid": 1, "tid": lid,
+                    })
+                for fid in flow_args.get("flow_in", ()):
+                    events.append({
+                        "name": "req", "cat": s.cat, "ph": "f",
+                        "bp": "e", "id": int(fid),
+                        "ts": ts, "pid": 1, "tid": lid,
+                    })
             for s in by_thread[tid]:
                 if s.ph != "i":
                     continue

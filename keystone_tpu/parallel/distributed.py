@@ -58,6 +58,7 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..observability.metrics import MetricsRegistry
+from ..observability.reqtrace import mint_flow_id, mint_trace_id
 from ..observability.timeline import record_span
 from ..resilience.faults import HOST_DEATH_EXIT_CODE, inject
 
@@ -113,6 +114,11 @@ class WorldCoordinator:
         self.nproc = process_count()
         self.tag = tag
         self.rounds = 0
+        # one trace id per fit (PR 16): every round span of this
+        # coordinator carries it, so a multi-round distributed fit
+        # greps as one correlated story per host log
+        self.trace_id = mint_trace_id("coord")
+        self._round_flow: Optional[int] = None
         MetricsRegistry.get_or_create().gauge(
             "coord.world_size").set(self.nproc)
 
@@ -135,8 +141,16 @@ class WorldCoordinator:
         reg = MetricsRegistry.get_or_create()
         reg.histogram("coord.barrier_wait_s").observe(wait_s)
         reg.counter("coord.rounds_total").inc()
-        record_span(f"coord:{self.tag}", "coord", t0, wait_s,
-                    args={"round": self.rounds, "cursor": int(cursor)})
+        # flow-chain the rounds: each span finishes the previous
+        # round's flow id and starts a fresh one, so Perfetto draws the
+        # fit as one arrowed chain under the coordinator's trace id
+        flow = mint_flow_id()
+        args: dict = {"round": self.rounds, "cursor": int(cursor),
+                      "trace_id": self.trace_id, "flow_out": flow}
+        if self._round_flow is not None:
+            args["flow_in"] = [self._round_flow]
+        self._round_flow = flow
+        record_span(f"coord:{self.tag}", "coord", t0, wait_s, args=args)
         state = WorldState(
             round=self.rounds,
             cursors=tuple(int(c) for c in gathered[:, 0]),
@@ -155,8 +169,11 @@ class WorldCoordinator:
 
         t0 = time.perf_counter()
         sync_global_devices(f"keystone-{name}")
+        wait_s = time.perf_counter() - t0
         MetricsRegistry.get_or_create().histogram(
-            "coord.barrier_wait_s").observe(time.perf_counter() - t0)
+            "coord.barrier_wait_s").observe(wait_s)
+        record_span(f"barrier:{name}", "coord", t0, wait_s,
+                    args={"trace_id": self.trace_id})
 
     # -- finalize-time reductions ------------------------------------------
     def merge_carries(self, carry: Any,

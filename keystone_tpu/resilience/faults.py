@@ -15,6 +15,12 @@ site                  where
 ``ingest.produce``    per chunk in the prefetch producer loop
 ``coord.step``        per cross-host coordination round
                       (``parallel.distributed.WorldCoordinator.step``)
+``serve.enqueue``     per serving request submit, before the slot gate
+                      (``serving.batcher.MicroBatcher.submit_request``)
+``serve.dispatch``    per micro-batch device dispatch
+                      (``serving.plane.ServingPlane._serve_batch``) —
+                      a ``straggler`` here is the slow-batch tail the
+                      SLO gate trips on
 ====================  =====================================================
 
 ``inject`` is a single global read when no plan is active — zero cost

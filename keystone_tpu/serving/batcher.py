@@ -35,7 +35,9 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Deque, List, Optional, Tuple
 
+from ..observability.reqtrace import ReqTrace, tracing_active
 from ..parallel.dataset import padded_rows
+from ..resilience.faults import inject
 from ..utils.guarded import TracedLock, TracedSemaphore, guarded_by
 
 
@@ -88,13 +90,17 @@ class BucketPolicy:
 class Request:
     """One submitted request: ``x`` is a host pytree whose leaves have
     leading dim ``n``; the future resolves to the model output for
-    exactly those ``n`` rows (pad stripped)."""
+    exactly those ``n`` rows (pad stripped). ``trace`` is the
+    request-path span record (PR 16) carried across the worker-thread
+    hop — None when tracing is suppressed/disabled, and the serving
+    path treats it as optional everywhere."""
 
     model: str
     x: Any
     n: int
     enqueued_s: float = field(default_factory=time.perf_counter)
     future: Future = field(default_factory=Future)
+    trace: Optional[ReqTrace] = None
 
 
 @guarded_by("_lock", "_pending", "_closed")
@@ -120,16 +126,34 @@ class MicroBatcher:
         future. Raises :class:`QueueFullError` when no slot frees
         within the timeout (bounded queue = bounded latency: better an
         honest 429 than an unbounded wait)."""
+        return self.submit_request(model, x, n, timeout_s=timeout_s).future
+
+    def submit_request(self, model: str, x: Any, n: int,
+                       timeout_s: Optional[float] = None) -> Request:
+        """:meth:`submit`, returning the whole :class:`Request` — the
+        trace-aware spelling (the HTTP surface echoes
+        ``request.trace.trace_id`` back as ``X-Keystone-Trace``)."""
+        inject("serve.enqueue", context=model)
         timeout = self.submit_timeout_s if timeout_s is None else timeout_s
         if not self._slots.acquire(timeout=timeout):
             from ..observability.metrics import MetricsRegistry
 
-            MetricsRegistry.get_or_create().counter(
-                "serving.rejected_total").inc()
+            reg = MetricsRegistry.get_or_create()
+            reg.counter("serving.rejected_total").inc()
+            # the per-model family: a 429 storm names its model
+            reg.counter(f"serving.rejected_total.{model}").inc()
             raise QueueFullError(
                 f"serving queue full ({self.queue_depth} slots) — "
                 f"request for {model!r} rejected after {timeout:.1f}s")
-        req = Request(model=model, x=x, n=int(n))
+        trace = ReqTrace.new(model, int(n)) if tracing_active() else None
+        if trace is None:
+            req = Request(model=model, x=x, n=int(n))
+        else:
+            # one clock read stamps both records: the trace's
+            # enqueued_s IS the request's (the telescoping invariant
+            # starts here)
+            req = Request(model=model, x=x, n=int(n),
+                          enqueued_s=trace.enqueued_s, trace=trace)
         with self._lock:
             if self._closed:
                 self._slots.release()
@@ -141,7 +165,7 @@ class MicroBatcher:
 
         MetricsRegistry.get_or_create().gauge(
             "serving.queue_depth").set(depth)
-        return req.future
+        return req
 
     # -- consumer side (the plane worker) ----------------------------------
     def take(self, max_rows: int, timeout_s: float = 0.05) -> List[Request]:
@@ -171,6 +195,11 @@ class MicroBatcher:
             if not self._pending:
                 self._ready.clear()
             depth = len(self._pending)
+        taken_s = time.perf_counter()
+        for req in out:
+            if req.trace is not None:
+                # queue_wait ends here; the worker owns later stamps
+                req.trace.taken_s = taken_s
         from ..observability.metrics import MetricsRegistry
 
         MetricsRegistry.get_or_create().gauge(

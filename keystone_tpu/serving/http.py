@@ -8,7 +8,10 @@ telemetry plane):
   JSON array). Instances are item-shaped rows for the admitted sample;
   the handler thread submits them as ONE request to the micro-batcher
   and blocks on the future, so concurrent requests coalesce into
-  padded-bucket batches. Response: ``{"model", "rows", "predictions"}``.
+  padded-bucket batches. Response: ``{"model", "rows", "predictions"}``
+  plus an ``X-Keystone-Trace`` header echoing the request's trace id
+  (PR 16) — the handle a client quotes when it asks "where did my
+  2-second request spend its time".
   Errors map to honest statuses: 404 unknown model, 503 warming,
   429 bounded-queue full, 400 shape/JSON errors.
 * ``GET /healthz`` — the REAL readiness gate: 503 ``warming`` until
@@ -18,13 +21,19 @@ telemetry plane):
   registry (``serving.*`` families included).
 * ``GET /models`` — JSON plane state (residency charges, buckets,
   per-model QPS, evicted set).
+* ``GET /slo`` — the SLO tracker's state: policy, rolling
+  availability / burn rate (aggregate + per model), lifetime totals,
+  and the bounded violation log with post-mortem paths.
+* ``GET /debug/slow?n=8[&model=m]`` — the slowest retained request
+  span trees from the exemplar reservoir (trace id, per-phase ms,
+  batch membership) — the "show me the tail" endpoint.
 
 CLI::
 
     python -m keystone_tpu serve NAME=PATH@SHAPE[:DTYPE] ... \
         [--port P] [--host H] [--hbm-budget BYTES] [--max-batch N] \
         [--queue-depth N] [--weight-dtype bf16|int8|f32] \
-        [--drift-every N]
+        [--drift-every N] [--slo-latency-ms MS] [--slo-availability A]
 
 ``SHAPE`` is the per-item shape (comma-separated, e.g. ``784`` or
 ``32,32,3``), ``DTYPE`` defaults to float32. The server binds BEFORE
@@ -39,12 +48,15 @@ from __future__ import annotations
 
 import json
 import sys
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
+from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
 
 from ..observability.metrics import MetricsRegistry
+from ..observability.reqtrace import exemplar_reservoir
 from ..observability.sampler import _MetricsHandler, _MetricsServer
+from ..observability.slo import SloPolicy
 from .batcher import QueueFullError
 from .plane import ModelNotAdmitted, ModelWarming, ServingPlane
 from .residency import AdmissionError
@@ -57,9 +69,32 @@ class ServingHandler(_MetricsHandler):
     plane: Optional[ServingPlane] = None
 
     def do_GET(self):  # noqa: N802 (stdlib handler API)
-        if self.path.split("?")[0] == "/models":
+        from ..observability.timeline import flight_recorder
+
+        # scrape-time flush point: materialize the worker's deferred
+        # telemetry (spans + phase observes) before serializing any view
+        flight_recorder().flush()
+        parts = urlsplit(self.path)
+        if parts.path == "/models":
             self._reply(200, json.dumps(self.plane.state()).encode(),
                         "application/json")
+            return
+        if parts.path == "/slo":
+            self._reply(200,
+                        json.dumps(self.plane.slo.state()).encode(),
+                        "application/json")
+            return
+        if parts.path == "/debug/slow":
+            try:
+                query = parse_qs(parts.query)
+                n = int(query.get("n", ["8"])[0])
+                model = query.get("model", [None])[0]
+            except (ValueError, TypeError) as exc:
+                self._reply(400, _err(exc))
+                return
+            body = json.dumps({"slowest": exemplar_reservoir()
+                               .slowest_trees(n, model=model)}).encode()
+            self._reply(200, body, "application/json")
             return
         super().do_GET()
 
@@ -77,13 +112,17 @@ class ServingHandler(_MetricsHandler):
             if not isinstance(instances, list) or not instances:
                 raise ValueError(
                     'body must be {"instances": [...]} or a JSON array')
-            out = self.plane.predict(name, np.asarray(instances))
+            out, trace_id = self.plane.predict_traced(
+                name, np.asarray(instances))
             body = json.dumps({
                 "model": name,
                 "rows": len(instances),
                 "predictions": _jsonable(out),
             }).encode()
-            self._reply(200, body, "application/json")
+            # the trace id rides a header, not the body — existing
+            # clients keep parsing the same JSON shape
+            headers = {"X-Keystone-Trace": trace_id} if trace_id else None
+            self._reply(200, body, "application/json", headers=headers)
         except ModelNotAdmitted as exc:
             self._reply(404, _err(exc))
         except ModelWarming as exc:
@@ -96,10 +135,13 @@ class ServingHandler(_MetricsHandler):
             self._reply(500, _err(exc))
 
     def _reply(self, status: int, body: bytes,
-               ctype: str = "application/json") -> None:
+               ctype: str = "application/json",
+               headers: Optional[Dict[str, str]] = None) -> None:
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for key, value in (headers or {}).items():
+            self.send_header(key, value)
         self.end_headers()
         self.wfile.write(body)
 
@@ -187,6 +229,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         wd = _pop_flag(argv, "--weight-dtype", "bf16")
         weight_dtype = None if wd in ("f32", "none", "f32/none") else wd
         drift_every = int(_pop_flag(argv, "--drift-every", "32"))
+        slo_latency = _pop_flag(argv, "--slo-latency-ms")
+        slo_avail = _pop_flag(argv, "--slo-availability")
+        slo_policy = None
+        if slo_latency is not None or slo_avail is not None:
+            kwargs = {}
+            if slo_latency is not None:
+                kwargs["latency_threshold_ms"] = float(slo_latency)
+            if slo_avail is not None:
+                kwargs["availability_target"] = float(slo_avail)
+            slo_policy = SloPolicy(**kwargs)
         specs = [_parse_model_spec(s) for s in argv if not
                  s.startswith("-")]
     except ValueError as exc:
@@ -196,13 +248,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("usage: python -m keystone_tpu serve "
               "NAME=PATH@SHAPE[:DTYPE] ... [--port P] [--host H] "
               "[--hbm-budget BYTES] [--max-batch N] [--queue-depth N] "
-              "[--weight-dtype bf16|int8|f32] [--drift-every N]",
+              "[--weight-dtype bf16|int8|f32] [--drift-every N] "
+              "[--slo-latency-ms MS] [--slo-availability A]",
               file=sys.stderr)
         return 2
 
     plane = ServingPlane(
         hbm_budget=budget, max_batch=max_batch, queue_depth=queue_depth,
-        default_weight_dtype=weight_dtype, drift_every=drift_every)
+        default_weight_dtype=weight_dtype, drift_every=drift_every,
+        slo_policy=slo_policy)
     # readiness waits for every listed model BEFORE the port opens:
     # a scrape between bind and the last warmup sees 503 warming
     plane.expect_models(len(specs))

@@ -34,7 +34,14 @@ telemetry channel:
   ``score_drift`` — a stale model trips ``numerics.drift_warn`` before
   its accuracy visibly drops. The PR 13 ``weight_dtype`` bf16/int8
   quantized predict is applied at admission when requested (the serve
-  CLI defaults to bf16).
+  CLI defaults to bf16). PR 16 added the request path itself: each
+  request carries a :class:`~keystone_tpu.observability.reqtrace.\
+ReqTrace` whose phase stamps (queue_wait / coalesce / dispatch /
+  respond) telescope exactly to ``serving.request_ms``, feed the
+  ``serving.phase_ms.<phase>`` histograms, link into per-batch flow
+  spans on the flight recorder, fill the slowest-N exemplar reservoir,
+  and drive the rolling-window SLO tracker (``self.slo``) — one
+  post-mortem per violated availability window.
 
 Thread model: handler/caller threads run ``admit``/``submit``; one
 worker thread drains the batcher. ``_models``/``_evicted``/
@@ -52,6 +59,8 @@ from typing import Any, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..observability.reqtrace import exemplar_reservoir, mint_flow_id
+from ..observability.timeline import flight_recorder
 from ..utils.guarded import TracedLock, guarded_by
 from .batcher import BucketPolicy, MicroBatcher, Request
 from .residency import AdmissionError, ModelCharge, ResidencyLedger, model_charge
@@ -228,7 +237,9 @@ class ServingPlane:
                  default_weight_dtype: Optional[str] = None,
                  drift_every: int = 32,
                  policy: Optional[BucketPolicy] = None,
-                 mesh: Any = None, steady_fence: bool = True):
+                 mesh: Any = None, steady_fence: bool = True,
+                 slo_policy: Any = None):
+        from ..observability.slo import SloTracker
         from ..parallel.mesh import get_mesh, num_data_shards
 
         self.mesh = mesh or get_mesh()
@@ -236,6 +247,9 @@ class ServingPlane:
         self.policy = policy or BucketPolicy(max_batch)
         self.ledger = ResidencyLedger(hbm_budget)
         self.batcher = MicroBatcher(queue_depth)
+        #: rolling-window error-budget accounting (PR 16); fed one
+        #: outcome per request by the worker, read by ``GET /slo``
+        self.slo = SloTracker(slo_policy)
         self.drift_every = max(int(drift_every), 1)
         self.default_weight_dtype = default_weight_dtype
         self.steady_fence = steady_fence
@@ -248,7 +262,19 @@ class ServingPlane:
         self._lock = TracedLock("serving.plane")
         self._stop = threading.Event()
         self._worker: Optional[threading.Thread] = None
+        # the serving thread's identity, cached once at worker start so
+        # the per-batch defer does not pay a current_thread() lookup
+        # (defaults cover tests driving _serve_batch directly)
+        self._worker_tid = 0
+        self._worker_name = "serving-worker"
         self._closed = False
+        # per-model phase-histogram handles, resolved once per model:
+        # the per-request hot loop must not pay a registry lookup per
+        # observe (the always-on <2% bar, PERFORMANCE.md rule 15);
+        # keyed off the live registry so a test-harness reset
+        # invalidates the cache instead of feeding a dead registry
+        self._phase_reg: Any = None
+        self._phase_hists: Dict[str, Dict[str, Tuple[Any, Any]]] = {}
         if hbm_budget is not None:
             from ..observability.metrics import MetricsRegistry
 
@@ -292,6 +318,9 @@ class ServingPlane:
             if not req.future.done():
                 req.future.set_exception(
                     RuntimeError("serving plane closed"))
+        # the worker is gone; materialize whatever it deferred so
+        # post-shutdown artifact dumps see every span/observe
+        flight_recorder().flush()
 
     @staticmethod
     def _observatory():
@@ -564,6 +593,13 @@ class ServingPlane:
         output for exactly the submitted rows (pad stripped). ``x`` is
         one item (the admitted sample shape) or a leading-dim batch of
         them, up to the largest bucket."""
+        return self.submit_request(name, x, timeout_s=timeout_s).future
+
+    def submit_request(self, name: str, x: Any,
+                       timeout_s: Optional[float] = None) -> Request:
+        """:meth:`submit`, returning the whole
+        :class:`~.batcher.Request` — ``request.trace`` carries the
+        request-path span record (trace id, phase stamps)."""
         with self._lock:
             entry = self._models.get(name)
             if entry is None:
@@ -576,11 +612,21 @@ class ServingPlane:
                 raise ModelWarming(f"model {name!r} is still warming")
             sample = entry.sample
         x_tree, n = self._normalize(name, sample, x)
-        return self.batcher.submit(name, x_tree, n, timeout_s=timeout_s)
+        return self.batcher.submit_request(name, x_tree, n,
+                                           timeout_s=timeout_s)
 
     def predict(self, name: str, x: Any, timeout_s: float = 60.0):
         """Synchronous convenience: submit + wait."""
         return self.submit(name, x).result(timeout=timeout_s)
+
+    def predict_traced(self, name: str, x: Any, timeout_s: float = 60.0):
+        """:meth:`predict`, returning ``(output, trace_id)`` —
+        ``trace_id`` is ``""`` when tracing is suppressed/disabled.
+        The HTTP handler serves this as the ``X-Keystone-Trace``
+        response header."""
+        req = self.submit_request(name, x)
+        out = req.future.result(timeout=timeout_s)
+        return out, ("" if req.trace is None else req.trace.trace_id)
 
     def _normalize(self, name: str, sample: Any,
                    x: Any) -> Tuple[Any, int]:
@@ -652,15 +698,22 @@ class ServingPlane:
     def _execute(self, entry: ServedModel, x_tree: Any, n: int):
         """One padded-bucket apply; returns ``(outputs, ds)`` where
         outputs carries exactly ``n`` rows (pad stripped)."""
+        ds = self._bucketed(entry, x_tree, n)
+        return self._collect(entry, ds, n), ds
+
+    def _collect(self, entry: ServedModel, ds: Any, n: int):
+        """The device half of :meth:`_execute`: dispatch the warm
+        program over an already-bucketed dataset and block until the
+        host holds the result — the ``dispatch`` phase of the request
+        trace is exactly this call."""
         from ..parallel.dataset import ArrayDataset, Dataset
 
-        ds = self._bucketed(entry, x_tree, n)
         out = entry.fitted.apply(ds).get()
         if isinstance(out, ArrayDataset):
-            return out.numpy(), ds
+            return out.numpy()
         if isinstance(out, Dataset):
-            return out.collect()[:n], ds
-        return np.asarray(out), ds
+            return out.collect()[:n]
+        return np.asarray(out)
 
     def _score_drift(self, entry: ServedModel, ds) -> None:
         from ..observability.numerics import score_drift
@@ -677,18 +730,46 @@ class ServingPlane:
             reason="request space is not the sketched feature space "
                    "(baseline rides an upstream stage)")
 
+    def _phase_instruments(self, name: str) -> Dict[str, Tuple[Any, Any]]:
+        """``phase -> (aggregate, per-model)`` histogram pairs for one
+        model, resolved on first use and cached for the worker's hot
+        loop. Invalidated wholesale when the metrics registry instance
+        changes (test harnesses reset it between cases)."""
+        from ..observability.metrics import MetricsRegistry
+        from ..observability.reqtrace import PHASES
+
+        reg = MetricsRegistry.get_or_create()
+        if reg is not self._phase_reg:
+            self._phase_reg = reg
+            self._phase_hists = {}
+        pairs = self._phase_hists.get(name)
+        if pairs is None:
+            pairs = {ph: (reg.histogram(f"serving.phase_ms.{ph}"),
+                          reg.histogram(f"serving.phase_ms.{ph}.{name}"))
+                     for ph in PHASES}
+            self._phase_hists[name] = pairs
+        return pairs
+
     # -- the worker --------------------------------------------------------
     def _worker_loop(self) -> None:
+        t = threading.current_thread()
+        self._worker_tid = t.ident or 0
+        self._worker_name = t.name
         max_rows = self.policy.max_rows(self._shards)
         while not self._stop.is_set():
             batch = self.batcher.take(max_rows, timeout_s=0.05)
             if batch:
                 self._serve_batch(batch)
+            else:
+                # idle moment: materialize this worker's deferred
+                # telemetry (spans + phase observes) off the hot path
+                flight_recorder().flush()
 
     def _serve_batch(self, requests: List[Request]) -> None:
         import jax
 
         from ..observability.metrics import MetricsRegistry
+        from ..resilience.faults import inject
 
         name = requests[0].model
         reg = MetricsRegistry.get_or_create()
@@ -699,48 +780,158 @@ class ServingPlane:
                 raise ModelNotAdmitted(
                     f"model {name!r} was evicted while queued")
             rows = sum(r.n for r in requests)
+            t_merge = time.perf_counter()  # coalesce/pad phase starts
             merged = jax.tree_util.tree_map(
                 lambda *leaves: np.concatenate(leaves, axis=0),
                 *[r.x for r in requests])
-            t0 = time.perf_counter()
-            outputs, ds = self._execute(entry, merged, rows)
-            batch_ms = (time.perf_counter() - t0) * 1e3
+            ds = self._bucketed(entry, merged, rows)
+            inject("serve.dispatch", context=name)
+            t0 = time.perf_counter()       # device dispatch starts
+            outputs = self._collect(entry, ds, rows)
+            t_done = time.perf_counter()   # block_until_ready returned
+            batch_ms = (t_done - t0) * 1e3
             bucket = ds.padded_n
+            fill = rows / float(bucket)
             offset = 0
             for req in requests:
-                req.future.set_result(self._slice_rows(
-                    outputs, offset, req.n))
+                out_i = self._slice_rows(outputs, offset, req.n)
                 offset += req.n
+                tr = req.trace
+                if tr is not None:
+                    # every stamp lands BEFORE the future resolves, so
+                    # a trace the submitter can observe is immutable
+                    tr.dispatch_s = t0
+                    tr.done_s = t_done
+                    tr.bucket = bucket
+                    tr.fill = fill
+                    tr.responded_s = time.perf_counter()
+                req.future.set_result(out_i)
             now = time.perf_counter()
             reg.counter("serving.requests_total").inc(len(requests))
             reg.counter("serving.rows_total").inc(rows)
             reg.counter("serving.batches_total").inc()
             reg.histogram("serving.batch_ms").observe(batch_ms)
-            fill = rows / float(bucket)
             reg.histogram("serving.batch_fill").observe(fill)
             reg.histogram(f"serving.batch_fill.{name}").observe(fill)
+            traced = []
             for req in requests:
-                wait_ms = (now - req.enqueued_s) * 1e3
+                tr = req.trace
+                if tr is not None and tr.complete():
+                    traced.append(tr)
+                    wait_ms = tr.request_ms()
+                else:
+                    wait_ms = (now - req.enqueued_s) * 1e3
                 reg.histogram("serving.request_ms").observe(wait_ms)
                 reg.histogram(
                     f"serving.request_ms.{name}").observe(wait_ms)
+                self.slo.record(name, wait_ms)
+            if traced:
+                self._record_batch_trace(name, traced, t_merge,
+                                         bucket, fill)
             with self._lock:
                 entry.note_served(rows, len(requests), now)
                 score_now = (not entry.drift_disabled
                              and entry.baseline is not None
                              and entry.batches % self.drift_every == 0)
             if score_now:
+                # scored AFTER futures resolved: drift work never adds
+                # request latency, so it is a batch-level phase outside
+                # the per-request telescoping sum (pinned test)
+                t_drift = time.perf_counter()
                 try:
                     self._score_drift(entry, ds)
                 except ValueError:
                     self._disable_drift(entry)
+                reg.histogram("serving.phase_ms.drift_score").observe(
+                    (time.perf_counter() - t_drift) * 1e3)
         except BaseException as exc:
             reg.counter("serving.errors_total").inc()
             for req in requests:
                 if not req.future.done():
                     req.future.set_exception(exc)
+                self.slo.record(name, None, ok=False)
         finally:
             self.batcher.done(len(requests))
+
+    def _record_batch_trace(self, name: str, traces: List[Any],
+                            start_s: float, bucket: int,
+                            fill: float) -> None:
+        """One ``request:`` span per completed member trace plus the
+        ``batch:`` span they rode, linked by Chrome-trace flow ids
+        (``flow_out`` on each request span, the matching ``flow_in``
+        list on the batch span — ``timeline.to_chrome_trace`` exports
+        them as ``ph:"s"``/``ph:"f"`` flow events, so Perfetto draws a
+        request's causal path through the coalesced batch). Completed
+        traces also feed the slowest-N exemplar reservoir. Hot path —
+        runs between a batch's futures resolving and the worker's next
+        ``take``, so EVERYTHING here is DEFERRED via
+        ``FlightRecorder.defer`` (span construction — f-strings, args
+        dicts, the ring lock — the phase-histogram observes, AND the
+        reservoir offers) and materialized at the next flush point
+        (any recorder view, the HTTP scrape surface, the idle worker,
+        and — because the offers ride along — the SLO escalation path,
+        which flushes before reading exemplars). Completed traces are
+        immutable, so late materialization reads exactly what the
+        worker stamped; the inline cost is one mint, one tuple, and
+        one deque append."""
+        rec = flight_recorder()
+        batch_id = mint_flow_id()
+        if rec.enabled:
+            members = tuple(traces)
+            rec.defer(lambda: self._materialize_batch_telemetry(
+                rec, name, members, start_s, bucket, fill, batch_id,
+                self._worker_tid, self._worker_name))
+        else:
+            # no recorder, no flush point: the scrape surface still
+            # owes the phase histograms and the reservoir its
+            # exemplars, so both run inline
+            reservoir = exemplar_reservoir()
+            for tr in traces:
+                tr.batch_id = batch_id
+                reservoir.offer(tr)
+            self._observe_phases(name, traces)
+
+    def _observe_phases(self, name: str, traces: Any) -> None:
+        """Feed the ``serving.phase_ms.<phase>[.<model>]`` histogram
+        pairs one decomposition per completed trace."""
+        pairs = self._phase_instruments(name)
+        for tr in traces:
+            for phase, ms in tr.phases_ms().items():
+                agg, per_model = pairs[phase]
+                agg.observe(ms)
+                per_model.observe(ms)
+
+    def _materialize_batch_telemetry(self, rec: Any, name: str,
+                                     traces: tuple, start_s: float,
+                                     bucket: int, fill: float,
+                                     batch_id: int, tid: int,
+                                     thread: str) -> None:
+        """The deferred half of :meth:`_record_batch_trace`: feeds the
+        exemplar reservoir, builds the ``request:``/``batch:`` spans,
+        and runs the phase-histogram observes when the recorder is
+        flushed. ``tid``/``thread`` are the worker identity captured
+        at defer time, so the spans land on the worker's lane."""
+        reservoir = exemplar_reservoir()
+        for tr in traces:
+            tr.batch_id = batch_id
+            reservoir.offer(tr)
+        self._observe_phases(name, traces)
+        end_s = start_s
+        req_span = "request:" + name
+        for tr in traces:
+            if tr.responded_s > end_s:
+                end_s = tr.responded_s
+            rec.record(req_span, "serving", tr.enqueued_s,
+                       tr.responded_s - tr.enqueued_s,
+                       args={"trace_id": tr.trace_id, "n": tr.n,
+                             "batch": batch_id, "flow_out": tr.flow_id,
+                             "phases_ms": tr.phases_ms()},
+                       tid=tid, thread=thread)
+        rec.record("batch:" + name, "serving", start_s, end_s - start_s,
+                   args={"batch": batch_id, "bucket": bucket,
+                         "fill": round(fill, 4), "requests": len(traces),
+                         "flow_in": [tr.flow_id for tr in traces]},
+                   tid=tid, thread=thread)
 
     @staticmethod
     def _slice_rows(outputs: Any, offset: int, n: int) -> Any:

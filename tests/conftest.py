@@ -55,6 +55,7 @@ def fresh_env():
     )
     from keystone_tpu.observability.metrics import MetricsRegistry
     from keystone_tpu.observability.numerics import reset_health_series
+    from keystone_tpu.observability.reqtrace import reset_exemplars
     from keystone_tpu.observability.timeline import reset_flight_recorder
     from keystone_tpu.workflow.env import PipelineEnv
 
@@ -63,6 +64,7 @@ def fresh_env():
     reset_flight_recorder()
     reset_compile_observatory()
     reset_health_series()
+    reset_exemplars()
     clear_calibration_cache()
     yield
     PipelineEnv.reset()
@@ -70,6 +72,7 @@ def fresh_env():
     reset_flight_recorder()
     reset_compile_observatory()
     reset_health_series()
+    reset_exemplars()
     clear_calibration_cache()
 
 

@@ -137,6 +137,18 @@ def test_direction_markers():
     assert not lower_is_better("serve_qps_per_chip")
     assert not lower_is_better("p99_bounded_qps")
     assert not lower_is_better("stall_free_qps")
+    # the request-path plane (PR 16): phase shares of the request wall
+    # and budget burn are costs; availability and batch fill are
+    # utilization/goodness fractions whose markers WIN over any
+    # lower-better substring in the same name
+    assert lower_is_better("serve_queue_wait_share")
+    assert lower_is_better("serve_dispatch_share")
+    assert lower_is_better("serving_trace_overhead_share")
+    assert lower_is_better("serve_error_budget_burn_rate")
+    assert not lower_is_better("serve_availability")
+    assert not lower_is_better("serve_batch_fill")
+    # "availability" outranks a co-occurring lower-better marker
+    assert not lower_is_better("availability_error_window")
 
 
 def test_serving_latency_regression_fixture(tmp_path, capsys):
@@ -195,6 +207,54 @@ def test_overhead_share_bands_absolutely(tmp_path):
     band, _ = noise_band(m, arts)
     assert band == pytest.approx(1.5 * 0.035)
     assert classify(m, -0.03, 0.01, band)[0] == "in-band"
+    # the serving-trace share (PR 16) rides the same absolute banding
+    # via the shared "overhead_share" marker
+    band16, _ = noise_band("serving_trace_overhead_share", [])
+    assert band16 == ABSOLUTE_BAND_FLOOR
+    assert classify("serving_trace_overhead_share",
+                    0.0, 0.01, band16) == ("in-band", -0.01)
+
+
+def test_slo_plane_regression_fixtures(tmp_path, capsys):
+    """The PR 16 direction markers end to end, pinned BEFORE BENCH_r09
+    records the first request-path baseline (the PR 15 `_p99`/`_qps`
+    discipline): availability that DROPS regresses, availability that
+    rises improves, and a queue-wait share that GROWS (backpressure
+    eating the wall) regresses."""
+    base = _artifact(tmp_path / "BENCH_r01.json", 1,
+                     {"serve_availability": 0.999,
+                      "serve_queue_wait_share": 0.2})
+    outage = _artifact(tmp_path / "BENCH_r02.json", 2,
+                       {"serve_availability": 0.88,
+                        "serve_queue_wait_share": 0.2})
+    rc = benchdiff_main([str(base), str(outage)])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert any("serve_availability" in line and "regressed" in line
+               for line in out.splitlines())
+
+    # a fresh dir: no learned history, so the default 8% band applies
+    # and the +13.5% recovery classifies as a directional improvement
+    rec = tmp_path / "rec"
+    rec.mkdir()
+    rec_base = _artifact(rec / "BENCH_r01.json", 1,
+                         {"serve_availability": 0.88})
+    recovered = _artifact(rec / "BENCH_r02.json", 2,
+                          {"serve_availability": 0.999})
+    rc = benchdiff_main([str(rec_base), str(recovered)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert any("serve_availability" in line and "improved" in line
+               for line in out.splitlines())
+
+    congested = _artifact(tmp_path / "BENCH_r04.json", 4,
+                          {"serve_availability": 0.999,
+                           "serve_queue_wait_share": 0.31})
+    rc = benchdiff_main([str(base), str(congested)])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert any("serve_queue_wait_share" in line and "regressed" in line
+               for line in out.splitlines())
 
 
 # -- classification + exit codes ---------------------------------------------

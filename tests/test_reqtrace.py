@@ -1,0 +1,493 @@
+"""The request-path tracing & SLO plane (ISSUE 16):
+
+* the telescoping invariant — the four-phase decomposition of a traced
+  request sums EXACTLY to its ``request_ms`` (float epsilon only),
+  both on a hand-stamped trace and through the real serving plane;
+* Chrome-trace flow links: request spans carry ``flow_out``, their
+  batch span the matching ``flow_in`` list, exported as ``ph:"s"`` /
+  ``ph:"f"`` events that anchor to existing lanes without ever
+  violating the strictly-non-overlapping-per-lane invariant;
+* the bounded slowest-N exemplar reservoir;
+* SLO accounting: rolling windows, min_count cold-start guard, the
+  one-post-mortem-per-violated-window discipline, and the embedded
+  exemplar evidence;
+* the HTTP surface (``X-Keystone-Trace`` header, ``GET /slo``,
+  ``GET /debug/slow``);
+* per-model 429 accounting (``serving.rejected_total.<model>``);
+* submit/take/done under the deterministic scheduler: two clients
+  racing the worker lose no span and cross-attribute none, under a
+  scripted regression schedule AND a seeded sweep.
+"""
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from keystone_tpu.nodes.learning.linear import LinearMapEstimator
+from keystone_tpu.observability.metrics import MetricsRegistry
+from keystone_tpu.observability.reqtrace import (
+    PHASES,
+    ExemplarReservoir,
+    ReqTrace,
+    exemplar_reservoir,
+    mint_trace_id,
+    tracing_active,
+    tracing_suppressed,
+)
+from keystone_tpu.observability.slo import (
+    SloPolicy,
+    SloTracker,
+    SloViolation,
+)
+from keystone_tpu.observability.timeline import (
+    FlightRecorder,
+    flight_recorder,
+)
+from keystone_tpu.parallel.dataset import ArrayDataset
+from keystone_tpu.serving import MicroBatcher, QueueFullError, ServingPlane
+
+
+def _make_fitted(d, k, seed=0, n=96):
+    r = np.random.RandomState(seed)
+    X = r.rand(n, d).astype(np.float32)
+    Y = r.rand(n, k).astype(np.float32)
+    fitted = LinearMapEstimator(lam=1e-3).with_data(
+        ArrayDataset.from_numpy(X), ArrayDataset.from_numpy(Y)).fit()
+    return fitted, X
+
+
+def _sample(d):
+    return jax.ShapeDtypeStruct((d,), np.float32)
+
+
+def _stamped(model="m", n=4, base=100.0,
+             deltas=(0.001, 0.002, 0.003, 0.0005)):
+    tr = ReqTrace.new(model, n)
+    tr.enqueued_s = base
+    tr.taken_s = base + deltas[0]
+    tr.dispatch_s = tr.taken_s + deltas[1]
+    tr.done_s = tr.dispatch_s + deltas[2]
+    tr.responded_s = tr.done_s + deltas[3]
+    return tr
+
+
+# -- the trace record ---------------------------------------------------------
+
+def test_phases_telescope_to_request_ms():
+    tr = _stamped()
+    ph = tr.phases_ms()
+    assert tuple(ph) == PHASES
+    assert sum(ph.values()) == pytest.approx(tr.request_ms(), abs=1e-9)
+    assert all(v >= 0 for v in ph.values())
+
+
+def test_incomplete_trace_has_no_phases():
+    tr = ReqTrace.new("m", 2)
+    assert not tr.complete()
+    assert tr.phases_ms() == {}
+    assert tr.request_ms() is None
+    tr.taken_s = tr.enqueued_s + 0.001
+    assert tr.phases_ms() == {}  # still missing later stamps
+
+
+def test_trace_ids_are_process_unique_and_prefixed():
+    ids = {mint_trace_id() for _ in range(64)}
+    assert len(ids) == 64
+    assert all(i.startswith(f"req-{os.getpid():x}-") for i in ids)
+    assert mint_trace_id("coord").startswith("coord-")
+
+
+def test_tracing_suppression_and_env_gate(monkeypatch):
+    assert tracing_active()
+    with tracing_suppressed():
+        assert not tracing_active()
+        with tracing_suppressed():  # depth-counted, not a boolean
+            assert not tracing_active()
+        assert not tracing_active()
+    assert tracing_active()
+    monkeypatch.setenv("KEYSTONE_REQTRACE", "0")
+    assert not tracing_active()
+
+
+# -- the exemplar reservoir ---------------------------------------------------
+
+def test_reservoir_is_bounded_and_keeps_the_slowest():
+    res = ExemplarReservoir(cap=3)
+    for ms in (5, 1, 9, 3, 7, 2, 8):
+        tr = _stamped(deltas=(ms / 4e3,) * 4)  # request_ms == ms
+        res.offer(tr)
+    kept = [round(t.request_ms()) for t in res.slowest(10, model="m")]
+    assert kept == [9, 8, 7]  # slowest three, slowest first
+    # a fast trace offered into a full reservoir is refused
+    assert res.offer(_stamped(deltas=(0.0001,) * 4)) is False
+    # incomplete traces are never retained
+    assert res.offer(ReqTrace.new("m", 1)) is False
+
+
+def test_reservoir_merges_across_models_and_filters():
+    res = ExemplarReservoir(cap=4)
+    res.offer(_stamped(model="a", deltas=(0.001,) * 4))
+    res.offer(_stamped(model="b", deltas=(0.002,) * 4))
+    merged = res.slowest(10)
+    assert [t.model for t in merged] == ["b", "a"]
+    assert [t.model for t in res.slowest(10, model="a")] == ["a"]
+    trees = res.slowest_trees(1)
+    assert trees[0]["model"] == "b" and "phases_ms" in trees[0]
+    res.clear()
+    assert res.slowest(10) == []
+
+
+# -- flow-event export --------------------------------------------------------
+
+def test_chrome_trace_emits_flow_links_at_anchor_positions():
+    rec = FlightRecorder(capacity=64, enabled=True)
+    rec.record("request:m", "serving", 1.0, 0.5,
+               args={"flow_out": 7, "trace_id": "req-x-7"})
+    rec.record("batch:m", "serving", 1.2, 0.4,
+               args={"flow_in": [7], "batch": 1})
+    events = rec.to_chrome_trace()["traceEvents"]
+    anchors = {e["name"]: e for e in events if e.get("ph") == "X"}
+    starts = [e for e in events if e.get("ph") == "s"]
+    finishes = [e for e in events if e.get("ph") == "f"]
+    assert len(starts) == 1 and len(finishes) == 1
+    # flow events anchor to their span's ts and lane, share the id,
+    # and the finish binds to the enclosing slice (bp: "e")
+    assert starts[0]["id"] == finishes[0]["id"] == 7
+    assert starts[0]["ts"] == anchors["request:m"]["ts"]
+    assert starts[0]["tid"] == anchors["request:m"]["tid"]
+    assert finishes[0]["ts"] == anchors["batch:m"]["ts"]
+    assert finishes[0]["tid"] == anchors["batch:m"]["tid"]
+    assert finishes[0]["bp"] == "e"
+
+
+def _assert_no_lane_overlap(trace):
+    by_lane = {}
+    for e in trace["traceEvents"]:
+        if e.get("ph") == "X":
+            by_lane.setdefault(e["tid"], []).append(
+                (e["ts"], e["ts"] + e["dur"]))
+    for lane, spans in by_lane.items():
+        spans.sort()
+        for (s0, e0), (s1, _) in zip(spans, spans[1:]):
+            assert s1 >= e0, f"lane {lane} overlaps: {spans}"
+
+
+def test_flow_links_do_not_break_lane_nonoverlap():
+    rec = FlightRecorder(capacity=64, enabled=True)
+    lanes_without_flows = None
+    for with_flows in (False, True):
+        rec.clear()
+        for i in range(4):
+            args = ({"flow_out": i + 1} if with_flows else None)
+            rec.record(f"request:{i}", "serving", 1.0 + i * 0.1, 0.5,
+                       args=args)  # overlapping -> sub-lanes
+        trace = rec.to_chrome_trace()
+        _assert_no_lane_overlap(trace)
+        lanes = {e["tid"] for e in trace["traceEvents"]
+                 if e.get("ph") == "X"}
+        if lanes_without_flows is None:
+            lanes_without_flows = lanes
+        else:
+            # flow events never mint lanes of their own
+            assert lanes == lanes_without_flows
+
+
+# -- through the real serving plane ------------------------------------------
+
+@pytest.fixture
+def plane_factory():
+    planes = []
+
+    def make(**kw):
+        kw.setdefault("max_batch", 16)
+        plane = ServingPlane(**kw)
+        planes.append(plane)
+        return plane
+
+    yield make
+    for plane in planes:
+        plane.close()
+
+
+def test_served_request_reconciles_and_links(plane_factory):
+    """The acceptance pin: a request served by the REAL plane carries a
+    complete trace whose phase sum reconciles with its request_ms, the
+    phase histograms observed it, the reservoir retained it, and the
+    Perfetto export links its span into the batch span it rode."""
+    fitted, X = _make_fitted(8, 3, seed=0)
+    plane = plane_factory()
+    plane.start()
+    plane.admit("m", fitted, _sample(8))
+    out, trace_id = plane.predict_traced("m", X[:5])
+    assert np.asarray(out).shape == (5, 3)
+    assert trace_id.startswith("req-")
+
+    # reservoir offers and phase observes are deferred onto the
+    # recorder's flush path (the serving hot path only stamps)
+    flight_recorder().flush()
+    traces = exemplar_reservoir().slowest(4, model="m")
+    assert len(traces) == 1
+    tr = traces[0]
+    assert tr.trace_id == trace_id and tr.complete()
+    ph = tr.phases_ms()
+    assert sum(ph.values()) == pytest.approx(tr.request_ms(), abs=1e-6)
+    assert tr.bucket == 8 and tr.fill == pytest.approx(5 / 8)
+    assert tr.batch_id is not None
+
+    reg = MetricsRegistry.get_or_create()
+    for phase in PHASES:
+        assert reg.histogram(f"serving.phase_ms.{phase}").count == 1
+        assert reg.histogram(f"serving.phase_ms.{phase}.m").count == 1
+        # the histogram observed the SAME decomposition the trace holds
+        assert reg.histogram(f"serving.phase_ms.{phase}").total == \
+            pytest.approx(ph[phase], abs=1e-6)
+    assert reg.histogram("serving.request_ms").total == \
+        pytest.approx(tr.request_ms(), abs=1e-6)
+
+    trace = flight_recorder().to_chrome_trace()
+    events = trace["traceEvents"]
+    req_span = next(e for e in events if e.get("ph") == "X"
+                    and e["name"] == "request:m")
+    batch_span = next(e for e in events if e.get("ph") == "X"
+                      and e["name"] == "batch:m")
+    assert req_span["args"]["trace_id"] == trace_id
+    assert req_span["args"]["flow_out"] == tr.flow_id
+    assert tr.flow_id in batch_span["args"]["flow_in"]
+    flow_ids = {e["id"] for e in events if e.get("ph") in ("s", "f")}
+    assert tr.flow_id in flow_ids
+    _assert_no_lane_overlap(trace)
+
+
+def test_suppressed_request_leaves_no_trace(plane_factory):
+    fitted, X = _make_fitted(8, 3, seed=0)
+    plane = plane_factory()
+    plane.start()
+    plane.admit("m", fitted, _sample(8))
+    with tracing_suppressed():
+        out, trace_id = plane.predict_traced("m", X[:3])
+    assert np.asarray(out).shape == (3, 3)
+    assert trace_id == ""
+    assert exemplar_reservoir().slowest(4) == []
+    reg = MetricsRegistry.get_or_create()
+    assert reg.histogram("serving.phase_ms.queue_wait").count == 0
+    # the coarse PR 15 funnels still fire on the untraced path
+    assert reg.histogram("serving.request_ms").count == 1
+    assert plane.slo.totals() == (1, 0)
+
+
+def test_rejection_increments_per_model_counter():
+    batcher = MicroBatcher(queue_depth=1, submit_timeout_s=0.01)
+    batcher.submit("alpha", np.zeros((1, 2)), 1)  # fills the only slot
+    with pytest.raises(QueueFullError):
+        batcher.submit("alpha", np.zeros((1, 2)), 1)
+    reg = MetricsRegistry.get_or_create()
+    assert reg.counter("serving.rejected_total").value == 1
+    assert reg.counter("serving.rejected_total.alpha").value == 1
+    batcher.close()
+
+
+# -- SLO accounting -----------------------------------------------------------
+
+def test_slo_policy_validates_and_computes_burn_rate():
+    p = SloPolicy(latency_threshold_ms=100, availability_target=0.9,
+                  window=10, min_count=5)
+    assert p.burn_rate(1.0) == 0.0
+    assert p.burn_rate(0.9) == pytest.approx(1.0)
+    assert p.burn_rate(0.8) == pytest.approx(2.0)
+    for bad in (dict(latency_threshold_ms=0),
+                dict(availability_target=1.0),
+                dict(availability_target=0.0),
+                dict(window=0),
+                dict(min_count=0),
+                dict(window=4, min_count=5)):
+        with pytest.raises(ValueError):
+            SloPolicy(**bad)
+
+
+def test_slo_cold_window_never_trips():
+    """min_count: 1 bad request out of 3 is not a 33% outage."""
+    tracker = SloTracker(SloPolicy(
+        latency_threshold_ms=10, availability_target=0.99,
+        window=16, min_count=8))
+    assert tracker.record("m", 50.0) is None  # slow, but window is cold
+    assert tracker.record("m", None, ok=False) is None
+    assert tracker.state()["violations"] == []
+    assert tracker.availability() == pytest.approx(0.0)
+
+
+def test_slo_trip_escalates_once_and_resets_window(tmp_path,
+                                                   monkeypatch):
+    monkeypatch.setenv("KEYSTONE_POSTMORTEM_DIR", str(tmp_path))
+    policy = SloPolicy(latency_threshold_ms=10,
+                       availability_target=0.95, window=8, min_count=4)
+    tracker = SloTracker(policy)
+    # a slow request lands in the reservoir first, so the post-mortem
+    # has an exemplar to embed
+    slow = _stamped(model="m", deltas=(0.02, 0.002, 0.003, 0.001))
+    exemplar_reservoir().offer(slow)
+    for _ in range(3):
+        tracker.record("m", 1.0)
+    tripped = tracker.record("m", 500.0)  # 3 good + 1 bad: 0.75 < 0.95
+    assert tripped is not None and tripped["model"] == "m"
+    assert tripped["window"]["count"] == 4
+    assert tripped["window"]["bad"] == 1
+    assert tripped["burn_rate"] == pytest.approx(
+        policy.burn_rate(0.75), abs=1e-4)
+    # the violated window RESET: the very next bad request cannot
+    # re-trip until the window refills to min_count
+    assert tracker.record("m", 500.0) is None
+    assert isinstance(tracker.last_violation, SloViolation)
+
+    pm_path = tripped["postmortem"]
+    assert pm_path and os.path.exists(pm_path)
+    with open(pm_path) as f:
+        pm = json.load(f)
+    ctx = pm["context"]
+    assert ctx["model"] == "m" and ctx["window"]["count"] == 4
+    exemplars = ctx["exemplars"]
+    assert exemplars and exemplars[0]["trace_id"] == slow.trace_id
+    assert exemplars[0]["phases_ms"]  # the span tree rode along
+
+    reg = MetricsRegistry.get_or_create()
+    assert reg.counter("serving.slo_violations_total").value == 1
+    assert reg.counter("slo.violation").value == 1
+    state = tracker.state()
+    assert len(state["violations"]) == 1
+    assert state["violations"][0]["postmortem"] == pm_path
+
+
+def test_slo_gauges_publish_aggregate_and_per_model():
+    tracker = SloTracker(SloPolicy(
+        latency_threshold_ms=10, availability_target=0.9,
+        window=8, min_count=8))
+    for _ in range(3):
+        tracker.record("a", 1.0)
+    tracker.record("b", 99.0)  # over threshold: bad
+    reg = MetricsRegistry.get_or_create()
+    assert reg.gauge("serving.availability").value == pytest.approx(0.75)
+    assert reg.gauge("serving.availability.b").value == 0.0
+    assert reg.gauge("serving.error_budget_burn_rate").value == \
+        pytest.approx(2.5)
+    state = tracker.state()
+    assert state["models"]["a"]["availability"] == 1.0
+    assert state["models"]["b"]["bad"] == 1
+    assert state["totals"] == {"good": 3, "bad": 1}
+
+
+# -- the HTTP surface ---------------------------------------------------------
+
+def test_http_trace_header_slo_and_debug_slow(plane_factory):
+    from keystone_tpu.serving.http import serve
+
+    fitted, X = _make_fitted(8, 3, seed=1)
+    plane = plane_factory(slo_policy=SloPolicy(
+        latency_threshold_ms=5000, availability_target=0.99,
+        window=16, min_count=4))
+    plane.start()
+    plane.admit("m", fitted, _sample(8))
+    server = serve(plane)
+    base = f"http://127.0.0.1:{server.server_port}"
+    try:
+        req = urllib.request.Request(
+            base + "/predict/m",
+            data=json.dumps({"instances": X[:3].tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as rsp:
+            header = rsp.headers.get("X-Keystone-Trace")
+            out = json.loads(rsp.read())
+        assert out["rows"] == 3
+        assert header and header.startswith("req-")
+
+        with urllib.request.urlopen(base + "/slo") as rsp:
+            slo = json.loads(rsp.read())
+        assert slo["availability"] == 1.0
+        assert slo["policy"]["availability_target"] == 0.99
+        assert slo["models"]["m"]["good"] == 1
+        assert slo["violations"] == []
+
+        with urllib.request.urlopen(base + "/debug/slow?n=2") as rsp:
+            slow = json.loads(rsp.read())
+        assert len(slow["slowest"]) == 1
+        tree = slow["slowest"][0]
+        assert tree["trace_id"] == header  # joins on the echoed header
+        assert sum(tree["phases_ms"].values()) == pytest.approx(
+            tree["request_ms"], abs=1e-2)
+
+        with urllib.request.urlopen(
+                base + "/debug/slow?n=4&model=ghost") as rsp:
+            assert json.loads(rsp.read())["slowest"] == []
+    finally:
+        server.shutdown()
+
+
+# -- submit/take/done under the deterministic scheduler -----------------------
+
+@pytest.mark.parametrize("schedule", [
+    {"picks": ["client-a", "client-b", "worker"] * 60},
+    {"picks": ["client-a", "client-a", "worker", "client-b"] * 60},
+    {"seed": 0}, {"seed": 1}, {"seed": 2}, {"seed": 3}, {"seed": 4},
+])
+def test_two_clients_race_worker_no_span_lost_or_crossed(schedule):
+    """Two clients race the ONE worker across submit/take/done on the
+    real TracedLock/TracedSemaphore yield points: every request's
+    future resolves with ITS OWN model's result (no cross-attribution),
+    every trace completes with its stamps in lifecycle order (no span
+    lost), and all trace ids stay distinct."""
+    from tests.sched import DeterministicScheduler
+
+    batcher = MicroBatcher(queue_depth=16, submit_timeout_s=5.0)
+    per_client = 3
+    requests = {"a": [], "b": []}
+    served = []
+
+    def client(model):
+        for _ in range(per_client):
+            requests[model].append(
+                batcher.submit_request(model, np.zeros((2, 4)), 2))
+
+    sched = DeterministicScheduler(**schedule)
+
+    def worker():
+        spins = 0
+        while len(served) < 2 * per_client and spins < 2000:
+            spins += 1
+            batch = batcher.take(max_rows=8, timeout_s=0.0)
+            sched.yield_point("worker-idle")
+            if not batch:
+                continue
+            t0 = time.perf_counter()
+            assert len({r.model for r in batch}) == 1  # same-model only
+            for req in batch:
+                if req.trace is not None:
+                    req.trace.dispatch_s = t0
+                    req.trace.done_s = time.perf_counter()
+                    req.trace.responded_s = time.perf_counter()
+                req.future.set_result(req.model)
+            batcher.done(len(batch))
+            served.extend(batch)
+
+    sched.spawn(client, "a", name="client-a")
+    sched.spawn(client, "b", name="client-b")
+    sched.spawn(worker, name="worker")
+    with sched:
+        sched.run()
+
+    assert len(served) == 2 * per_client  # no request lost
+    all_ids = set()
+    for model, reqs in requests.items():
+        assert len(reqs) == per_client
+        for req in reqs:
+            assert req.future.result(timeout=1) == model  # no crossing
+            tr = req.trace
+            assert tr is not None and tr.complete()
+            assert tr.model == model and tr.trace_id not in all_ids
+            all_ids.add(tr.trace_id)
+            assert tr.enqueued_s <= tr.taken_s <= tr.dispatch_s
+            assert sum(tr.phases_ms().values()) == pytest.approx(
+                tr.request_ms(), abs=1e-6)
+    batcher.close()

@@ -11,11 +11,21 @@ End-to-end, out of process — the exact deployment shape:
 3. wait for readiness (``/healthz`` 200) with a hard timeout — a hung
    warmup fails the gate, not the CI wall clock;
 4. drive requests across >= 2 request shapes (different buckets) and
-   BOTH models, checking response shapes;
+   BOTH models, checking response shapes AND that every predict
+   response carries a distinct non-empty ``X-Keystone-Trace`` header
+   (the PR 16 request-path handle round-trips end to end);
 5. scrape ``/metrics`` and assert ``keystone_compile_unexpected_total``
    is 0 — the server arms the warmup fence after admission, so ANY
    steady-state recompile shows up here — and that the serving
-   counters saw the traffic.
+   counters saw the traffic;
+6. scrape ``/slo`` and assert a clean run reports availability 1.0
+   with zero violations;
+7. IN PROCESS (FaultPlan is process-global, so the straggler cannot be
+   installed in the subprocess server): run a tight-policy plane under
+   a ``serve.dispatch`` straggler injection and assert the SLO trips —
+   a violation is recorded naming the model and the violated window,
+   and its post-mortem artifact exists on disk embedding the exemplar
+   span trees.
 
 Exit 0 clean; exit 1 with a named reason otherwise.
 """
@@ -141,8 +151,10 @@ def main() -> int:
         print(f"serving gate: ready on port {port} "
               f"(warming observed: {saw_warming})")
 
-        # 3. drive both models across >= 2 request shapes (buckets)
+        # 3. drive both models across >= 2 request shapes (buckets);
+        # every response must echo a distinct trace id header
         sent = 0
+        trace_ids = set()
         for name, (d, k) in DIMS.items():
             for n in (1, 3, 7, 11):  # buckets 8 and 16 on the sim mesh
                 payload = json.dumps(
@@ -153,15 +165,26 @@ def main() -> int:
                 for _ in range(3):
                     with urllib.request.urlopen(req, timeout=30) as rsp:
                         out = json.loads(rsp.read())
+                        trace_id = rsp.headers.get("X-Keystone-Trace")
                     preds = out.get("predictions")
                     if (out.get("rows") != n or len(preds) != n
                             or len(preds[0]) != k):
                         return _fail(
                             proc, f"bad predict response for {name} "
                                   f"n={n}: rows={out.get('rows')}")
+                    if not trace_id:
+                        return _fail(
+                            proc, f"predict response for {name} n={n} "
+                                  "carried no X-Keystone-Trace header")
+                    trace_ids.add(trace_id)
                     sent += 1
+        if len(trace_ids) != sent:
+            return _fail(
+                proc, f"trace ids not distinct: {len(trace_ids)} unique "
+                      f"across {sent} requests")
         print(f"serving gate: {sent} requests served across "
-              f"{len(DIMS)} models and 2 buckets")
+              f"{len(DIMS)} models and 2 buckets "
+              f"({len(trace_ids)} distinct trace ids)")
 
         # 4. the fence verdict: zero steady-state recompiles
         status, body = _get(base + "/metrics")
@@ -191,15 +214,107 @@ def main() -> int:
             return _fail(
                 proc, f"serving.requests_total={served:.0f} < "
                       f"{sent} requests the gate sent")
-        print(f"serving gate: PASS (requests={served:.0f}, "
-              "unexpected recompiles=0)")
-        return 0
+
+        # 5. a clean run's SLO surface: availability 1.0, no violations
+        status, body = _get(base + "/slo")
+        if status != 200:
+            return _fail(proc, f"/slo returned {status}")
+        slo = json.loads(body)
+        if slo.get("availability") != 1.0:
+            return _fail(
+                proc, f"clean run reports availability "
+                      f"{slo.get('availability')} != 1.0")
+        if slo.get("violations"):
+            return _fail(
+                proc, f"clean run reports {len(slo['violations'])} SLO "
+                      "violation(s)")
+        print(f"serving gate: /slo clean (availability=1.0, "
+              f"burn_rate={slo.get('burn_rate')})")
+        print(f"serving gate: PASS subprocess phase "
+              f"(requests={served:.0f}, unexpected recompiles=0)")
     finally:
         proc.terminate()
         try:
             proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             proc.kill()
+
+    # 6. the straggler phase, in process: inject a serve.dispatch
+    # straggler under a tight policy and require the SLO plane to do
+    # its whole job — trip, name the model and window, write the
+    # post-mortem with exemplars embedded
+    return _straggler_phase()
+
+
+def _straggler_phase() -> int:
+    import jax
+
+    import numpy as np
+
+    from keystone_tpu.nodes.learning.linear import LinearMapEstimator
+    from keystone_tpu.observability.slo import SloPolicy
+    from keystone_tpu.parallel.dataset import ArrayDataset
+    from keystone_tpu.resilience.faults import FaultPlan
+    from keystone_tpu.serving import ServingPlane
+
+    d, k = 16, 3
+    r = np.random.RandomState(7)
+    X = r.rand(96, d).astype(np.float32)
+    Y = r.rand(96, k).astype(np.float32)
+    fitted = LinearMapEstimator(lam=1e-3).with_data(
+        ArrayDataset.from_numpy(X), ArrayDataset.from_numpy(Y)).fit()
+    policy = SloPolicy(latency_threshold_ms=50.0,
+                       availability_target=0.95, window=8, min_count=8)
+    plane = ServingPlane(max_batch=16, slo_policy=policy)
+    plane.start()
+    try:
+        plane.admit("straggle", fitted,
+                    jax.ShapeDtypeStruct((d,), np.float32))
+        plane.predict("straggle", X[:4])  # clean warm request
+        with FaultPlan(0) as fp:
+            fp.add("serve.dispatch", kind="straggler", delay_s=0.2)
+            for _ in range(10):
+                plane.predict("straggle", X[:4], timeout_s=60.0)
+        violations = plane.slo.state()["violations"]
+        if not violations:
+            print("serving gate: FAIL: injected serve.dispatch "
+                  "straggler did not trip the SLO", file=sys.stderr)
+            return 1
+        v = violations[0]
+        if v.get("model") != "straggle" or "window" not in v:
+            print(f"serving gate: FAIL: violation names neither model "
+                  f"nor window: {v}", file=sys.stderr)
+            return 1
+        pm_path = v.get("postmortem")
+        if not pm_path or not os.path.exists(pm_path):
+            print(f"serving gate: FAIL: SLO violation wrote no "
+                  f"post-mortem artifact ({pm_path!r})", file=sys.stderr)
+            return 1
+        with open(pm_path) as f:
+            pm = json.load(f)
+        ctx = pm.get("context", {})
+        if ctx.get("model") != "straggle":
+            print("serving gate: FAIL: post-mortem context does not "
+                  f"name the model: {ctx.get('model')!r}",
+                  file=sys.stderr)
+            return 1
+        if not ctx.get("window", {}).get("count"):
+            print("serving gate: FAIL: post-mortem context does not "
+                  "carry the violated window", file=sys.stderr)
+            return 1
+        exemplars = ctx.get("exemplars") or []
+        if not any(e.get("model") == "straggle" and e.get("phases_ms")
+                   for e in exemplars):
+            print("serving gate: FAIL: post-mortem embeds no exemplar "
+                  "span tree for the slow model", file=sys.stderr)
+            return 1
+        print(f"serving gate: PASS (straggler tripped SLO: "
+              f"availability={v['window']['availability']}, "
+              f"post-mortem={os.path.basename(pm_path)}, "
+              f"{len(exemplars)} exemplars)")
+        return 0
+    finally:
+        plane.close()
 
 
 if __name__ == "__main__":
