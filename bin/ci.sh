@@ -10,7 +10,16 @@
 #                                  collective divergence, barrier/
 #                                  coordination-shape stability,
 #                                  collective axis bindings, world-
-#                                  checkpoint consistency) + the
+#                                  checkpoint consistency, and the
+#                                  hot-path + atomic-publication passes:
+#                                  interprocedural request-path
+#                                  reachability from the @hotpath entry
+#                                  points, blocking/host-sync/IO/lazy-
+#                                  import/unbounded-growth/lock-held-
+#                                  dispatch hazards, @published_by swap
+#                                  discipline — the full-tree scan is
+#                                  wall-budgeted and its runtime is
+#                                  printed in the gate output) + the
 #                                  eval_shape donation shape gate (+ ruff
 #                                  if present)
 #   2. python -m keystone_tpu check --all --budget $KEYSTONE_CI_HBM_BUDGET
@@ -19,7 +28,8 @@
 #                                  static HBM plans over every CHECK_APPS
 #                                  app + the concurrency scan + the
 #                                  metric-name-drift scan + the SPMD
-#                                  scan (the `spmd` key in --json),
+#                                  scan (the `spmd` key in --json) +
+#                                  the hot-path scan (the `hotpath` key),
 #                                  device-free; exit 1 on diagnostics,
 #                                  exit 2 on a predicted budget violation
 #   2a. benchdiff (ADVISORY)       classify the two newest artifacts of
@@ -82,7 +92,7 @@ fi
 
 BUDGET="${KEYSTONE_CI_HBM_BUDGET:-16GiB}"
 
-echo "== ci: lint (AST rules + donation shape gate) =="
+echo "== ci: lint (AST rules + hot-path/publication passes + donation shape gate) =="
 "$PY" "$KEYSTONE_HOME/tools/lint.py" --skip-apps
 
 echo "== ci: static pipeline checks + HBM plans (budget $BUDGET) =="

@@ -36,7 +36,12 @@ scan (guarded-by races, lock-order cycles, blocking-under-lock;
 ``analysis/concurrency.py``) and the tree-wide SPMD-safety scan
 (collective divergence, barrier/coordination-shape stability,
 collective axis bindings, world-checkpoint consistency;
-``analysis/spmd.py``), without loading data or allocating a
+``analysis/spmd.py``) and the tree-wide hot-path scan
+(interprocedural request-path reachability from the ``@hotpath``
+serving entry points — blocking/host-sync/IO/lazy-import/unbounded-
+growth/lock-held-dispatch hazards — plus the ``@published_by``
+atomic-publication pass; ``analysis/hotpath.py``, the ``hotpath``
+key in ``--json``), without loading data or allocating a
 device buffer, and exits non-zero if any diagnostic fires.
 ``--budget BYTES`` (``MiB``/``GiB`` suffixes accepted) gates each app
 on its planned fit-path peak and exits 2 on a predicted violation.
@@ -183,9 +188,20 @@ def check_main(rest) -> int:
     for hit in spmd:
         print(f"{hit['file']}:{hit['lineno']}: {hit['code']}: "
               f"{hit['message']}", file=sys.stderr)
+    # hot-path safety: every call reachable from a @hotpath serving
+    # entry point classified for blocking/host-sync/IO/lazy-import/
+    # unbounded-growth/lock-held-dispatch hazards, plus the
+    # @published_by atomic-publication discipline (analysis/hotpath.py)
+    # — the request path's latency invariants, checked device-free
+    from keystone_tpu.analysis.hotpath import scan_package as scan_hotpath
+
+    hotpath = scan_hotpath(pkg_root)
+    for hit in hotpath:
+        print(f"{hit['file']}:{hit['lineno']}: {hit['code']}: "
+              f"{hit['message']}", file=sys.stderr)
 
     failed = ((1 if concurrency else 0) + (1 if metrics_names else 0)
-              + (1 if spmd else 0))
+              + (1 if spmd else 0) + (1 if hotpath else 0))
     over_budget = 0
     reports = []
     for build in builders:
@@ -219,6 +235,7 @@ def check_main(rest) -> int:
     print(f"concurrency: {'clean' if not concurrency else f'{len(concurrency)} diagnostic(s)'}")
     print(f"metrics names: {'clean' if not metrics_names else f'{len(metrics_names)} diagnostic(s)'}")
     print(f"spmd: {'clean' if not spmd else f'{len(spmd)} diagnostic(s)'}")
+    print(f"hotpath: {'clean' if not hotpath else f'{len(hotpath)} diagnostic(s)'}")
     if json_out is not None:
         import json as _json
 
@@ -233,11 +250,13 @@ def check_main(rest) -> int:
             blob["concurrency"] = concurrency
             blob["metrics_names"] = metrics_names
             blob["spmd"] = spmd
+            blob["hotpath"] = hotpath
         else:
             blob = {"apps": [_dump(r) for r in reports],
                     "concurrency": concurrency,
                     "metrics_names": metrics_names,
-                    "spmd": spmd}
+                    "spmd": spmd,
+                    "hotpath": hotpath}
         with open(json_out, "w") as f:
             f.write(_json.dumps(blob, indent=2))
         print(f"report written to {json_out}", file=sys.stderr)

@@ -30,6 +30,14 @@ from .diagnostics import (
     check_graph,
     check_pipeline,
 )
+from .hotpath import (
+    HOTPATH_SCAN_BUDGET_S,
+    build_package,
+    hotpath_hazards,
+    published_field_hazards,
+    scan_package as scan_package_hotpath,
+    scan_source as scan_source_hotpath,
+)
 from .interpreter import Analysis, analyze
 from .resources import (
     HbmPlan,
@@ -62,6 +70,7 @@ __all__ = [
     "DatasetSpec",
     "DatumSpec",
     "Diagnostic",
+    "HOTPATH_SCAN_BUDGET_S",
     "HbmPlan",
     "ResourceEffect",
     "SparseSpec",
@@ -74,6 +83,7 @@ __all__ = [
     "as_input_spec",
     "barrier_stability",
     "blocking_under_lock",
+    "build_package",
     "check_graph",
     "check_pipeline",
     "collective_axis_bindings",
@@ -81,10 +91,14 @@ __all__ = [
     "find_lock_cycles",
     "guarded_field_races",
     "guarded_sequence_hazards",
+    "hotpath_hazards",
     "lock_order_edges",
     "plan_graph",
+    "published_field_hazards",
     "scan_package",
+    "scan_package_hotpath",
     "scan_package_spmd",
+    "scan_source_hotpath",
     "sharding_flow_lint",
     "spec_dataset",
     "world_checkpoint_consistency",

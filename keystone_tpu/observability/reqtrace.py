@@ -51,7 +51,7 @@ import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
-from ..utils.guarded import guarded_by
+from ..utils.guarded import guarded_by, hotpath, published_by
 
 #: the phase vocabulary, in lifecycle order (``drift_score`` is a
 #: BATCH-level phase scored after futures resolve — deliberately outside
@@ -162,6 +162,7 @@ class ReqTrace:
         return f"req-{_PID_HEX}-{self.flow_id:x}"
 
     @classmethod
+    @hotpath
     def new(cls, model: str, n: int) -> "ReqTrace":
         return cls(next(_SEQ), model, int(n), time.perf_counter())
 
@@ -225,6 +226,7 @@ def _env_cap() -> int:
     return cap
 
 
+@published_by("_lock", "_floor")
 @guarded_by("_lock", "_by_model")
 class ExemplarReservoir:
     """Slowest-N completed traces per model (N =
@@ -246,11 +248,13 @@ class ExemplarReservoir:
         # GIL-atomic, a stale floor only costs one lock round-trip,
         # and steady state is exactly the case where almost every
         # offer is slower than nothing retained — so the common path
-        # is a lock-free dict probe. Deliberately outside the
-        # ``@guarded_by`` contract for that reason.
+        # is a lock-free dict probe. Declared ``@published_by`` (not
+        # guarded): the publication pass holds every write to an
+        # atomic flip under the lock.
         self._floor: Dict[str, float] = {}
         self._lock = threading.Lock()
 
+    @hotpath
     def offer(self, trace: ReqTrace) -> bool:
         """Retain ``trace`` if it is among the slowest ``cap`` seen for
         its model; returns whether it was kept. The common refusal

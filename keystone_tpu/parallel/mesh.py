@@ -14,12 +14,15 @@ from __future__ import annotations
 import contextlib
 import os
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..observability.timeline import record_span
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
@@ -60,8 +63,6 @@ def get_mesh() -> Mesh:
     global _global_mesh
     with _lock:
         if _global_mesh is None:
-            import os
-
             raw = os.environ.get("KEYSTONE_MESH_MODEL") or "1"
             try:
                 model = int(raw)
@@ -236,8 +237,6 @@ def shard_put(arr, sharding: NamedSharding, pool=None):
     With ``pool=None`` or a single addressable device this is exactly
     ``jax.device_put(arr, sharding)``.
     """
-    import jax
-
     if pool is None:
         return jax.device_put(arr, sharding)
     try:
@@ -253,13 +252,9 @@ def shard_put(arr, sharding: NamedSharding, pool=None):
         # put is async; the span covers dispatch + host-side slicing,
         # which is what the lane occupancy shows (transfer completion
         # is the device's business).
-        import time as _time
-
-        from ..observability.timeline import record_span
-
-        t0 = _time.perf_counter()
+        t0 = time.perf_counter()
         out = jax.device_put(slice_, dev)
-        record_span("h2d", "h2d", t0, _time.perf_counter() - t0,
+        record_span("h2d", "h2d", t0, time.perf_counter() - t0,
                     args={"nbytes": int(getattr(slice_, "nbytes", 0)),
                           "device": str(dev)})
         return out

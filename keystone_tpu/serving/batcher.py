@@ -35,10 +35,12 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Deque, List, Optional, Tuple
 
+from ..observability.metrics import MetricsRegistry
 from ..observability.reqtrace import ReqTrace, tracing_active
 from ..parallel.dataset import padded_rows
 from ..resilience.faults import inject
-from ..utils.guarded import TracedLock, TracedSemaphore, guarded_by
+from ..utils.guarded import (TracedLock, TracedSemaphore, guarded_by,
+                             hotpath, published_by)
 
 
 class QueueFullError(RuntimeError):
@@ -103,9 +105,16 @@ class Request:
     trace: Optional[ReqTrace] = None
 
 
-@guarded_by("_lock", "_pending", "_closed")
+@published_by("_lock", "_closed")
+@guarded_by("_lock", "_pending")
 class MicroBatcher:
-    """Slot-gated bounded request queue; see module docstring."""
+    """Slot-gated bounded request queue; see module docstring.
+
+    ``_closed`` is *published* rather than guarded: :meth:`submit_request`
+    reads it lock-free before paying the slot gate, so a closed batcher
+    refuses instantly instead of blocking the submit timeout and
+    mis-reporting shutdown as a 429. Writes stay atomic rebinds under
+    the lock (the publication pass checks this)."""
 
     def __init__(self, queue_depth: int = 128,
                  submit_timeout_s: float = 2.0):
@@ -120,6 +129,7 @@ class MicroBatcher:
         self._ready = threading.Event()
 
     # -- producer side (handler threads) -----------------------------------
+    @hotpath
     def submit(self, model: str, x: Any, n: int,
                timeout_s: Optional[float] = None) -> Future:
         """Enqueue one request behind the slot gate; returns its
@@ -128,16 +138,20 @@ class MicroBatcher:
         honest 429 than an unbounded wait)."""
         return self.submit_request(model, x, n, timeout_s=timeout_s).future
 
+    @hotpath
     def submit_request(self, model: str, x: Any, n: int,
                        timeout_s: Optional[float] = None) -> Request:
         """:meth:`submit`, returning the whole :class:`Request` — the
         trace-aware spelling (the HTTP surface echoes
         ``request.trace.trace_id`` back as ``X-Keystone-Trace``)."""
         inject("serve.enqueue", context=model)
+        # lock-free published read: a closed batcher refuses BEFORE the
+        # slot gate, so shutdown never costs callers the submit timeout
+        # nor masquerades as a QueueFullError 429
+        if self._closed:
+            raise RuntimeError("batcher is closed")
         timeout = self.submit_timeout_s if timeout_s is None else timeout_s
         if not self._slots.acquire(timeout=timeout):
-            from ..observability.metrics import MetricsRegistry
-
             reg = MetricsRegistry.get_or_create()
             reg.counter("serving.rejected_total").inc()
             # the per-model family: a 429 storm names its model
@@ -161,13 +175,12 @@ class MicroBatcher:
             self._pending.append(req)
             depth = len(self._pending)
         self._ready.set()
-        from ..observability.metrics import MetricsRegistry
-
         MetricsRegistry.get_or_create().gauge(
             "serving.queue_depth").set(depth)
         return req
 
     # -- consumer side (the plane worker) ----------------------------------
+    @hotpath
     def take(self, max_rows: int, timeout_s: float = 0.05) -> List[Request]:
         """Pop the oldest pending request plus every later SAME-model
         request that fits within ``max_rows`` total rows; requests for
@@ -200,12 +213,11 @@ class MicroBatcher:
             if req.trace is not None:
                 # queue_wait ends here; the worker owns later stamps
                 req.trace.taken_s = taken_s
-        from ..observability.metrics import MetricsRegistry
-
         MetricsRegistry.get_or_create().gauge(
             "serving.queue_depth").set(depth)
         return out
 
+    @hotpath
     def done(self, count: int) -> None:
         """Free ``count`` slots once their requests' futures resolved —
         the release half of the staging discipline: live queue

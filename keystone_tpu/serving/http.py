@@ -57,6 +57,7 @@ from ..observability.metrics import MetricsRegistry
 from ..observability.reqtrace import exemplar_reservoir
 from ..observability.sampler import _MetricsHandler, _MetricsServer
 from ..observability.slo import SloPolicy
+from ..utils.guarded import hotpath
 from .batcher import QueueFullError
 from .plane import ModelNotAdmitted, ModelWarming, ServingPlane
 from .residency import AdmissionError
@@ -98,6 +99,7 @@ class ServingHandler(_MetricsHandler):
             return
         super().do_GET()
 
+    @hotpath
     def do_POST(self):  # noqa: N802 (stdlib handler API)
         path = self.path.split("?")[0]
         if not path.startswith("/predict/"):

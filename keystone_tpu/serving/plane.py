@@ -57,11 +57,16 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
+import jax
 import numpy as np
 
-from ..observability.reqtrace import exemplar_reservoir, mint_flow_id
+from ..observability.metrics import MetricsRegistry
+from ..observability.reqtrace import (PHASES, exemplar_reservoir,
+                                      mint_flow_id)
 from ..observability.timeline import flight_recorder
-from ..utils.guarded import TracedLock, guarded_by
+from ..parallel.dataset import ArrayDataset, Dataset, bucketed_dataset
+from ..resilience.faults import inject
+from ..utils.guarded import TracedLock, guarded_by, hotpath, published_by
 from .batcher import BucketPolicy, MicroBatcher, Request
 from .residency import AdmissionError, ModelCharge, ResidencyLedger, model_charge
 
@@ -158,8 +163,6 @@ class _EvictedModel:
 
 
 def _zeros_batch(sample: Any, rows: int) -> Any:
-    import jax
-
     return jax.tree_util.tree_map(
         lambda leaf: np.zeros((rows,) + tuple(leaf.shape),
                               np.dtype(leaf.dtype)),
@@ -206,8 +209,6 @@ def _apply_weight_dtype(graph: Any, weight_dtype: Optional[str]) -> int:
 def _evicted_record(entry: ServedModel) -> _EvictedModel:
     """Host-side remainder for one eviction (also counts it); the dict
     mutations stay inline at the call sites, under the plane lock."""
-    from ..observability.metrics import MetricsRegistry
-
     MetricsRegistry.get_or_create().counter(
         "serving.evictions_total").inc()
     return _EvictedModel(blob=entry.blob, sample=entry.sample,
@@ -225,12 +226,20 @@ def _find_baseline(graph: Any) -> Any:
     return None
 
 
+@published_by("_lock", "_live")
 @guarded_by("_lock", "_models", "_evicted", "_warming", "_expected",
             "_admitted_total")
 class ServingPlane:
     """Warm multi-model serving under an HBM budget; see module
     docstring. Usable as a context manager (``close`` disarms the
-    steady-state fence and stops the worker)."""
+    steady-state fence and stops the worker).
+
+    ``_live`` is the PUBLISHED ready-model snapshot: a fresh dict
+    rebuilt and rebound in one reference flip by
+    :meth:`_publish_locked` every time residency changes, read
+    LOCK-FREE by :meth:`submit_request`'s fast path — the same swap
+    discipline ROADMAP item 1's versioned hot-swap must follow (the
+    publication pass in ``analysis/hotpath.py`` checks it)."""
 
     def __init__(self, hbm_budget: Optional[float] = None,
                  max_batch: int = 64, queue_depth: int = 128,
@@ -254,6 +263,9 @@ class ServingPlane:
         self.default_weight_dtype = default_weight_dtype
         self.steady_fence = steady_fence
         self._models: Dict[str, ServedModel] = {}
+        #: published lock-free snapshot of the READY residents; only
+        #: ever rebound whole under the lock (_publish_locked / close)
+        self._live: Dict[str, ServedModel] = {}
         self._evicted: Dict[str, _EvictedModel] = {}
         self._warming = 0
         self._expected = 0
@@ -276,8 +288,6 @@ class ServingPlane:
         self._phase_reg: Any = None
         self._phase_hists: Dict[str, Dict[str, Tuple[Any, Any]]] = {}
         if hbm_budget is not None:
-            from ..observability.metrics import MetricsRegistry
-
             MetricsRegistry.get_or_create().gauge(
                 "serving.hbm_budget_bytes").set(float(hbm_budget))
 
@@ -306,6 +316,9 @@ class ServingPlane:
         process's later compiles as serving recompiles)."""
         with self._lock:
             self._closed = True
+            # atomic flip: lock-free submitters fall to the locked slow
+            # path, which sees _closed and the batcher refusal
+            self._live = {}
             worker = self._worker
             self._worker = None
             self._stop.set()
@@ -403,6 +416,10 @@ class ServingPlane:
                 dropped = self._models.pop(victim)
                 self.ledger.release(victim)
                 self._evicted[victim] = _evicted_record(dropped)
+                # drop the victim's cached phase-histogram handles too:
+                # admit/evict churn must not leak one entry per model
+                # name ever served (hotpath-unbounded-growth finding)
+                self._phase_hists.pop(victim, None)
             # the backstop: the ledger re-checks atomically and raises
             # without mutating if the plan raced anything
             self.ledger.admit(name, charge.total_nbytes())
@@ -427,8 +444,6 @@ class ServingPlane:
             self._finish_warmup(entry, ok=False,
                                 restore_evicted=prior_evicted)
             raise
-        from ..observability.metrics import MetricsRegistry
-
         MetricsRegistry.get_or_create().histogram(
             "serving.warmup_s").observe(entry.warmup_s)
         self._finish_warmup(entry, ok=True)
@@ -449,6 +464,7 @@ class ServingPlane:
             else:
                 self._models.pop(entry.name, None)
                 self.ledger.release(entry.name)
+                self._phase_hists.pop(entry.name, None)
                 if restore_evicted is not None:
                     self._evicted[entry.name] = restore_evicted
             self._warming -= 1
@@ -464,6 +480,10 @@ class ServingPlane:
             entry = self._models.pop(name)
             self.ledger.release(name)
             self._evicted[name] = _evicted_record(entry)
+            # the cached histogram handles go with the model (the leak
+            # the first hotpath tree scan found: one entry per model
+            # name ever served, never pruned)
+            self._phase_hists.pop(name, None)
             self._publish_locked()
 
     def readmit(self, name: str) -> ServedModel:
@@ -490,8 +510,6 @@ class ServingPlane:
         if budget is None:
             return []
         if needed > budget:
-            from ..observability.metrics import MetricsRegistry
-
             MetricsRegistry.get_or_create().counter(
                 "serving.admission_rejected_total").inc()
             mib = 1 << 20
@@ -524,8 +542,6 @@ class ServingPlane:
         kept_bytes = pinned_bytes + sum(self.ledger.charge_of(n)
                                         for n in keep)
         if kept_bytes + needed > budget:
-            from ..observability.metrics import MetricsRegistry
-
             MetricsRegistry.get_or_create().counter(
                 "serving.admission_rejected_total").inc()
             mib = 1 << 20
@@ -547,11 +563,14 @@ class ServingPlane:
             self._fence_armed = True
 
     def _publish_locked(self) -> None:
-        from ..observability.metrics import MetricsRegistry
-
+        """Republish derived residency state (lock held): the gauges,
+        and the lock-free ``_live`` snapshot — built FRESH and bound in
+        one reference flip, never mutated in place (the atomic-
+        publication discipline; readers see the old dict or the new
+        one, never a half-updated hybrid)."""
+        self._live = {n: e for n, e in self._models.items() if e.ready}
         reg = MetricsRegistry.get_or_create()
-        reg.gauge("serving.models_resident").set(
-            sum(1 for e in self._models.values() if e.ready))
+        reg.gauge("serving.models_resident").set(len(self._live))
         reg.gauge("serving.models_warming").set(self._warming)
 
     # -- warmup ------------------------------------------------------------
@@ -587,6 +606,7 @@ class ServingPlane:
                     break
 
     # -- request path ------------------------------------------------------
+    @hotpath
     def submit(self, name: str, x: Any,
                timeout_s: Optional[float] = None):
         """Enqueue one request; returns a Future resolving to the model
@@ -595,30 +615,39 @@ class ServingPlane:
         them, up to the largest bucket."""
         return self.submit_request(name, x, timeout_s=timeout_s).future
 
+    @hotpath
     def submit_request(self, name: str, x: Any,
                        timeout_s: Optional[float] = None) -> Request:
         """:meth:`submit`, returning the whole
         :class:`~.batcher.Request` — ``request.trace`` carries the
         request-path span record (trace id, phase stamps)."""
-        with self._lock:
-            entry = self._models.get(name)
-            if entry is None:
-                known = sorted(self._models) + [
-                    f"{k} (evicted)" for k in sorted(self._evicted)]
-                raise ModelNotAdmitted(
-                    f"model {name!r} is not resident "
-                    f"(known: {known or 'none'})")
-            if not entry.ready:
-                raise ModelWarming(f"model {name!r} is still warming")
-            sample = entry.sample
-        x_tree, n = self._normalize(name, sample, x)
+        # lock-free fast path over the published ready snapshot: the
+        # steady-state request pays no plane-lock acquire (and never
+        # queues behind an admission holding it); misses fall to the
+        # locked slow path for the accurate warming-vs-unknown verdict
+        entry = self._live.get(name)
+        if entry is None:
+            with self._lock:
+                entry = self._models.get(name)
+                if entry is None:
+                    known = sorted(self._models) + [
+                        f"{k} (evicted)" for k in sorted(self._evicted)]
+                    raise ModelNotAdmitted(
+                        f"model {name!r} is not resident "
+                        f"(known: {known or 'none'})")
+                if not entry.ready:
+                    raise ModelWarming(
+                        f"model {name!r} is still warming")
+        x_tree, n = self._normalize(name, entry.sample, x)
         return self.batcher.submit_request(name, x_tree, n,
                                            timeout_s=timeout_s)
 
+    @hotpath
     def predict(self, name: str, x: Any, timeout_s: float = 60.0):
         """Synchronous convenience: submit + wait."""
         return self.submit(name, x).result(timeout=timeout_s)
 
+    @hotpath
     def predict_traced(self, name: str, x: Any, timeout_s: float = 60.0):
         """:meth:`predict`, returning ``(output, trace_id)`` —
         ``trace_id`` is ``""`` when tracing is suppressed/disabled.
@@ -630,8 +659,6 @@ class ServingPlane:
 
     def _normalize(self, name: str, sample: Any,
                    x: Any) -> Tuple[Any, int]:
-        import jax
-
         structs = jax.tree_util.tree_leaves(
             sample,
             is_leaf=lambda s: isinstance(s, jax.ShapeDtypeStruct))
@@ -670,8 +697,6 @@ class ServingPlane:
 
     @staticmethod
     def _as_sample_struct(sample: Any) -> Any:
-        import jax
-
         if isinstance(sample, jax.ShapeDtypeStruct):
             return sample
         if (isinstance(sample, tuple) and len(sample) == 2
@@ -690,11 +715,10 @@ class ServingPlane:
 
     # -- execution ---------------------------------------------------------
     def _bucketed(self, entry: ServedModel, x_tree: Any, n: int):
-        from ..parallel.dataset import bucketed_dataset
-
         bucket = self.policy.bucket_for(max(n, 1), self._shards)
         return bucketed_dataset(x_tree, n, bucket, self.mesh)
 
+    @hotpath
     def _execute(self, entry: ServedModel, x_tree: Any, n: int):
         """One padded-bucket apply; returns ``(outputs, ds)`` where
         outputs carries exactly ``n`` rows (pad stripped)."""
@@ -706,8 +730,6 @@ class ServingPlane:
         program over an already-bucketed dataset and block until the
         host holds the result — the ``dispatch`` phase of the request
         trace is exactly this call."""
-        from ..parallel.dataset import ArrayDataset, Dataset
-
         out = entry.fitted.apply(ds).get()
         if isinstance(out, ArrayDataset):
             return out.numpy()
@@ -733,11 +755,10 @@ class ServingPlane:
     def _phase_instruments(self, name: str) -> Dict[str, Tuple[Any, Any]]:
         """``phase -> (aggregate, per-model)`` histogram pairs for one
         model, resolved on first use and cached for the worker's hot
-        loop. Invalidated wholesale when the metrics registry instance
-        changes (test harnesses reset it between cases)."""
-        from ..observability.metrics import MetricsRegistry
-        from ..observability.reqtrace import PHASES
-
+        loop. Entries leave the cache when their model leaves the plane
+        (evict / admission victims / warmup rollback). Invalidated
+        wholesale when the metrics registry instance changes (test
+        harnesses reset it between cases)."""
         reg = MetricsRegistry.get_or_create()
         if reg is not self._phase_reg:
             self._phase_reg = reg
@@ -765,12 +786,8 @@ class ServingPlane:
                 # telemetry (spans + phase observes) off the hot path
                 flight_recorder().flush()
 
+    @hotpath
     def _serve_batch(self, requests: List[Request]) -> None:
-        import jax
-
-        from ..observability.metrics import MetricsRegistry
-        from ..resilience.faults import inject
-
         name = requests[0].model
         reg = MetricsRegistry.get_or_create()
         try:
@@ -935,8 +952,6 @@ class ServingPlane:
 
     @staticmethod
     def _slice_rows(outputs: Any, offset: int, n: int) -> Any:
-        import jax
-
         if isinstance(outputs, list):  # host collect() output
             return outputs[offset:offset + n]
         return jax.tree_util.tree_map(
@@ -963,7 +978,5 @@ class ServingPlane:
         """The ``compile.unexpected_total`` counter — with the
         steady-state fence armed, any nonzero delta across a serving
         window is a recompile bug, not noise."""
-        from ..observability.metrics import MetricsRegistry
-
         return MetricsRegistry.get_or_create().counter(
             "compile.unexpected_total").value

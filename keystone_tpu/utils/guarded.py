@@ -104,6 +104,59 @@ def guarded_fields(cls) -> Dict[str, str]:
     return out
 
 
+def published_by(lock_attr: str, *fields: str):
+    """Class decorator declaring ``fields`` PUBLISHED under
+    ``self.<lock_attr>``: read lock-free on the serving hot path,
+    mutated only via single-reference atomic flips (a whole rebind, one
+    subscript store, or a single-key pop/del) while the declared lock
+    is held. The stronger sibling of :func:`guarded_by` — a guarded
+    field may not be touched outside the lock at all; a published field
+    trades that for a strict write discipline so readers never need the
+    lock. The static publication passes
+    (:mod:`keystone_tpu.analysis.hotpath`) read the declaration off the
+    AST; at runtime the merged map is ``cls.__published_fields__``.
+
+    Usage::
+
+        @published_by("_lock", "_live")
+        class ServingPlane: ...
+
+    Methods whose names end in ``_locked`` are treated by the analyzer
+    as running with the declared lock held (the repo's ``*_locked``
+    calling convention, same idea as clang's capability annotations).
+    """
+    if not fields:
+        raise ValueError("published_by needs at least one field name")
+
+    def wrap(cls):
+        merged: Dict[str, str] = {}
+        for klass in reversed(cls.__mro__):
+            merged.update(getattr(klass, "__published_fields__", {}))
+        merged.update({f: lock_attr for f in fields})
+        cls.__published_fields__ = merged
+        return cls
+
+    return wrap
+
+
+def published_fields(cls) -> Dict[str, str]:
+    """The merged field->lock publication declaration for ``cls``."""
+    return dict(getattr(cls, "__published_fields__", {}))
+
+
+def hotpath(fn):
+    """Marker decorator declaring a function/method a REQUEST-PATH
+    ENTRY POINT: everything statically reachable from it is scanned by
+    the hot-path hazard passes (:mod:`keystone_tpu.analysis.hotpath`)
+    for blocking primitives, host-device syncs, I/O, lazy imports,
+    unbounded growth, and locks held across device dispatch. Runtime
+    cost: zero — the decorator only stamps an attribute (the analyzer
+    reads the decoration off the AST; the attribute is for
+    introspection and tests)."""
+    fn.__hotpath_entry__ = True
+    return fn
+
+
 # -- scheduler hook ----------------------------------------------------------
 
 #: when set (tests/sched.py), every TracedLock/TracedSemaphore operation

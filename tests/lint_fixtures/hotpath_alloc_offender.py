@@ -1,0 +1,29 @@
+"""Synthetic offender for ``hotpath-unbounded-growth``
+(``analysis.hotpath.hotpath_hazards``): a ``@hotpath`` entry appending
+to a ``self`` container the class never shrinks anywhere and never
+bounds — the ``_phase_hists`` leak shape the first tree scan found in
+``ServingPlane`` (fixed in PR 17 by pruning at evict/admit-victim/
+warmup-rollback). The sibling field with a drain path, and the
+``deque(maxlen=...)`` field, pin the two non-firing shapes. Never
+imported by the package; parsed/compiled by tests only."""
+from collections import deque
+
+from keystone_tpu.utils.guarded import hotpath
+
+
+class LeakyLedger:
+    def __init__(self):
+        self._seen = []
+        self._seen_index = {}
+        self._retired = []
+        self._recent = deque(maxlen=64)
+
+    @hotpath
+    def record(self, rid):
+        self._seen.append(rid)  # hotpath-unbounded-growth: no drain path
+        self._retired.append(rid)  # clean: retire() pops it
+        self._recent.append(rid)  # clean: deque(maxlen=) declares a bound
+        self._seen_index[rid] = True  # hotpath-unbounded-growth: keyed store
+
+    def retire(self):
+        return self._retired.pop()

@@ -1,0 +1,36 @@
+"""Synthetic offender for ``hotpath-blocking``
+(``analysis.hotpath.hotpath_hazards``): a class whose ``@hotpath``
+entry points reach blocking primitives — a semaphore acquire, an event
+wait, a future ``.result``, a queue ``.get``, and (through a helper,
+pinning the interprocedural chain naming) a ``sleep``. Never imported
+by the package; parsed/compiled by tests only."""
+import threading
+import time
+
+from keystone_tpu.utils.guarded import hotpath
+
+
+class SlowGate:
+    def __init__(self):
+        self._slots = threading.Semaphore(4)
+        self._done = threading.Event()
+
+    @hotpath
+    def handle(self, fut):
+        self._slots.acquire()  # hotpath-blocking: semaphore backpressure
+        self._done.wait(1.0)  # hotpath-blocking: event wait
+        return fut.result()  # hotpath-blocking: future join
+
+    @hotpath
+    def drain(self, q):
+        return q.get()  # hotpath-blocking: queue get
+
+    @hotpath
+    def submit(self, item):
+        # clean at this line — the hazard is INSIDE the helper, and the
+        # diagnostic must name the chain SlowGate.submit -> SlowGate._stall
+        return self._stall(item)
+
+    def _stall(self, item):
+        time.sleep(0.01)  # hotpath-blocking, reached interprocedurally
+        return item

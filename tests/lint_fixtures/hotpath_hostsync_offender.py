@@ -1,0 +1,18 @@
+"""Synthetic offender for ``hotpath-host-sync``
+(``analysis.hotpath.hotpath_hazards``): a ``@hotpath`` entry that
+coerces through a numpy alias (the silent device->host drag), calls
+``block_until_ready`` (the explicit round trip), and ``device_put``
+(the H2D half). Never imported by the package; parsed/compiled by
+tests only."""
+import numpy as np
+
+from keystone_tpu.utils.guarded import hotpath
+
+
+class SyncyPlane:
+    @hotpath
+    def respond(self, out, sharding):
+        host = np.asarray(out)  # hotpath-host-sync: implicit coercion
+        out.block_until_ready()  # hotpath-host-sync: explicit sync
+        staged = out.device_put(sharding)  # hotpath-host-sync: transfer
+        return host, staged

@@ -1,0 +1,16 @@
+"""Synthetic offender for ``hotpath-lazy-import``
+(``analysis.hotpath.hotpath_hazards``): a ``@hotpath`` entry executing
+an ``import`` statement per request — the exact shape the first tree
+scan found on the real serving path (per-request ``MetricsRegistry``
+imports in the batcher, per-shard ``record_span`` imports in
+``shard_put``), fixed by hoisting in PR 17. Never imported by the
+package; parsed/compiled by tests only."""
+from keystone_tpu.utils.guarded import hotpath
+
+
+class LazyLoader:
+    @hotpath
+    def predict(self, x):
+        import json  # hotpath-lazy-import: per-request import machinery
+
+        return json.dumps(x)

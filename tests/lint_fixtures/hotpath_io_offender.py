@@ -1,0 +1,18 @@
+"""Synthetic offender for ``hotpath-io``
+(``analysis.hotpath.hotpath_hazards``): a ``@hotpath`` entry doing
+filesystem, console, and serialization I/O per request — ``open``,
+``.read``, ``print``, and a ``pickle`` round trip through the module
+alias table. Never imported by the package; parsed/compiled by tests
+only."""
+import pickle
+
+from keystone_tpu.utils.guarded import hotpath
+
+
+class ChattyHandler:
+    @hotpath
+    def handle(self, path):
+        print("request", path)  # hotpath-io: console write per request
+        with open(path, "rb") as f:  # hotpath-io: filesystem open
+            raw = f.read()  # hotpath-io: file read
+        return pickle.loads(raw)  # hotpath-io: deserialization

@@ -1,0 +1,34 @@
+"""Synthetic offender for ``hotpath-lock-held-dispatch``
+(``analysis.hotpath.hotpath_hazards``): a ``@hotpath`` entry that
+calls a helper while holding ``self._lock`` — and the helper
+TRANSITIVELY syncs with the device (``block_until_ready`` one more hop
+down), so every thread contending the lock stalls for the device round
+trip. The unlocked call to the same helper pins that the rule is about
+the held lock, not the helper. Never imported by the package;
+parsed/compiled by tests only."""
+import threading
+
+from keystone_tpu.utils.guarded import hotpath
+
+
+class DispatchUnderLock:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    @hotpath
+    def flush(self, batch):
+        with self._lock:
+            self._dispatch(batch)  # hotpath-lock-held-dispatch
+
+    @hotpath
+    def flush_unlocked(self, batch):
+        # clean at this line: same callee, lock released first (the
+        # helper's own host-sync hazard still fires, on ITS line)
+        return self._dispatch(batch)
+
+    def _dispatch(self, batch):
+        return self._gather(batch)
+
+    def _gather(self, batch):
+        batch.block_until_ready()  # hotpath-host-sync, two hops down
+        return batch

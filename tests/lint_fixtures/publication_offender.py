@@ -1,0 +1,44 @@
+"""Synthetic offender for the atomic-publication pass
+(``analysis.hotpath.published_field_hazards``): a class that DECLARES
+``@published_by`` — its fields are read LOCK-FREE on the hot path, so
+every write must be a single-reference atomic flip under the declared
+lock — and then violates each clause: ``unpublished-write`` (a flip
+outside the lock), ``non-atomic-publication`` (an in-place mutation
+readers observe piecewise), ``torn-publication`` (two published fields
+flipped in separate statements — version skew for a reader between
+them). ``clean_flip`` pins the discipline ROADMAP item 1's hot-swap
+must follow. Never imported by the package; parsed/compiled by tests
+only."""
+import threading
+
+from keystone_tpu.utils.guarded import published_by
+
+
+@published_by("_lock", "_live", "_epoch")
+class TornPlane:
+    def __init__(self):
+        # __init__ is exempt: the object is not shared yet
+        self._lock = threading.Lock()
+        self._live = {}
+        self._epoch = 0
+
+    def unlocked_flip(self, snap):
+        self._live = snap  # unpublished-write: no lock held
+
+    def piecewise(self, name, entry):
+        with self._lock:
+            self._live.update({name: entry})  # non-atomic-publication
+
+    def torn_swap(self, snap, epoch):
+        with self._lock:
+            # torn-publication: two published fields in two statements
+            self._live = snap
+            self._epoch = epoch
+
+    def clean_flip(self, snap):
+        with self._lock:
+            self._live = dict(snap)  # clean: ONE atomic rebind under lock
+
+    def clean_drop_locked(self, name):
+        self._live.pop(name, None)  # clean: *_locked holds the declared
+        # lock by convention, and a single-key pop is one dict-slot write

@@ -1,0 +1,270 @@
+"""Hot-path + atomic-publication static passes (analysis/hotpath.py):
+each rule — blocking, host-sync, I/O, lazy-import, unbounded-growth,
+lock-held-dispatch, and the three publication clauses — fires on its
+synthetic offender fixture (tests/lint_fixtures) with the full call
+chain named; the package tree scans CLEAN under the wall budget; every
+``HOTPATH_ALLOWLIST`` entry and every ``HOTPATH_COLD`` entry is LIVE
+(removing it produces diagnostics — a dead suppression is a lint bug);
+and the declarations themselves (``@hotpath`` / ``@published_by``) are
+introspectable at runtime on the real serving classes."""
+import ast
+import pathlib
+import time
+
+import pytest
+
+from keystone_tpu.analysis.hotpath import (
+    HOTPATH_ALLOWLIST,
+    HOTPATH_COLD,
+    HOTPATH_SCAN_BUDGET_S,
+    build_package,
+    hotpath_hazards,
+    published_classes,
+    published_field_hazards,
+    scan_package,
+    scan_source,
+)
+from keystone_tpu.utils.guarded import hotpath, published_fields
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "keystone_tpu"
+FIXTURES = pathlib.Path(__file__).resolve().parent / "lint_fixtures"
+
+HOTPATH_FIXTURES = [
+    ("hotpath_blocking_offender", "hotpath-blocking", 5),
+    ("hotpath_hostsync_offender", "hotpath-host-sync", 3),
+    ("hotpath_io_offender", "hotpath-io", 4),
+    ("hotpath_import_offender", "hotpath-lazy-import", 1),
+    ("hotpath_alloc_offender", "hotpath-unbounded-growth", 2),
+]
+
+
+def _src(name):
+    return (FIXTURES / f"{name}.py").read_text()
+
+
+def _scan(name, **kw):
+    # hermetic: fixtures judged against an EMPTY allowlist/cold set so
+    # the shipped tables can never mask a fixture regression
+    kw.setdefault("allowlist", ())
+    kw.setdefault("cold", ())
+    return scan_source(_src(name), **kw)
+
+
+# -- declarations ------------------------------------------------------------
+
+def test_hotpath_marker_is_zero_cost_and_introspectable():
+    def f():
+        return 1
+
+    marked = hotpath(f)
+    assert marked is f  # a marker, not a wrapper: zero call overhead
+    assert marked.__hotpath_entry__ is True
+
+
+def test_serving_entry_points_carry_the_marker():
+    """The declared request-path surface: the entry-point registry IS
+    the decorated code (README 'Static checking')."""
+    from keystone_tpu.observability.reqtrace import ExemplarReservoir, ReqTrace
+    from keystone_tpu.serving.batcher import MicroBatcher
+    from keystone_tpu.serving.http import ServingHandler
+    from keystone_tpu.serving.plane import ServingPlane
+
+    for fn in (MicroBatcher.submit, MicroBatcher.submit_request,
+               MicroBatcher.take, MicroBatcher.done,
+               ServingPlane.submit, ServingPlane.submit_request,
+               ServingPlane.predict, ServingPlane.predict_traced,
+               ServingPlane._execute, ServingPlane._serve_batch,
+               ReqTrace.new, ExemplarReservoir.offer,
+               ServingHandler.do_POST):
+        assert getattr(fn, "__hotpath_entry__", False), fn
+
+
+def test_published_by_lands_on_class_and_ast():
+    from lint_fixtures.publication_offender import TornPlane
+
+    assert published_fields(TornPlane) == {
+        "_live": "_lock", "_epoch": "_lock"}
+    classes = published_classes(ast.parse(_src("publication_offender")))
+    assert classes["TornPlane"] == {"_live": "_lock", "_epoch": "_lock"}
+
+
+def test_serving_classes_declare_their_published_fields():
+    """The lock-free read surface the publication pass pins: the
+    batcher's closed flag, the plane's ready snapshot, the reservoir's
+    admission floor."""
+    from keystone_tpu.observability.reqtrace import ExemplarReservoir
+    from keystone_tpu.serving.batcher import MicroBatcher
+    from keystone_tpu.serving.plane import ServingPlane
+
+    assert published_fields(MicroBatcher) == {"_closed": "_lock"}
+    assert published_fields(ServingPlane) == {"_live": "_lock"}
+    assert published_fields(ExemplarReservoir) == {"_floor": "_lock"}
+
+
+# -- per-rule firing on the offender fixtures --------------------------------
+
+@pytest.mark.parametrize("name, code, count", HOTPATH_FIXTURES)
+def test_rule_fires_on_offender_fixture(name, code, count):
+    hits = _scan(name)
+    assert {c for _, c, _ in hits} == {code}
+    assert len(hits) == count
+    for lineno, _, msg in hits:
+        assert lineno > 0
+        assert "hot path" in msg  # every diagnostic explains itself
+
+
+def test_diagnostics_name_the_full_call_chain():
+    """The interprocedural contract: a hazard inside a helper is
+    attributed to the ENTRY POINT's chain, not just the helper."""
+    hits = _scan("hotpath_blocking_offender")
+    sleep_hits = [msg for _, _, msg in hits if "sleep" in msg]
+    assert len(sleep_hits) == 1
+    assert "SlowGate.submit -> SlowGate._stall" in sleep_hits[0]
+
+
+def test_growth_rule_spares_drained_and_bounded_fields():
+    hits = _scan("hotpath_alloc_offender")
+    assert all("_seen" in msg for _, _, msg in hits)
+    assert not any("_retired" in msg or "_recent" in msg
+                   for _, _, msg in hits)
+
+
+def test_lock_held_dispatch_fires_transitively_and_only_under_lock():
+    hits = _scan("hotpath_lockdispatch_offender")
+    dispatch = [h for h in hits if h[1] == "hotpath-lock-held-dispatch"]
+    assert len(dispatch) == 1  # flush only; flush_unlocked is clean
+    assert "holding `self._lock`" in dispatch[0][2]
+    assert "DispatchUnderLock._dispatch" in dispatch[0][2]
+    # the helper's own sync still fires, on its own line, chain-named
+    syncs = [h for h in hits if h[1] == "hotpath-host-sync"]
+    assert len(syncs) == 1
+    assert "DispatchUnderLock.flush -> " in syncs[0][2]
+
+
+def test_publication_pass_fires_each_clause_once():
+    hits = published_field_hazards(
+        ast.parse(_src("publication_offender")), allowlist=())
+    assert {c for _, c, _ in hits} == {
+        "unpublished-write", "non-atomic-publication", "torn-publication"}
+    assert len(hits) == 3  # clean_flip / clean_drop_locked are silent
+
+
+# -- allowlist / cold semantics ----------------------------------------------
+
+def test_allowlist_suppresses_by_func_and_offender():
+    allow = {"SlowGate.handle:acquire", "SlowGate.handle:wait",
+             "SlowGate.handle:result", "SlowGate.drain:get",
+             "SlowGate._stall:sleep"}
+    assert _scan("hotpath_blocking_offender", allowlist=allow) == []
+    # a PARTIAL allowlist only suppresses its own keys
+    partial = _scan("hotpath_blocking_offender",
+                    allowlist={"SlowGate.drain:get"})
+    assert len(partial) == 4
+    assert not any("q.get" in msg for _, _, msg in partial)
+
+
+def test_cold_set_prunes_the_traversal():
+    hits = _scan("hotpath_blocking_offender", cold={"SlowGate._stall"})
+    assert not any("sleep" in msg for _, _, msg in hits)
+    assert len(hits) == 4  # the direct hazards are untouched
+
+
+def test_publication_allowlist_suppresses_by_method_and_field():
+    allow = {"TornPlane.unlocked_flip:_live", "TornPlane.piecewise:_live",
+             "TornPlane.torn_swap:_live"}
+    assert published_field_hazards(
+        ast.parse(_src("publication_offender")), allowlist=allow) == []
+
+
+# -- the package tree --------------------------------------------------------
+
+def test_tree_scan_is_clean_and_under_budget():
+    """The PR bar: zero unallowlisted diagnostics over the package,
+    inside the wall budget CI asserts (static-layer creep is a measured
+    quantity)."""
+    t0 = time.perf_counter()
+    hits = scan_package(PKG)
+    elapsed = time.perf_counter() - t0
+    assert hits == [], hits
+    assert elapsed < HOTPATH_SCAN_BUDGET_S, (
+        f"tree scan took {elapsed:.2f}s >= {HOTPATH_SCAN_BUDGET_S}s")
+
+
+def test_every_allowlist_entry_is_live():
+    """Removing ANY allowlist entry must surface at least one
+    diagnostic — a dead entry is a stale suppression waiting to mask a
+    real regression. (One shared index; the BFS re-runs per entry.)"""
+    pkg = build_package(PKG)
+    assert hotpath_hazards(pkg) == []
+    for entry in sorted(HOTPATH_ALLOWLIST):
+        hits = hotpath_hazards(pkg, allowlist=HOTPATH_ALLOWLIST - {entry})
+        assert hits, f"allowlist entry {entry!r} is dead"
+
+
+def test_every_cold_entry_is_live():
+    """Removing a cold entry must pull new reachable code into the
+    traversal and fire diagnostics — a cold entry that changes nothing
+    is a stale claim."""
+    pkg = build_package(PKG)
+    for entry in sorted(HOTPATH_COLD):
+        hits = hotpath_hazards(pkg, cold=HOTPATH_COLD - {entry})
+        assert hits, f"cold entry {entry!r} is dead"
+
+
+def test_scan_package_reports_the_lint_shape(tmp_path):
+    pkg = tmp_path / "pkg"
+    (pkg / "serving").mkdir(parents=True)
+    (pkg / "serving" / "bad.py").write_text(_src("hotpath_import_offender"))
+    hits = scan_package(pkg)
+    assert {h["code"] for h in hits} == {"hotpath-lazy-import"}
+    for h in hits:
+        assert set(h) == {"file", "lineno", "code", "message"}
+        assert h["file"].endswith("bad.py")
+        assert isinstance(h["lineno"], int) and h["lineno"] > 0
+
+
+# -- wiring: lint + check CLI ------------------------------------------------
+
+def test_lint_gate_runs_hotpath_passes(tmp_path, monkeypatch):
+    """tools/lint.py fails when a package module has a hot-path
+    diagnostic, and its summary line carries the measured runtime
+    against the budget (wired like the concurrency/SPMD passes)."""
+    import sys
+
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import lint
+    finally:
+        sys.path.pop(0)
+    pkg = tmp_path / "keystone_tpu"
+    (pkg / "serving").mkdir(parents=True)
+    (pkg / "serving" / "bad.py").write_text(
+        _src("hotpath_blocking_offender"))
+    monkeypatch.setattr(lint, "REPO", tmp_path)
+    monkeypatch.setattr(lint, "PKG", pkg)
+    assert lint.run_hotpath_rules() > 0
+
+
+@pytest.mark.slow
+def test_check_cli_json_carries_hotpath_key(tmp_path):
+    """`python -m keystone_tpu check <app> --json` grows the `hotpath`
+    key (clean today) next to `concurrency`/`spmd`, exit codes
+    preserved — the schema the CI consumers parse."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    out = tmp_path / "report.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+    proc = subprocess.run(
+        [sys.executable, "-m", "keystone_tpu", "check",
+         "mnist.random_fft", "--json", str(out)],
+        cwd=REPO, capture_output=True, text=True, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "hotpath: clean" in proc.stdout
+    blob = json.loads(out.read_text())
+    assert blob["hotpath"] == []
+    assert blob["spmd"] == []  # neighbours unchanged
+    assert blob["concurrency"] == []

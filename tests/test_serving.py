@@ -635,3 +635,203 @@ def test_greedy_select_maximizes_value_under_budget():
     # empty-budget edge: nothing fits, nothing selected
     assert greedy_select((), candidates, mem.get,
                          lambda sel: 0.0, budget=0.0) == frozenset()
+
+
+# -- PR 17 hot-path findings: pinned regressions ------------------------------
+# Each true positive the first hotpath tree scan found rides with an
+# UN-FIXED offender copy (the pre-fix method body, verbatim) that
+# reproduces the pathology deterministically, plus the HEAD behavior
+# surviving the same sequence — the static rule points at the line, the
+# dynamic pin proves the line mattered.
+
+from keystone_tpu.serving.batcher import Request as _Request
+from keystone_tpu.serving.plane import _evicted_record
+from keystone_tpu.utils.guarded import published_fields
+
+
+class _UnfixedBatcher(MicroBatcher):
+    """``submit_request`` as it stood before the published lock-free
+    ``_closed`` fast-fail: the slot gate is paid FIRST, so a closed
+    batcher whose slots are still held (taken-but-not-done requests)
+    costs callers the full submit timeout and reports shutdown as a
+    QueueFullError 429."""
+
+    def submit_request(self, model, x, n, timeout_s=None):
+        timeout = self.submit_timeout_s if timeout_s is None else timeout_s
+        if not self._slots.acquire(timeout=timeout):
+            raise QueueFullError(
+                f"serving queue full ({self.queue_depth} slots) — "
+                f"request for {model!r} rejected after {timeout:.1f}s")
+        req = _Request(model=model, x=x, n=int(n))
+        with self._lock:
+            if self._closed:
+                self._slots.release()
+                raise RuntimeError("batcher is closed")
+            self._pending.append(req)
+        self._ready.set()
+        return req
+
+
+def _closed_batcher_with_held_slots(cls):
+    """A closed batcher whose every slot is held by an in-flight
+    (taken, not yet done) request — the shutdown shape that exposed the
+    bug: close() only releases DRAINED slots."""
+    batcher = cls(queue_depth=2, submit_timeout_s=0.3)
+    batcher.submit("m", np.zeros((1, 2)), 1)
+    batcher.submit("m", np.zeros((1, 2)), 1)
+    taken = batcher.take(max_rows=8)
+    assert len(taken) == 2 and batcher.close() == []
+    return batcher
+
+
+def test_closed_batcher_masquerades_as_429_on_unfixed_copy():
+    batcher = _closed_batcher_with_held_slots(_UnfixedBatcher)
+    t0 = time.perf_counter()
+    with pytest.raises(QueueFullError):
+        batcher.submit("m", np.zeros((1, 2)), 1)
+    # the pathology, both halves: the wrong verdict (shutdown shaped as
+    # an overload 429) at the price of the full submit timeout
+    assert time.perf_counter() - t0 >= 0.3
+
+
+def test_closed_batcher_fast_fails_on_head():
+    batcher = _closed_batcher_with_held_slots(MicroBatcher)
+    t0 = time.perf_counter()
+    with pytest.raises(RuntimeError, match="closed"):
+        batcher.submit("m", np.zeros((1, 2)), 1)
+    # the published read refuses BEFORE the slot gate: honest verdict,
+    # immediately (QueueFullError is a RuntimeError too — the match
+    # pins the type apart)
+    assert time.perf_counter() - t0 < 0.1
+
+
+class _UnfixedPublishPlane(ServingPlane):
+    """``_publish_locked`` as an in-place mutation instead of the
+    reference flip: a lock-free reader holding the ``_live`` dict
+    observes it change under them (momentarily EMPTY mid-republish) —
+    the torn publication the ``@published_by`` pass forbids."""
+
+    def _publish_locked(self):
+        self._live.clear()
+        self._live.update(
+            {n: e for n, e in self._models.items() if e.ready})
+        reg = MetricsRegistry.get_or_create()
+        reg.gauge("serving.models_resident").set(len(self._live))
+        reg.gauge("serving.models_warming").set(self._warming)
+
+
+def test_live_snapshot_mutated_under_readers_on_unfixed_copy():
+    plane = _UnfixedPublishPlane(max_batch=8)
+    try:
+        fitted, X, _ = _make_fitted(6, 2)
+        plane.admit("m", fitted, _sample(6))
+        snapshot = plane._live  # what a lock-free reader holds
+        plane.evict("m")
+        assert plane._live is snapshot  # same object republished...
+        assert "m" not in snapshot  # ...so the reader's view tore
+    finally:
+        plane.close()
+
+
+def test_live_snapshot_flips_atomically_on_head():
+    plane = ServingPlane(max_batch=8)
+    try:
+        fitted, X, _ = _make_fitted(6, 2)
+        plane.admit("m", fitted, _sample(6))
+        snapshot = plane._live
+        assert "m" in snapshot
+        plane.evict("m")
+        assert plane._live is not snapshot  # a NEW dict was bound
+        assert "m" in snapshot  # the reader's snapshot never mutates
+        assert "m" not in plane._live
+        # the discipline is DECLARED, so the static pass guards it
+        assert published_fields(ServingPlane) == {"_live": "_lock"}
+    finally:
+        plane.close()
+
+
+class _CountingLock:
+    def __init__(self, inner):
+        self._inner = inner
+        self.acquires = 0
+
+    def __enter__(self):
+        self.acquires += 1
+        return self._inner.__enter__()
+
+    def __exit__(self, *exc):
+        return self._inner.__exit__(*exc)
+
+
+def test_steady_state_submit_skips_the_plane_lock():
+    """The point of publishing ``_live``: a ready-model request never
+    acquires the plane lock (and never queues behind an admission
+    holding it); only the miss path pays it for the honest
+    warming-vs-unknown verdict. No worker is started, so the submitted
+    request parks in the batcher and nothing else touches the lock."""
+    plane = ServingPlane(max_batch=8)
+    try:
+        fitted, X, _ = _make_fitted(6, 2)
+        plane.admit("m", fitted, _sample(6))
+        real = plane._lock
+        counting = _CountingLock(real)
+        plane._lock = counting
+        try:
+            plane.submit_request("m", X[:2])
+        finally:
+            plane._lock = real
+        assert counting.acquires == 0
+        plane._lock = counting
+        try:
+            with pytest.raises(ModelNotAdmitted):
+                plane.submit_request("ghost", X[:2])
+        finally:
+            plane._lock = real
+        assert counting.acquires == 1
+    finally:
+        plane.close()
+
+
+class _UnfixedEvictPlane(ServingPlane):
+    """``evict`` as it stood before PR 17: the ``_phase_hists`` entry
+    outlives its model — one cached histogram-handle pair per model
+    name EVER served, the per-model leak the first hotpath tree scan
+    flagged as ``hotpath-unbounded-growth``."""
+
+    def evict(self, name):
+        with self._lock:
+            if name not in self._models:
+                raise ModelNotAdmitted(f"model {name!r} is not resident")
+            entry = self._models.pop(name)
+            self.ledger.release(name)
+            self._evicted[name] = _evicted_record(entry)
+            self._publish_locked()
+
+
+def _churn_phase_hists(plane, fitted, names):
+    for name in names:
+        plane.admit(name, fitted, _sample(6))
+        plane._phase_instruments(name)  # the worker's first-use fill
+        assert name in plane._phase_hists
+        plane.evict(name)
+
+
+def test_phase_hist_cache_leaks_on_unfixed_copy():
+    plane = _UnfixedEvictPlane(max_batch=8)
+    try:
+        fitted, _, _ = _make_fitted(6, 2)
+        _churn_phase_hists(plane, fitted, ["m0", "m1", "m2"])
+        # one entry per model name ever served, none of them resident
+        assert sorted(plane._phase_hists) == ["m0", "m1", "m2"]
+    finally:
+        plane.close()
+
+
+def test_phase_hist_cache_is_pruned_with_its_model_on_head():
+    plane = ServingPlane(max_batch=8)
+    try:
+        fitted, _, _ = _make_fitted(6, 2)
+        _churn_phase_hists(plane, fitted, ["m0", "m1", "m2"])
+        assert plane._phase_hists == {}
+    finally:
+        plane.close()

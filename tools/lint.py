@@ -81,6 +81,26 @@ Three layers, all hermetic (no data, no device buffers):
      host-0-only world-snapshot effects must be barrier-paired and
      restored carries must re-enter through ``_restore_carry``.
      Deliberate exceptions live in the commented ``SPMD_ALLOWLIST``.
+   - **hot-path safety** (``analysis.hotpath``, PR 17): the
+     interprocedural request-path pass. From every ``@hotpath``-marked
+     serving entry point, the static call graph is walked and each
+     reachable call classified: ``hotpath-blocking`` (queue waits,
+     joins, sleeps, future ``.result``), ``hotpath-host-sync``
+     (``block_until_ready`` / ``device_put`` / numpy coercions — a
+     host-device round trip per request), ``hotpath-io`` (filesystem /
+     network / pickle on the request path), ``hotpath-lazy-import``
+     (a per-request import statement), ``hotpath-unbounded-growth``
+     (appending to a container no code path ever shrinks), and
+     ``hotpath-lock-held-dispatch`` (a call under a held lock whose
+     callee transitively blocks or syncs). Every diagnostic names the
+     full call chain from the entry point. Plus the atomic-publication
+     pass over ``@published_by`` classes: ``unpublished-write`` /
+     ``non-atomic-publication`` / ``torn-publication`` — a published
+     field may only change via a single-reference atomic flip under its
+     declared lock (the swap discipline hot-swap will ride on).
+     Deliberate exceptions live in the commented ``HOTPATH_ALLOWLIST``;
+     the full-tree scan must also finish under
+     ``HOTPATH_SCAN_BUDGET_S`` (the gate emits its runtime).
 3. **ruff** (when installed): style/correctness pass over the package.
    Skipped with a notice when the container lacks ruff — layers 1–2
    are the required gate.
@@ -257,6 +277,39 @@ def run_spmd_rules() -> int:
     return failures
 
 
+# -- layer 2a'': hot-path + publication passes -------------------------------
+
+def run_hotpath_rules() -> int:
+    """The interprocedural hot-path pass + the atomic-publication pass
+    over the package tree (single source of truth in
+    ``analysis.hotpath``; offender fixtures under tests/lint_fixtures
+    pin each rule's firing shape). The scan is also WALL-BUDGETED: the
+    whole-tree walk must finish under ``HOTPATH_SCAN_BUDGET_S`` so the
+    gate can never quietly become the slow part of CI — an over-budget
+    scan is itself a failure."""
+    import time
+
+    from keystone_tpu.analysis.hotpath import (
+        HOTPATH_SCAN_BUDGET_S,
+        scan_package,
+    )
+
+    failures = 0
+    t0 = time.perf_counter()
+    for hit in scan_package(PKG):
+        print(f"{hit['file']}:{hit['lineno']}: {hit['code']}: "
+              f"{hit['message']}")
+        failures += 1
+    elapsed = time.perf_counter() - t0
+    if elapsed > HOTPATH_SCAN_BUDGET_S:
+        print(f"hotpath-scan-over-budget: full-tree scan took "
+              f"{elapsed:.2f}s > {HOTPATH_SCAN_BUDGET_S:.0f}s budget")
+        failures += 1
+    print(f"hotpath passes: {failures} failure(s) in {elapsed:.2f}s "
+          f"(budget {HOTPATH_SCAN_BUDGET_S:.0f}s)")
+    return failures
+
+
 # -- layer 2b: donation shape gate (spec-level, eval_shape) ------------------
 
 def _donating_modules():
@@ -367,6 +420,7 @@ def main(argv=None) -> int:
     failures = run_ast_rules()
     failures += run_concurrency_rules()
     failures += run_spmd_rules()
+    failures += run_hotpath_rules()
     failures += run_donation_shape_gate()
     failures += run_ruff()
     if "--skip-apps" not in argv:

@@ -71,6 +71,36 @@ def _get(url: str, timeout: float = 5.0):
 
 
 def main() -> int:
+    # 0. the static precondition, BEFORE any jax/device work: the
+    # request path the rest of this gate is about to exercise must be
+    # statically clean — every call reachable from a @hotpath serving
+    # entry point free of unallowlisted blocking/host-sync/IO/alloc
+    # hazards, and every @published_by field on the swap discipline.
+    # Cheap (AST-only, ~1s) and it fails the gate with named chains
+    # instead of a mystery latency regression three phases later.
+    import time as _time
+
+    from keystone_tpu.analysis.hotpath import (
+        HOTPATH_SCAN_BUDGET_S,
+        scan_package,
+    )
+
+    t0 = _time.perf_counter()
+    hotpath_hits = scan_package(os.path.join(REPO, "keystone_tpu"))
+    scan_s = _time.perf_counter() - t0
+    if hotpath_hits:
+        for hit in hotpath_hits:
+            print(f"  {hit['file']}:{hit['lineno']}: {hit['code']}: "
+                  f"{hit['message']}", file=sys.stderr)
+        return _fail(None, f"{len(hotpath_hits)} hot-path/publication "
+                           "diagnostic(s) — fix or allowlist before "
+                           "driving load")
+    if scan_s > HOTPATH_SCAN_BUDGET_S:
+        return _fail(None, f"hot-path scan took {scan_s:.2f}s > "
+                           f"{HOTPATH_SCAN_BUDGET_S:.0f}s budget")
+    print(f"serving gate: hot-path scan clean in {scan_s:.2f}s "
+          f"(budget {HOTPATH_SCAN_BUDGET_S:.0f}s)")
+
     import jax
 
     jax.config.update("jax_platforms", "cpu")
