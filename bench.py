@@ -1657,6 +1657,69 @@ def serving_bench():
         plane.close()
 
 
+def elastic_coordination_bench():
+    """Multi-host coordination cost on the CPU dryrun harness (PR 18):
+    shells out to ``tools/elastic_bench.py`` — real ``jax.distributed``
+    + gloo worlds at sizes 1 and 2, warm steady-state fits — and
+    re-emits its banded lines:
+
+    * ``elastic_scaling_efficiency`` — (2p img/s) / (2 x 1p img/s);
+      vs_baseline against the 0.8 acceptance bar. On the CPU sim both
+      "hosts" share this machine, so the number prices coordination
+      rounds, not hardware scaling; warm per-chunk wall is dispatch-
+      latency-bound under gloo, so values above 1.0 mean the hosts
+      overlap that latency (coordination adds ~nothing).
+    * ``coord_overhead_share`` — blocked-await wall / round wall on the
+      2-process world (PERFORMANCE.md rule 17: measure the await, not
+      the round). Banded absolutely via the shared "overhead_share"
+      marker; the overlapped round loop's whole point is holding this
+      near zero.
+    * ``coord_overlap_occupancy`` — its complement (1.0 = coordination
+      fully hidden behind accumulate compute).
+
+    The subprocess pins ``JAX_PLATFORMS=cpu`` for the worlds, so this
+    section is device-independent — it measures the coordinator, not
+    the accelerator, and runs identically on the TPU bench host."""
+    import subprocess
+    import sys as _sys
+
+    rows = 4_096 if SMALL else _scaled(16_384, mult=4_096)
+    proc = subprocess.run(
+        [_sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "tools", "elastic_bench.py"),
+         "--rows", str(rows), "--chunk-size", "256"],
+        capture_output=True, text=True, timeout=560,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"elastic bench subprocess failed (rc {proc.returncode}): "
+            f"{proc.stdout[-800:]}\n{proc.stderr[-800:]}")
+    lines = {}
+    for raw in proc.stdout.splitlines():
+        if raw.startswith("{"):
+            blob = json.loads(raw)
+            lines[blob.get("metric")] = blob
+    eff = lines.get("elastic_scaling_efficiency")
+    if eff is None:
+        raise RuntimeError("elastic bench emitted no "
+                           f"efficiency line: {proc.stdout[-800:]}")
+    _emit("elastic_scaling_efficiency", round(float(eff["value"]), 4),
+          "fraction", round(float(eff["value"]) / 0.8, 3),
+          processes=eff.get("processes"), rows=rows,
+          note=eff.get("note"))
+    share = lines.get("coord_overhead_share")
+    if share is not None:
+        _emit("coord_overhead_share", round(float(share["value"]), 6),
+              "share", round(float(share["value"]) / 0.02, 3),
+              processes=share.get("processes"))
+    occ = lines.get("coord_overlap_occupancy")
+    if occ is not None:
+        _emit("coord_overlap_occupancy", round(float(occ["value"]), 6),
+              "fraction", round(float(occ["value"]) / 0.98, 3),
+              processes=occ.get("processes"))
+
+
 def loader_bench():
     """VERDICT r2 weak#5: time the tar -> threaded decode -> device ->
     SIFT path END TO END on a generated JPEG tar, so the ImageNet-style
@@ -2062,6 +2125,7 @@ def main():
         (e2e_bench, 60),
         (loader_bench, 60),
         (streamed_e2e_bench, 60),
+        (elastic_coordination_bench, 75),
         (newsgroups_bench, 30),
         (timit_bench, 120),
         (mnist_bench, 75),

@@ -2,7 +2,7 @@
 ``bin/run-pipeline.sh <class> --flags``, SURVEY.md section 2.13):
 
     python -m keystone_tpu <app> [--flags]
-    python -m keystone_tpu check <app> [--json PATH] [--budget BYTES]
+    python -m keystone_tpu check <app> [--json PATH] [--budget BYTES] [--shards N]
     python -m keystone_tpu check --all [--budget BYTES]
     python -m keystone_tpu benchdiff BASE.json CURRENT.json [--force]
     python -m keystone_tpu numerics POSTMORTEM.json
@@ -96,11 +96,14 @@ def _parse_bytes(text: str) -> float:
 
 def check_main(rest) -> int:
     """``python -m keystone_tpu check <app>|--all [--json PATH]
-    [--budget BYTES] [--xla]``.
+    [--budget BYTES] [--shards N] [--xla]``.
 
     ``--budget`` (bytes; ``MiB``/``GiB`` suffixes accepted) gates every
     checked app on its static HBM plan — the device-free prediction of
-    the fit path's peak residency. ``--xla`` cross-checks that plan
+    the fit path's peak residency. ``--shards N`` overrides the
+    planner's data-axis width, so ``--budget`` verifies the PER-HOST
+    charge of an N-shard world from a single-host machine (the
+    sharded-apply sizing runbook, CLUSTER.md "Serving topology"). ``--xla`` cross-checks that plan
     against XLA's own memory model: every planner-resolved node with a
     per-item program is compiled-without-executing on the sample spec
     and its ``memory_analysis`` output/temp bytes are compared with the
@@ -136,6 +139,22 @@ def check_main(rest) -> int:
                   f"16GiB), got {rest[i + 1]!r}", file=sys.stderr)
             return 2
         del rest[i:i + 2]
+    shards = None
+    if "--shards" in rest:
+        i = rest.index("--shards")
+        if i + 1 >= len(rest):
+            print("--shards requires a data-shard count (e.g. 8)",
+                  file=sys.stderr)
+            return 2
+        try:
+            shards = int(rest[i + 1])
+            if shards < 1:
+                raise ValueError(shards)
+        except ValueError:
+            print(f"--shards expects a positive integer, got "
+                  f"{rest[i + 1]!r}", file=sys.stderr)
+            return 2
+        del rest[i:i + 2]
     xla_verify = "--xla" in rest
     if xla_verify:
         rest.remove("--xla")
@@ -144,7 +163,8 @@ def check_main(rest) -> int:
 
     if not rest or rest[0] in ("-h", "--help"):
         print("usage: python -m keystone_tpu check <app>|--all "
-              "[--json PATH] [--budget BYTES] [--xla]\n\napps:")
+              "[--json PATH] [--budget BYTES] [--shards N] [--xla]\n\n"
+              "apps:")
         for name in sorted(CHECK_APPS):
             print(f"  {name}")
         return 0
@@ -207,7 +227,8 @@ def check_main(rest) -> int:
     for build in builders:
         target = build()
         report = target.pipeline.check(target.input_spec, name=target.name,
-                                       hbm_budget=budget)
+                                       hbm_budget=budget,
+                                       data_shards=shards)
         reports.append(report)
         print(report.summary(), file=sys.stderr)
         if xla_verify:

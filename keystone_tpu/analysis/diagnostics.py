@@ -1072,12 +1072,17 @@ def check_graph(
     source_specs: Optional[Mapping[SourceId, AbstractValue]] = None,
     name: str = "graph",
     hbm_budget: Optional[float] = None,
+    data_shards: Optional[int] = None,
 ) -> AnalysisReport:
     """Run the abstract interpreter, every lint, and the static HBM
     planner over ``graph``. ``hbm_budget`` (bytes) adds an
     ``hbm-budget`` ERROR diagnostic when the plan's fit-path peak
     exceeds it — the device-free form of the runtime budget assert
-    (budgets are checked twice, PERFORMANCE.md)."""
+    (budgets are checked twice, PERFORMANCE.md). ``data_shards``
+    overrides the mesh-derived data-axis width the planner divides
+    batch effects across — so ``check --budget --shards N`` verifies
+    the PER-HOST charge of an N-shard world from a single-host
+    machine (the sharded-apply sizing runbook, CLUSTER.md)."""
     source_specs = dict(source_specs or {})
     analysis = analyze(graph, source_specs)
     diagnostics = list(analysis.diagnostics)
@@ -1093,7 +1098,7 @@ def check_graph(
     diagnostics += sharding_flow_lint(analysis)
     from .resources import plan_graph
 
-    plan = plan_graph(analysis, name=name)
+    plan = plan_graph(analysis, name=name, data_shards=data_shards)
     if plan.over_budget(hbm_budget):
         mib = 1 << 20
         diagnostics.append(Diagnostic(
@@ -1112,13 +1117,16 @@ def check_graph(
 
 def check_pipeline(pipeline, sample: Any = None,
                    name: str = "pipeline",
-                   hbm_budget: Optional[float] = None) -> AnalysisReport:
+                   hbm_budget: Optional[float] = None,
+                   data_shards: Optional[int] = None) -> AnalysisReport:
     """``Pipeline.check``'s engine: bind ``sample`` (an input spec — see
     ``spec.as_input_spec``) to the pipeline's dangling source and check
     the full graph (lints + static HBM plan, optionally against an
-    ``hbm_budget`` in bytes)."""
+    ``hbm_budget`` in bytes; ``data_shards`` overrides the planner's
+    data-axis width for per-host verification)."""
     p = pipeline.to_pipeline()
     specs = {}
     if sample is not None:
         specs[p._source] = as_input_spec(sample)
-    return check_graph(p._graph, specs, name=name, hbm_budget=hbm_budget)
+    return check_graph(p._graph, specs, name=name, hbm_budget=hbm_budget,
+                       data_shards=data_shards)

@@ -424,8 +424,32 @@ def fitted_model_nbytes(graph: Any) -> float:
     return total
 
 
+def sharded_apply_nbytes(graph: Any) -> tuple:
+    """``(shardable_nbytes, gather_nbytes)`` for the spmd sharded
+    apply (``parallel/spmd_apply.py``): how many of the graph's fitted
+    bytes row-shard over the data axis AT REST, and the largest
+    transient one in-body ``all_gather`` materializes (the whole
+    matrix for ``LinearMapper``, one feature block for
+    ``BlockLinearMapper``). Operators opt in via a
+    ``sharded_apply_nbytes()`` hook returning that pair; everything
+    else stays replicated and is charged in full by the caller."""
+    shardable = 0.0
+    gather = 0.0
+    for node in graph.nodes:
+        op = graph.get_operator(node)
+        hook = getattr(op, "sharded_apply_nbytes", None)
+        if callable(hook):
+            s, u = hook()
+            shardable += float(s)
+            gather = max(gather, float(u))
+    return shardable, gather
+
+
 def serving_residency_nbytes(model_nbytes: float, plan: "HbmPlan",
-                             bucket_rows: int) -> Optional[float]:
+                             bucket_rows: int, data_shards: int = 1,
+                             shardable_nbytes: float = 0.0,
+                             gather_nbytes: float = 0.0,
+                             ) -> Optional[float]:
     """The admission charge for one served model at its largest request
     bucket: ``model_nbytes + bucket_rows x apply_item_nbytes`` — the
     serving-residency approximation the :class:`HbmPlan` docstring
@@ -433,11 +457,25 @@ def serving_residency_nbytes(model_nbytes: float, plan: "HbmPlan",
     (``serving/residency.py``). Returns None when the plan could not
     size the per-item activation (``apply_item_nbytes == 0`` with
     unresolved nodes): the caller must fall back to a measured probe
-    rather than admit on an invented number."""
+    rather than admit on an invented number.
+
+    With ``data_shards > 1`` the charge is PER HOST under the sharded
+    apply (``parallel/spmd_apply.py``): the shardable fitted bytes
+    (from :func:`sharded_apply_nbytes`) divide across the data axis,
+    the rest stays replicated, one ``gather_nbytes`` transient is
+    charged for the in-body all_gather, and the activation shrinks to
+    this host's row shard of the bucket — verified device-free by
+    ``check --budget``."""
     item = float(plan.apply_item_nbytes)
     if item <= 0.0 and plan.unresolved:
         return None
-    return float(model_nbytes) + float(bucket_rows) * item
+    shards = max(int(data_shards), 1)
+    if shards == 1:
+        return float(model_nbytes) + float(bucket_rows) * item
+    shardable = min(float(shardable_nbytes), float(model_nbytes))
+    resident = float(model_nbytes) - shardable + shardable / shards
+    shard_rows = -(-int(bucket_rows) // shards)
+    return resident + float(gather_nbytes) + float(shard_rows) * item
 
 
 # -- the plan ----------------------------------------------------------------

@@ -96,6 +96,18 @@ SPMD_ALLOWLIST: FrozenSet[str] = frozenset({
     # enforced at the .barrier(...) CALL SITES by this same pass — the
     # funnel itself is the one deliberate non-literal tag in the tree.
     "WorldCoordinator.barrier:sync_global_devices",
+    # The overlapped round loop merges WITHOUT barriers on purpose:
+    # ordering comes from the round allgather itself. A host renames
+    # its sidecar (atomic os.replace) BEFORE dispatching the round
+    # that reports its cursor in the (1, 4) payload, and host 0 calls
+    # merge_hosts only after AWAITING a round in which every host
+    # reported a durable sidecar — the collective IS the
+    # happens-before the ckpt-sidecars/ckpt-world barrier pair used
+    # to provide, at zero extra collectives. The 'after' side is
+    # unnecessary because peers never READ the world snapshot during
+    # a fit (only a relaunched world does, and atomic rename means it
+    # sees either the old or the new complete snapshot, never torn).
+    "fit_streaming:merge_hosts",
 })
 
 
@@ -928,10 +940,147 @@ def world_checkpoint_consistency(
     return sorted(set(hits))
 
 
+# -- pass 5: unawaited coordination handles ----------------------------------
+
+#: WorldCoordinator methods that DISPATCH an asynchronous coordination
+#: round (returning a ``PendingStep`` handle) and the method that AWAITS
+#: one. The overlapped round loop (``parallel/streaming.py``) is the
+#: shape this pass protects: every dispatched handle must reach exactly
+#: one ``step_await`` before it is discarded, rebound, or read.
+_DISPATCH_METHODS = frozenset({"step_begin"})
+_AWAIT_METHODS = frozenset({"step_await"})
+
+#: ``PendingStep`` fields only meaningful AFTER the await: reading one
+#: on a still-pending handle races the in-flight allgather (the payload
+#: is a device future; ``result`` is None until ``step_await`` fills it)
+_PENDING_RESULT_FIELDS = frozenset({"result"})
+
+
+def _dispatch_call(node) -> Optional[ast.Call]:
+    """The ``world.step_begin(...)`` call inside ``node``, unless the
+    same expression also awaits it inline (``step_await(step_begin())``
+    is a complete round, not a leak)."""
+    found = None
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        name = _call_name(sub)
+        if name in _AWAIT_METHODS and _is_coordinator_receiver(sub):
+            return None
+        if name in _DISPATCH_METHODS and _is_coordinator_receiver(sub):
+            found = sub
+    return found
+
+
+def unawaited_collective(
+    tree: ast.Module, allowlist: Optional[Iterable[str]] = None,
+) -> List[tuple]:
+    """``(lineno, code, description)`` for async-coordination hazards:
+    a dispatched round handle (``world.step_begin`` → ``PendingStep``)
+    that is discarded, rebound, or still pending at scope exit without
+    ever reaching ``world.step_await`` — the collective the rest of the
+    world is blocked in never completes here, or its result is silently
+    dropped and the next boundary folds a stale world view — and a
+    pending handle's ``result`` read before its await point (a
+    stale-buffer read racing the in-flight allgather).
+
+    Same textual-order discipline as the taint passes: handles are
+    tracked per scope in statement order, an await KILLS the pending
+    bit through any alias (``pending = new_pending`` transfers the
+    handle), so the shipped pipelined loop — dispatch round k+1, await
+    round k, drain at the break — scans clean."""
+    hits: List[tuple] = []
+
+    def flag(lineno: int, where: str, what: str):
+        hits.append((
+            lineno, "unawaited-collective",
+            f"{where} {what}: every `step_begin` handle must reach "
+            "exactly one `step_await` (the overlap contract — peers "
+            "are already blocked in this round's allgather, and the "
+            "awaited result is the only world view safe to act on). "
+            "Await the handle at the next round boundary (the "
+            "fit_streaming pipeline shape), or allowlist with a "
+            "comment (analysis/spmd.py)"))
+
+    for where, fdef in _scopes(tree):
+        if _allowed(f"{where}:step_begin", allowlist):
+            continue
+        # pending handle name -> dispatch lineno, folded in textual
+        # order over this scope's own statements
+        pending: Dict[str, int] = {}
+        events: List[tuple] = []
+        for sub in _own_walk(fdef):
+            if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                events.append((sub.lineno, 1, "bind", sub))
+            elif isinstance(sub, ast.Expr):
+                events.append((sub.lineno, 1, "expr", sub))
+            elif isinstance(sub, ast.Call) and _call_name(
+                    sub) in _AWAIT_METHODS and _is_coordinator_receiver(sub):
+                events.append((sub.lineno, 2, "await", sub))
+            elif isinstance(sub, ast.Attribute) and isinstance(
+                    sub.ctx, ast.Load) and sub.attr in \
+                    _PENDING_RESULT_FIELDS and isinstance(
+                        sub.value, ast.Name):
+                events.append((sub.lineno, 0, "read", sub))
+        for lineno, _, kind, node in sorted(events, key=lambda e: e[:2]):
+            if kind == "read":
+                if node.value.id in pending:
+                    hits.append((
+                        lineno, "stale-coordination-read",
+                        f"{where} reads `{node.value.id}."
+                        f"{node.attr}` before its `step_await`: the "
+                        "round dispatched at line "
+                        f"{pending[node.value.id]} is still in "
+                        "flight, so the read races the allgather "
+                        "(None or a torn device future, never the "
+                        "world view). Await the handle first, or "
+                        "allowlist with a comment (analysis/spmd.py)"))
+            elif kind == "await":
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    for n in ast.walk(arg):
+                        if isinstance(n, ast.Name):
+                            pending.pop(n.id, None)
+            elif kind == "expr":
+                disp = _dispatch_call(node.value)
+                if disp is not None:
+                    flag(disp.lineno, where,
+                         "discards a `step_begin` handle (dispatched "
+                         "round never awaited)")
+            else:  # bind
+                targets = node.targets if isinstance(
+                    node, ast.Assign) else [node.target]
+                names = [n for t in targets for n in _store_names(t)]
+                value = node.value
+                disp = None if value is None else _dispatch_call(value)
+                # alias transfer: `pending = new_pending` moves the
+                # handle — awaiting through EITHER name satisfies it
+                alias = value.id if isinstance(value, ast.Name) and \
+                    value.id in pending else None
+                for name in names:
+                    if name in pending and alias != name:
+                        flag(pending.pop(name), where,
+                             f"rebinds `{name}` over a still-pending "
+                             "handle (the earlier round's result is "
+                             "dropped unawaited)")
+                if disp is not None:
+                    for name in names:
+                        pending[name] = disp.lineno
+                elif alias is not None:
+                    lno = pending.pop(alias)
+                    for name in names:
+                        pending[name] = lno
+        for name, lineno in pending.items():
+            flag(lineno, where,
+                 f"lets pending handle `{name}` escape the scope "
+                 "unawaited")
+    return sorted(set(hits))
+
+
 # -- package scan (tools/lint.py + `check` CLI) ------------------------------
 
 def scan_file(path, rel: str) -> List[Dict[str, object]]:
-    """All four AST families over one file; ``[{file, lineno, code,
+    """All five AST families over one file; ``[{file, lineno, code,
     message}]`` (the shape tools/lint.py and ``check --json``
     consume)."""
     out: List[Dict[str, object]] = []
@@ -942,7 +1091,8 @@ def scan_file(path, rel: str) -> List[Dict[str, object]]:
                  "code": "syntax-error", "message": str(exc)}]
     for pass_fn in (collective_divergence, barrier_stability,
                     collective_axis_bindings,
-                    world_checkpoint_consistency):
+                    world_checkpoint_consistency,
+                    unawaited_collective):
         for lineno, code, msg in pass_fn(tree):
             out.append({"file": rel, "lineno": lineno,
                         "code": code, "message": msg})

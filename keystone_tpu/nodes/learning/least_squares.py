@@ -305,14 +305,20 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         candidates need per-pass data access a stream cannot provide).
         The decision rides the active trace with ``shape_source:
         "streamed"`` and ``streaming_restricted: true``."""
+        from ...parallel.distributed import process_count
         from ...parallel.mesh import get_mesh, num_data_shards
 
         G, C, _, _, n = carry
         d, k = int(G.shape[0]), int(C.shape[1])
         # same machine count the static/sampled optimizer paths use —
         # the cost surface must not shift between a streamed fit and a
-        # graph-optimized fit of the identical workload
-        machines = self.num_machines or num_data_shards(get_mesh())
+        # graph-optimized fit of the identical workload. Under a live
+        # multi-process world the workload really is spread over
+        # nproc x local shards (each host accumulated its shard-local
+        # stream), so the cost surface sees the GLOBAL machine count —
+        # every host computes the same number and makes the same choice.
+        machines = self.num_machines or (
+            num_data_shards(get_mesh()) * process_count())
         choice = self._choose(n, d, k, 1.0, machines,
                               "streamed", streaming=True)
         return choice.node.finalize(carry)

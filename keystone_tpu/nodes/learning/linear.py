@@ -278,6 +278,17 @@ class LinearMapper(Transformer):
             return super().struct_key()
         return (LinearMapper, "affine", self.weight_dtype)
 
+    def sharded_apply_nbytes(self):
+        """(shardable at rest, gather transient) under the spmd
+        sharded apply — W row-shards, and the whole matrix gathers
+        per call (the FSDP unit). Quantized mappers keep the fused
+        dequant program with only the batch sharded: nothing shards
+        at rest, nothing gathers."""
+        if self.weight_dtype is not None:
+            return 0.0, 0.0
+        nb = float(self.weights.nbytes)
+        return nb, nb
+
 
 class LinearMapEstimator(LabelEstimator):
     """OLS/ridge via distributed normal equations on mean-centered features
@@ -722,6 +733,23 @@ class BlockLinearMapper(Transformer):
 
     def struct_key(self):
         return (BlockLinearMapper, "affine", self.weight_dtype)
+
+    def sharded_apply_nbytes(self):
+        """(shardable at rest, gather transient) under the spmd
+        sharded apply: every block row-shards, and the in-body gather
+        reassembles ONE block at a time — the transient peak is the
+        largest block, which is what lets a model whose total
+        ``weights.nbytes`` exceeds a single host's budget still be
+        admitted (the concatenated ``weights`` view is derived state
+        the sharded apply never materializes)."""
+        if self.weight_dtype is not None:
+            return 0.0, 0.0
+        # charge the concat view too: it shards right alongside the
+        # blocks (fitted_model_nbytes counted it, so we must as well)
+        total = float(self.weights.nbytes) + sum(
+            float(w.nbytes) for w in self.block_weights)
+        unit = max(float(w.nbytes) for w in self.block_weights)
+        return total, unit
 
     def _block_bounds(self) -> List[tuple]:
         bounds, lo = [], 0

@@ -86,8 +86,15 @@ _LOWER_BETTER_MARKERS = ("error", "stall", "_ms", "_p99", "_latency",
 #: throughput collapse as an "improvement". ``_fill`` (batch fill, a
 #: utilization fraction) and ``availability`` (good-request fraction;
 #: wins over the ``burn_rate``-style lower-better names should a
-#: future key carry both) joined in PR 16.
-_HIGHER_BETTER_MARKERS = ("_qps", "_fill", "availability")
+#: future key carry both) joined in PR 16. ``_efficiency``
+#: (elastic_scaling_efficiency — a falling scaling ratio is the
+#: regression the overlap work exists to prevent) and ``_occupancy``
+#: (coord_overlap_occupancy — coordination hidden behind compute;
+#: wins over the ``_share`` suffix its ``overhead_share`` twin
+#: carries) joined in PR 18, landed before MULTICHIP_r07 first
+#: records them.
+_HIGHER_BETTER_MARKERS = ("_qps", "_fill", "availability",
+                          "_efficiency", "_occupancy")
 
 #: metrics banded in ABSOLUTE units (plain difference, not
 #: percent-of-base): signed shares that hover at ~0, where a relative

@@ -73,6 +73,12 @@ METRIC_NAMES: FrozenSet[str] = frozenset({
     "coord.barrier_wait_s",  # histogram: time spent waiting for peers
                              # at a round boundary / named barrier — a
                              # persistently hot host here is a straggler
+    "coord.overlap_occupancy",  # gauge: 1 - blocked-await wall over
+                             # round wall under the overlapped round
+                             # loop (PR 18) — 1.0 means coordination is
+                             # fully hidden behind accumulate compute,
+                             # 0.0 means every round blocks (the old
+                             # synchronous floor)
     # keystone_tpu/serving — the low-latency multi-tenant serving plane
     # (PR 15). Catalogued from day one: these names cross the scrape
     # surface into dashboards AND the serving CI gate reads them back
@@ -184,6 +190,18 @@ BENCH_METRIC_NAMES: FrozenSet[str] = frozenset({
     "serve_dispatch_share",
     "serve_availability",
     "serving_trace_overhead_share",
+    # overlapped multi-host coordination (PR 18): the elastic bench
+    # emits per-world-size throughput plus the scaling ratio, and the
+    # coordination-cost pair the overlap exists to move — benchdiff
+    # bands `_efficiency`/`_occupancy` higher-is-better and
+    # `_overhead_share` lower-is-better (the shared "_share" marker)
+    "elastic_scaling_efficiency",
+    "coord_overhead_share",      # blocked-await wall / round wall —
+                                 # "measure the await, not the round"
+                                 # (PERFORMANCE.md rule 17)
+    "coord_overlap_occupancy",   # 1 - coord_overhead_share, the bench
+                                 # twin of the coord.overlap_occupancy
+                                 # gauge
 })
 
 

@@ -41,9 +41,26 @@ Coordination telemetry: ``coord.world_size`` gauge,
 histogram (time a host spent waiting for its peers at a round
 boundary — a persistently hot host here IS the straggler the
 ``kind="straggler"`` fault simulates). Every coordination round is
-also a named fault-injection site (``coord.step``), so the host-level
-fault kinds (``host_death`` / ``partition`` / ``straggler``) exercise
-the real coordination path.
+also a named fault-injection site (``coord.step`` at dispatch,
+``coord.await`` at the await point — the kill-mid-overlap window the
+elastic gate drives), so the host-level fault kinds (``host_death`` /
+``partition`` / ``straggler``) exercise the real coordination path.
+
+**Overlapped rounds (PR 18).** The round collective is split into
+:meth:`WorldCoordinator.step_begin` (dispatch: build the
+process-spanning global array and launch the replicating gather —
+JAX async dispatch returns before the gloo exchange completes) and
+:meth:`WorldCoordinator.step_await` (the explicit await point:
+``np.asarray`` on the in-flight result). The streamed-fit loop
+dispatches round k's gather, folds round k+1's chunks, and only then
+awaits round k — coordination hides behind compute. What the fit
+actually BLOCKED on is tracked separately from the round wall:
+``coord.overlap_occupancy`` gauge (1 - blocked/round) and
+:meth:`WorldCoordinator.overhead_share` (blocked-await wall over
+round wall — the number the MULTICHIP artifact reports as
+``coord_overhead_share``). ``KEYSTONE_COORD_OVERLAP=0`` forces the
+synchronous dispatch-and-await path (debugging; same collective
+sequence, zero overlap).
 """
 from __future__ import annotations
 
@@ -53,7 +70,7 @@ import subprocess
 import sys
 import time
 from dataclasses import dataclass
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -100,6 +117,41 @@ class WorldState:
                                 # instead of one raising while the rest
                                 # wedge in the finalize collective
     all_done: bool
+    #: per-host "cursor of the last sidecar I durably wrote" (-1: none).
+    #: This rides the SAME fixed-shape payload as the cursors, which is
+    #: what lets the checkpoint protocol coalesce into the round
+    #: exchange: a host renames its sidecar BEFORE dispatching the
+    #: round that reports it, so by the time host 0 awaits that round,
+    #: every reported sidecar is durable — the happens-before the PR 11
+    #: ckpt-sidecars/ckpt-world barrier pair used to provide, now at
+    #: zero extra collectives.
+    saved_cursors: Tuple[int, ...] = ()
+
+
+@dataclass
+class PendingStep:
+    """One dispatched-but-unawaited coordination round.
+
+    ``payload`` holds the in-flight replicated device array of the
+    round gather (None on the synchronous fallback path, where
+    ``result`` is already materialized). The handle must reach
+    :meth:`WorldCoordinator.step_await` exactly once — the
+    ``unawaited-collective`` pass (analysis/spmd.py) flags a handle
+    that is dropped, rebound, or read before its await point."""
+
+    round: int
+    cursor: int
+    dispatched_at: float
+    flow: int
+    payload: Any = None
+    result: Optional[np.ndarray] = None
+
+
+#: compiled round-gather programs keyed per mesh (Mesh hashes
+#: structurally, so every coordinator over the same world shares one
+#: executable — the _CAST_JIT_CACHE discipline: never memoize a
+#: compiled program on an instance that refits rebuild)
+_GATHER_PROGRAMS: Dict[Any, Any] = {}
 
 
 class WorldCoordinator:
@@ -119,46 +171,144 @@ class WorldCoordinator:
         # greps as one correlated story per host log
         self.trace_id = mint_trace_id("coord")
         self._round_flow: Optional[int] = None
+        # overlap telemetry: cumulative wall the fit BLOCKED at await
+        # points vs cumulative round wall (boundary to boundary) — the
+        # PERFORMANCE.md rule-17 split ("measure the await, not the
+        # round"). _last_boundary anchors each round's wall.
+        self._await_wall = 0.0
+        self._round_wall = 0.0
+        self._last_boundary: Optional[float] = None
+        self._overlap = os.environ.get(
+            "KEYSTONE_COORD_OVERLAP", "1") not in ("0", "false", "off")
+        # the gather mesh: structural, cheap to rebuild; the compiled
+        # gather program itself lives in the module-level per-mesh
+        # cache (_gather_program) so a refit's fresh coordinator reuses
+        # the executable — ONE compile per process (the payload is
+        # fixed-shape (1, 4) int64), armed-fence safe
+        self._gather_mesh = None
         MetricsRegistry.get_or_create().gauge(
             "coord.world_size").set(self.nproc)
 
     # -- the per-round collective ------------------------------------------
-    def step(self, cursor: int, done: bool,
-             has_carry: bool = True) -> WorldState:
-        """Exchange ``(cursor, done, has_carry)`` with every peer. The
-        allgather is fixed-shape ((3,) int64), so it compiles exactly
+    def _dispatch_gather(self, row: np.ndarray):
+        """Dispatch the round allgather WITHOUT blocking: this host's
+        (1, 4) row becomes its shard of a process-spanning global
+        array, and a cached replicating identity program launches the
+        cross-host exchange. JAX async dispatch returns as soon as the
+        program is enqueued; the gloo transfer proceeds on the backend
+        threads while the caller accumulates the next round's chunks.
+        ``np.asarray`` on the returned array is the only block."""
+        import jax
+        from jax.experimental.multihost_utils import (
+            host_local_array_to_global_array,
+        )
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        if self._gather_mesh is None:
+            devs = np.asarray(jax.devices()).reshape(self.nproc, -1)
+            self._gather_mesh = Mesh(devs, ("proc", "dev"))
+        glob = host_local_array_to_global_array(
+            row[None, :], self._gather_mesh, PartitionSpec("proc"))
+        fn = _GATHER_PROGRAMS.get(self._gather_mesh)
+        if fn is None:
+            fn = jax.jit(
+                lambda x: x,
+                out_shardings=NamedSharding(self._gather_mesh,
+                                            PartitionSpec()))
+            _GATHER_PROGRAMS[self._gather_mesh] = fn
+        return fn(glob)
+
+    def step_begin(self, cursor: int, done: bool, has_carry: bool = True,
+                   saved_cursor: int = -1) -> PendingStep:
+        """Dispatch one round's ``(cursor, done, has_carry,
+        saved_cursor)`` exchange and return the in-flight handle. The
+        allgather is fixed-shape ((1, 4) int64), so it compiles exactly
         once — round 2 onward is collective-only, which is what lets
         the PR 9 warmup fence stay armed across rounds on the
-        distributed path."""
+        distributed path. Every handle must reach :meth:`step_await`
+        exactly once, in dispatch order."""
         inject("coord.step", context=f"{self.tag}:round{self.rounds}")
-        from jax.experimental.multihost_utils import process_allgather
-
         t0 = time.perf_counter()
-        gathered = np.asarray(process_allgather(
-            np.array([int(cursor), 1 if done else 0,
-                      1 if has_carry else 0], np.int64)))
-        wait_s = time.perf_counter() - t0
+        row = np.array([int(cursor), 1 if done else 0,
+                        1 if has_carry else 0, int(saved_cursor)],
+                       np.int64)
+        flow = mint_flow_id()
+        pend = PendingStep(round=self.rounds, cursor=int(cursor),
+                           dispatched_at=t0, flow=flow)
+        if self._overlap:
+            pend.payload = self._dispatch_gather(row)
+        else:
+            from jax.experimental.multihost_utils import process_allgather
+
+            pend.result = np.asarray(process_allgather(row))
+        self.rounds += 1
+        # the dispatch lane: how long launching the collective held the
+        # host (compile on round 1, ~0 after) — distinct from the await
+        # span so the overlap window reads directly off the timeline
+        record_span(f"coord:{self.tag}:dispatch", "coord", t0,
+                    time.perf_counter() - t0,
+                    args={"round": pend.round, "cursor": pend.cursor,
+                          "trace_id": self.trace_id, "flow_out": flow})
+        return pend
+
+    def step_await(self, pending: PendingStep) -> WorldState:
+        """The explicit await point for a dispatched round: block on
+        the in-flight gather (``coord.await`` is the fault site in the
+        dispatch->await window the elastic gate kills a host inside)
+        and fold the world view. Only the time spent HERE is
+        coordination overhead — the round wall is tracked alongside so
+        ``overhead_share`` reports blocked/round, not collective/round.
+        """
+        inject("coord.await", context=f"{self.tag}:round{pending.round}")
+        t0 = time.perf_counter()
+        if pending.result is None:
+            pending.result = np.asarray(pending.payload)
+            pending.payload = None
+        gathered = pending.result
+        end = time.perf_counter()
+        wait_s = end - t0
+        anchor = (self._last_boundary if self._last_boundary is not None
+                  else pending.dispatched_at)
+        self._await_wall += wait_s
+        self._round_wall += max(end - anchor, 1e-9)
+        self._last_boundary = end
         reg = MetricsRegistry.get_or_create()
         reg.histogram("coord.barrier_wait_s").observe(wait_s)
         reg.counter("coord.rounds_total").inc()
-        # flow-chain the rounds: each span finishes the previous
+        reg.gauge("coord.overlap_occupancy").set(
+            max(0.0, 1.0 - self.overhead_share()))
+        # flow-chain the rounds: each await span finishes the previous
         # round's flow id and starts a fresh one, so Perfetto draws the
-        # fit as one arrowed chain under the coordinator's trace id
-        flow = mint_flow_id()
-        args: dict = {"round": self.rounds, "cursor": int(cursor),
-                      "trace_id": self.trace_id, "flow_out": flow}
+        # fit as one arrowed chain under the coordinator's trace id —
+        # dispatch spans join the chain through the shared flow ids
+        args: dict = {"round": pending.round, "cursor": pending.cursor,
+                      "trace_id": self.trace_id, "flow_out": pending.flow}
         if self._round_flow is not None:
             args["flow_in"] = [self._round_flow]
-        self._round_flow = flow
+        self._round_flow = pending.flow
         record_span(f"coord:{self.tag}", "coord", t0, wait_s, args=args)
-        state = WorldState(
-            round=self.rounds,
+        return WorldState(
+            round=pending.round,
             cursors=tuple(int(c) for c in gathered[:, 0]),
             dones=tuple(bool(d) for d in gathered[:, 1]),
             carries=tuple(bool(c) for c in gathered[:, 2]),
-            all_done=bool(gathered[:, 1].all()))
-        self.rounds += 1
-        return state
+            all_done=bool(gathered[:, 1].all()),
+            saved_cursors=tuple(int(s) for s in gathered[:, 3]))
+
+    def step(self, cursor: int, done: bool,
+             has_carry: bool = True) -> WorldState:
+        """Synchronous round: dispatch and immediately await (the
+        pre-overlap shape; tests and non-pipelined callers)."""
+        pending = self.step_begin(cursor, done, has_carry=has_carry)
+        return self.step_await(pending)
+
+    def overhead_share(self) -> float:
+        """Blocked-await wall over round wall, cumulative across the
+        fit: the fraction of coordination the overlap did NOT hide.
+        0.0 until the first await lands."""
+        if self._round_wall <= 0.0:
+            return 0.0
+        return min(1.0, self._await_wall / self._round_wall)
 
     def barrier(self, name: str) -> None:
         """A named world barrier. Names must come from a FIXED set per

@@ -283,6 +283,21 @@ def local_mesh(model: int = 1) -> Mesh:
     return make_mesh(jax.local_devices(), model=model)
 
 
+def world_data_mesh(model: int = 1) -> Mesh:
+    """A ('data', 'model') mesh over EVERY process's devices — the
+    world mesh the sharded apply (:mod:`keystone_tpu.parallel.
+    spmd_apply`) runs on: batch rows and resident weight rows both
+    shard over the global ``data`` axis, so one logical model serves
+    from N hosts' HBM. Single-process this is the default mesh over
+    all visible devices; under a live ``jax.distributed`` world the
+    data axis spans hosts (cross-host gathers over DCN/gloo). Device
+    order is jax's global enumeration — process-major — so each host's
+    row shards are contiguous in the global batch."""
+    import jax
+
+    return make_mesh(jax.devices(), model=model)
+
+
 def initialize_distributed(coordinator_address=None, num_processes=None,
                            process_id=None):
     """Multi-host initialization (the DCN scale-out entry point): wires

@@ -63,6 +63,7 @@ resumes bit-comparably instead of restarting.
 from __future__ import annotations
 
 import atexit
+import os
 import queue
 import threading
 import time
@@ -70,6 +71,7 @@ import weakref
 from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
@@ -1076,6 +1078,53 @@ def _restore_carry(host_carry: Any, mesh: Mesh) -> Any:
     return jax.tree_util.tree_map(put, host_carry)
 
 
+# ONE copy program per carry structure (jit re-specializes per leaf
+# shapes/dtypes, so the module-level handle is safe to share): jnp.copy,
+# NOT ``x + 0`` — adding zero flips -0.0 to +0.0 and a snapshot that is
+# not BIT-identical with the carry it cuts breaks the kill-and-resume
+# bit-identity contract in the last ulp.
+_copy_carry_leaves = jax.jit(lambda leaves: [jnp.copy(leaf) for leaf in leaves])
+
+
+def _snapshot_carry_async(carry: Any):
+    """Start copying the live carry to host WITHOUT blocking the
+    stream. The jitted copy enqueues AFTER the round's accumulates
+    (per-device execution order is dispatch order) and BEFORE the next
+    round's accumulates can donate the buffers — so the copy is a
+    consistent cut at the quiesced round boundary even with donation
+    on — then ``copy_to_host_async`` starts the D2H transfer behind
+    the next round's compute. Host leaves (the Python int cursor
+    ``n``) pass through untouched. Materialize the returned handle
+    with :func:`_materialize_snapshot` one boundary later."""
+    if carry is None:
+        return None
+    leaves, treedef = jax.tree_util.tree_flatten(carry)
+    device_ix = [i for i, leaf in enumerate(leaves)
+                 if isinstance(leaf, jax.Array)]
+    copies = (_copy_carry_leaves([leaves[i] for i in device_ix])
+              if device_ix else [])
+    for cp in copies:
+        try:
+            cp.copy_to_host_async()
+        except AttributeError:  # backends without async D2H: await lands it
+            pass
+    return (treedef, leaves, device_ix, copies)
+
+
+def _materialize_snapshot(snap: Any) -> Any:
+    """Land a :func:`_snapshot_carry_async` handle on host. Called one
+    round boundary after the cut, when the async copy has drained
+    behind the interleaved compute — so the ``np.asarray`` here blocks
+    on (almost) nothing."""
+    if snap is None:
+        return None
+    treedef, leaves, device_ix, copies = snap
+    out = list(leaves)
+    for i, cp in zip(device_ix, copies):
+        out[i] = np.asarray(cp)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def fit_streaming(estimator: Any, data: StreamingDataset,
                   labels: Any = None, hbm_budget: Optional[float] = None,
                   checkpoint_dir: Optional[str] = None,
@@ -1306,21 +1355,54 @@ def fit_streaming(estimator: Any, data: StreamingDataset,
                     obs.arm_fence(f"fit_streaming:{tag}")
                     fence_armed = True
         else:
-            # the distributed round loop: every host folds up to
-            # round_len shard-local chunks, then ALL hosts meet in one
-            # fixed-shape coordination collective — so every host runs
-            # the same round count (a host whose shard exhausts early
-            # idles at the barrier) and the collectives always match
-            # up. Coordinated checkpoints happen at round boundaries:
-            # sidecar per host, barrier, world snapshot by host 0,
-            # barrier — a consistent cut a relaunched world resumes
-            # from.
-            round_len = (16 if checkpoint_every is None
-                         else int(checkpoint_every))
+            # the distributed OVERLAPPED round loop: every host folds
+            # up to round_len shard-local chunks, DISPATCHES its round
+            # collective (step_begin — JAX async dispatch; the gloo
+            # exchange proceeds on backend threads), and only awaits
+            # the PREVIOUS round (step_await) — so round k's
+            # coordination hides behind round k+1's accumulates. The
+            # SPMD contract still holds by construction: the awaited
+            # state sequence is identical on every host, so every host
+            # runs the same round count and breaks at the same
+            # boundary (a host whose shard exhausts early keeps
+            # stepping with done=1 until all_done).
+            #
+            # Checkpoints coalesce into the round exchange — zero
+            # extra collectives. At each boundary a host cuts an ASYNC
+            # host copy of its carry (a quiesced-boundary cut: the
+            # copy enqueues before the next round's accumulates can
+            # donate the buffers), writes the sidecar one boundary
+            # LATER (the copy has drained behind the compute), and
+            # reports the durably-written cursor in the NEXT round's
+            # payload. Host 0 merges the world snapshot only after
+            # AWAITING a round in which every host reported a sidecar:
+            # the allgather itself is the happens-before the old
+            # ckpt-sidecars/ckpt-world barrier pair provided. A
+            # sidecar may trail its host's live cursor by one round;
+            # resume re-accumulates that round's chunks — the normal
+            # replay path, still bit-identical.
+            if checkpoint_every is not None:
+                round_len = int(checkpoint_every)
+            else:
+                raw_len = os.environ.get("KEYSTONE_COORD_ROUND_LEN", "16")
+                try:
+                    round_len = int(raw_len)
+                except ValueError:
+                    raise ValueError(
+                        "KEYSTONE_COORD_ROUND_LEN must be an integer "
+                        "(chunks folded per coordination round), got "
+                        f"{raw_len!r} — see CLUSTER.md 'Sizing the "
+                        "coordination round'")
+                if round_len < 1:
+                    raise ValueError(
+                        f"KEYSTONE_COORD_ROUND_LEN must be >= 1, got "
+                        f"{round_len}")
             chunk_iter = _paired_chunks(data, labels)
             local_done = False
-            last_world_cursors = None  # cursors at the last snapshot
-            last_saved_cursor = None   # THIS host's last sidecar write
+            last_saved_cursor = -1    # this host's last DURABLE sidecar
+            last_merged_saved = None  # host 0: frontier at last merge
+            pending = None            # dispatched-but-unawaited round
+            pending_snap = None       # (cursor, async copy, q/n states)
             final_state = None
             while True:
                 in_round = 0
@@ -1335,38 +1417,64 @@ def fit_streaming(estimator: Any, data: StreamingDataset,
                         continue  # resume replay: already folded in
                     accumulate_one(chunk, lchunk)
                     in_round += 1
-                state = world.step(cursor=idx + 1, done=local_done,
-                                   has_carry=carry is not None)
-                # a checkpoint round runs only when SOME host made
-                # progress since the last snapshot — every host decides
-                # from the same gathered cursors, so the barriers below
-                # stay matched; an already-done host rejoins them
-                # without re-pickling its unchanged state to shared
-                # storage every round its straggling peers keep working
-                if ckpt is not None and state.cursors != last_world_cursors:
-                    if last_saved_cursor != idx + 1:
-                        q_state, n_state = snapshot_states()
-                        ckpt.save_host(fingerprint, world.pid, idx + 1,
-                                       carry, q_state, numerics=n_state)
-                        last_saved_cursor = idx + 1
-                    world.barrier("ckpt-sidecars")
-                    if world.pid == 0:
-                        ckpt.merge_hosts(world.nproc)
-                    world.barrier("ckpt-world")
-                    last_world_cursors = state.cursors
+                # lagged sidecar write: the copy cut at the LAST
+                # boundary drained behind this round's compute, and it
+                # lands durably (atomic rename) BEFORE the dispatch
+                # below reports its cursor to the world
+                if pending_snap is not None:
+                    snap_cursor, snap, q_state, n_state = pending_snap
+                    ckpt.save_host(fingerprint, world.pid, snap_cursor,
+                                   _materialize_snapshot(snap), q_state,
+                                   numerics=n_state)
+                    last_saved_cursor = snap_cursor
+                    pending_snap = None
+                new_pending = world.step_begin(
+                    cursor=idx + 1, done=local_done,
+                    has_carry=carry is not None,
+                    saved_cursor=last_saved_cursor)
+                # cut this boundary's snapshot (the copy rides the
+                # same per-device queue, so it still precedes any
+                # donation by the next round's accumulates) — only
+                # when this host advanced since its last cut, so a
+                # done host stops re-pickling unchanged state while
+                # straggling peers keep working
+                if ckpt is not None and idx + 1 != last_saved_cursor:
+                    q_state, n_state = snapshot_states()
+                    pending_snap = (idx + 1, _snapshot_carry_async(carry),
+                                    q_state, n_state)
                 if not fence_armed and chunks_seen >= 1:
-                    # the distributed fence arms after the FIRST round:
-                    # by then the per-chunk programs AND the
-                    # fixed-shape coordination collectives (step
-                    # allgather, checkpoint barriers) have all
-                    # compiled, so every later round must compile
-                    # nothing — the PR 9 invariant, now held across
-                    # process boundaries
+                    # the distributed fence arms after the FIRST
+                    # boundary's dispatch: by then the per-chunk
+                    # programs, the fixed-shape round gather, and the
+                    # carry-copy program have all compiled, so every
+                    # later round — dispatch, await, snapshot cut —
+                    # must compile nothing: the PR 9 invariant, held
+                    # across process boundaries AND across the
+                    # dispatch/await split (overlap adds zero compiles)
                     obs.arm_fence(f"fit_streaming:{tag}")
                     fence_armed = True
-                if state.all_done:
-                    final_state = state
-                    break
+                if pending is not None:
+                    state = world.step_await(pending)
+                    # host 0's barrier-free merge: every saved_cursor
+                    # in an AWAITED round was durable before its host
+                    # dispatched that round, so the sidecars all exist
+                    # — merge whenever the world's sidecar frontier
+                    # moved (atomic sidecar renames mean a concurrent
+                    # writer can only make a slice NEWER, never torn)
+                    if (ckpt is not None and world.pid == 0
+                            and min(state.saved_cursors) >= 0
+                            and state.saved_cursors != last_merged_saved):
+                        ckpt.merge_hosts(world.nproc)
+                        last_merged_saved = state.saved_cursors
+                    if state.all_done:
+                        final_state = state
+                        # drain the round dispatched above — every
+                        # host observed all_done at the same awaited
+                        # boundary, so every host drains the same
+                        # final round and no handle is left in flight
+                        world.step_await(new_pending)
+                        break
+                pending = new_pending
             if not all(final_state.carries):
                 # an empty peer shard: every host learned it from the
                 # same step exchange, so every host raises the SAME

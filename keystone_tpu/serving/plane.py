@@ -247,12 +247,20 @@ class ServingPlane:
                  drift_every: int = 32,
                  policy: Optional[BucketPolicy] = None,
                  mesh: Any = None, steady_fence: bool = True,
-                 slo_policy: Any = None):
+                 slo_policy: Any = None, data_shards: int = 1):
         from ..observability.slo import SloTracker
         from ..parallel.mesh import get_mesh, num_data_shards
 
         self.mesh = mesh or get_mesh()
         self._shards = num_data_shards(self.mesh)
+        #: >1 opts admission into the sharded-apply charge arithmetic
+        #: (parallel/spmd_apply.py): ``hbm_budget`` then reads as ONE
+        #: HOST's budget, each model's shardable fitted state divides
+        #: across the data axis, and a model whose total model_nbytes
+        #: exceeds the per-host budget can still be placed (CLUSTER.md
+        #: "Serving topology"). 1 (default) keeps the replicated
+        #: single-host charge.
+        self.data_shards = max(int(data_shards), 1)
         self.policy = policy or BucketPolicy(max_batch)
         self.ledger = ResidencyLedger(hbm_budget)
         self.batcher = MicroBatcher(queue_depth)
@@ -401,7 +409,8 @@ class ServingPlane:
         _apply_weight_dtype(pipeline.graph, wd)
         blob = pickle.dumps(working)
         buckets = self.policy.rows(self._shards)
-        charge = model_charge(pipeline, sample, buckets[-1], name=name)
+        charge = model_charge(pipeline, sample, buckets[-1], name=name,
+                              data_shards=self.data_shards)
         entry = ServedModel(
             name=name, fitted=pipeline, blob=blob, sample=sample,
             charge=charge, buckets=buckets, weight_dtype=wd,

@@ -51,18 +51,36 @@ class ModelCharge:
     ``bucket_rows`` the LARGEST request bucket the model will serve —
     the activation bound is charged at the worst case, so a full bucket
     arriving never busts the budget at runtime. ``source`` records the
-    provenance (``static-plan`` | ``probed``)."""
+    provenance (``static-plan`` | ``probed``).
+
+    ``data_shards > 1`` makes :meth:`total_nbytes` the PER-HOST charge
+    under the sharded apply (``parallel/spmd_apply.py``): the
+    ``shardable_nbytes`` portion of the model divides across the data
+    axis, one ``gather_nbytes`` transient is charged for the in-body
+    all_gather, and the activation is this host's row shard of the
+    bucket — so admission can place a model whose total
+    ``model_nbytes`` exceeds one host's budget."""
 
     model_nbytes: float
     item_nbytes: float
     bucket_rows: int
     source: str = "static-plan"
+    data_shards: int = 1
+    shardable_nbytes: float = 0.0
+    gather_nbytes: float = 0.0
 
     def activation_nbytes(self) -> float:
-        return float(self.item_nbytes) * float(self.bucket_rows)
+        shards = max(int(self.data_shards), 1)
+        shard_rows = -(-int(self.bucket_rows) // shards)
+        return float(self.item_nbytes) * float(shard_rows)
 
     def total_nbytes(self) -> float:
-        return float(self.model_nbytes) + self.activation_nbytes()
+        shards = max(int(self.data_shards), 1)
+        shardable = min(float(self.shardable_nbytes),
+                        float(self.model_nbytes))
+        resident = float(self.model_nbytes) - shardable + shardable / shards
+        gather = float(self.gather_nbytes) if shards > 1 else 0.0
+        return resident + gather + self.activation_nbytes()
 
 
 def _probe_item_nbytes(fitted, sample_struct) -> float:
@@ -90,7 +108,7 @@ def _probe_item_nbytes(fitted, sample_struct) -> float:
 
 
 def model_charge(fitted, sample_struct, bucket_rows: int,
-                 name: str = "model") -> ModelCharge:
+                 name: str = "model", data_shards: int = 1) -> ModelCharge:
     """Derive the admission charge for ``fitted`` serving items of
     ``sample_struct`` (a ``jax.ShapeDtypeStruct`` pytree describing ONE
     request item) at a largest bucket of ``bucket_rows`` rows.
@@ -99,23 +117,40 @@ def model_charge(fitted, sample_struct, bucket_rows: int,
     ``check``-ed on the item spec with unknown ``n`` (the apply-path
     view), ``apply_item_nbytes`` sizes the activation and
     ``fitted_model_nbytes`` the resident parameters. A plan that cannot
-    size the activation falls back to the one-item probe."""
+    size the activation falls back to the one-item probe.
+
+    ``data_shards > 1`` sizes the PER-HOST charge under the sharded
+    apply: the mappers' ``sharded_apply_nbytes`` hooks say how much of
+    the fitted state row-shards at rest and how large the gather
+    transient is (see :class:`ModelCharge`)."""
     from ..analysis.resources import (
         fitted_model_nbytes,
         serving_residency_nbytes,
+        sharded_apply_nbytes,
     )
 
+    graph = fitted.to_pipeline().graph
     report = fitted.check(sample_struct, name=f"serve:{name}")
-    model_b = fitted_model_nbytes(fitted.to_pipeline().graph)
-    total = serving_residency_nbytes(model_b, report.plan, bucket_rows)
+    model_b = fitted_model_nbytes(graph)
+    shards = max(int(data_shards), 1)
+    shardable = gather = 0.0
+    if shards > 1:
+        shardable, gather = sharded_apply_nbytes(graph)
+    total = serving_residency_nbytes(
+        model_b, report.plan, bucket_rows, data_shards=shards,
+        shardable_nbytes=shardable, gather_nbytes=gather)
     if total is not None:
         return ModelCharge(model_nbytes=model_b,
                            item_nbytes=float(report.plan.apply_item_nbytes),
                            bucket_rows=int(bucket_rows),
-                           source="static-plan")
+                           source="static-plan", data_shards=shards,
+                           shardable_nbytes=shardable,
+                           gather_nbytes=gather)
     item_b = _probe_item_nbytes(fitted, sample_struct)
     return ModelCharge(model_nbytes=model_b, item_nbytes=item_b,
-                       bucket_rows=int(bucket_rows), source="probed")
+                       bucket_rows=int(bucket_rows), source="probed",
+                       data_shards=shards, shardable_nbytes=shardable,
+                       gather_nbytes=gather)
 
 
 @guarded_by("_lock", "_charges")

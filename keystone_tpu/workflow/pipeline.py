@@ -144,7 +144,8 @@ class Chainable:
         return self.bind_datum(data)
 
     def check(self, sample: Any = None, name: str = "pipeline",
-              hbm_budget: Optional[float] = None):
+              hbm_budget: Optional[float] = None,
+              data_shards: Optional[int] = None):
         """Statically check this stage/pipeline: propagate shape/dtype
         specs from ``sample`` (a ``jax.ShapeDtypeStruct``,
         ``(shape, dtype)`` tuple, array, Dataset, or ``analysis`` spec
@@ -153,13 +154,16 @@ class Chainable:
         effects into a static HBM plan (``report.plan``).
         ``hbm_budget`` (bytes) turns a predicted over-budget fit into an
         ``hbm-budget`` ERROR diagnostic before anything executes.
+        ``data_shards`` overrides the planner's data-axis width: the
+        per-host view of an N-shard world, checkable from one host.
         Returns an :class:`~keystone_tpu.analysis.AnalysisReport`;
         inspect ``report.ok`` / ``report.diagnostics`` /
         ``report.plan`` / ``report.summary()``."""
         from ..analysis import check_pipeline
 
         return check_pipeline(self, sample, name=name,
-                              hbm_budget=hbm_budget)
+                              hbm_budget=hbm_budget,
+                              data_shards=data_shards)
 
 
 class Pipeline(Chainable):

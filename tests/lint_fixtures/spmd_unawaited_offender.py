@@ -1,0 +1,53 @@
+"""Deliberate async-coordination hazards for the unawaited-collective
+pass (analysis/spmd.py, pass 5): dispatched round handles that never
+reach their ``step_await``, plus a pending ``result`` read mid-flight.
+Scanned as text by tests/test_spmd_passes.py; never imported or run.
+The clean shapes at the bottom are the shipped pipelined round loop
+(parallel/streaming.py) in miniature — the pass must stay silent on
+them or it would flag the very overlap it exists to protect.
+"""
+
+
+def discarded_dispatch(world, cursor):
+    # handle dropped on the floor: peers block in this allgather and
+    # the result is never read — the next boundary folds a stale view
+    world.step_begin(cursor=cursor, done=False)
+
+
+def rebound_before_await(world):
+    handle = world.step_begin(cursor=0, done=False)
+    handle = world.step_begin(cursor=1, done=False)  # round 0 lost
+    return world.step_await(handle)
+
+
+def result_read_mid_flight(world):
+    handle = world.step_begin(cursor=0, done=False)
+    rows = handle.result  # races the in-flight allgather (still None)
+    world.step_await(handle)
+    return rows
+
+
+def scope_exit_leak(world):
+    handle = world.step_begin(cursor=0, done=False)
+    return handle.round  # round number is host-side; await never runs
+
+
+def pipelined_loop_is_clean(world, chunks):
+    # the fit_streaming overlap shape: dispatch round k+1, await round
+    # k, alias-transfer the handle, drain the extra round at the break
+    # — every handle reaches exactly one await
+    pending = None
+    for idx, _ in enumerate(chunks):
+        new_pending = world.step_begin(cursor=idx, done=False)
+        if pending is not None:
+            state = world.step_await(pending)
+            if state.all_done:
+                world.step_await(new_pending)
+                break
+        pending = new_pending
+
+
+def inline_await_is_clean(world):
+    # dispatch+await in one expression is a complete (synchronous)
+    # round, not a leak
+    return world.step_await(world.step_begin(cursor=0, done=True))

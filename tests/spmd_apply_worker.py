@@ -1,0 +1,90 @@
+"""Worker for the cross-process sharded-apply parity test (ISSUE 18
+tentpole b): each member of a 2-process ``DryrunWorld`` builds the
+WORLD data mesh (data axis spanning both hosts' devices), places the
+same fitted mappers' weights row-sharded across it, and applies its
+LOCAL row block through ``sharded_apply`` — the real
+``host_local_array_to_global_array`` + in-body ``all_gather`` path the
+single-process 8-virtual-device tests (``test_spmd_apply.py``) can
+only approximate.
+
+Parity is asserted IN the worker at the acceptance bar: <= 1e-5
+against the single-host ``model.apply`` of the same local rows, with
+IDENTICAL prediction argmax, across bucket sizes including ragged
+tails (local row counts not divisible by the per-host device count).
+A green exit prints ``SPMD_APPLY_OK``.
+
+Usage (the launcher appends the positionals)::
+
+    python tests/spmd_apply_worker.py <process_id> <num_processes> <port>
+"""
+import sys
+
+import numpy as np
+
+
+def main():
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+    import jax
+
+    from keystone_tpu.parallel.mesh import (
+        initialize_distributed,
+        mesh_scope,
+        world_data_mesh,
+    )
+
+    initialize_distributed(f"127.0.0.1:{port}", nproc, pid)
+    assert jax.process_count() == nproc, jax.process_count()
+
+    from keystone_tpu.nodes.learning.linear import (
+        BlockLinearMapper,
+        LinearMapper,
+    )
+    from keystone_tpu.nodes.stats import StandardScalerModel
+    from keystone_tpu.parallel.spmd_apply import sharded_apply
+
+    d, k = 37, 5  # divides neither the shard count nor the 16-row blocks
+    rng = np.random.RandomState(0)  # same fitted state on every host
+    affine = LinearMapper(
+        rng.randn(d, k).astype(np.float32),
+        intercept=rng.randn(k).astype(np.float32),
+        feature_scaler=StandardScalerModel(
+            rng.randn(d).astype(np.float32),
+            (0.5 + rng.rand(d)).astype(np.float32)))
+    w = rng.randn(d, k).astype(np.float32)
+    block = BlockLinearMapper(
+        [w[lo:lo + 16] for lo in range(0, d, 16)], block_size=16,
+        intercept=rng.randn(k).astype(np.float32),
+        feature_means=rng.randn(d).astype(np.float32))
+
+    mesh = world_data_mesh()
+    checked = 0
+    with mesh_scope(mesh):
+        # local row counts per bucket: every host the same count (the
+        # PR 15 bucket contract); 13 is a ragged tail for the 2 local
+        # devices, 1 the degenerate pad
+        for n_local in (1, 8, 13):
+            # per-host data differs (seeded by pid): the global batch
+            # is the process-major concat, each host reads back only
+            # its own rows
+            x = np.random.RandomState(100 + 10 * pid + n_local).randn(
+                n_local, d).astype(np.float32)
+            for model in (affine, block):
+                ref = np.asarray(model.apply(x))
+                got = np.asarray(sharded_apply(model, x, mesh))
+                assert got.shape == ref.shape, (got.shape, ref.shape)
+                rel = (np.abs(ref - got).max()
+                       / max(float(np.abs(ref).max()), 1.0))
+                assert rel <= 1e-5, (
+                    f"pid {pid} bucket {n_local} "
+                    f"{type(model).__name__}: delta {rel}")
+                assert (np.argmax(ref, axis=1)
+                        == np.argmax(got, axis=1)).all()
+                checked += 1
+
+    print(f"SPMD_APPLY_OK pid={pid} world={nproc} cases={checked}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

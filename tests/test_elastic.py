@@ -176,6 +176,23 @@ def test_scaling_metric_emitted(elastic_runs):
     assert metrics[0]["processes"] == 2
 
 
+def test_two_process_sharded_apply_parity(tmp_path):
+    """ISSUE 18 tentpole b: ``sharded_apply`` over the WORLD mesh —
+    weights row-sharded across both hosts, batches entering as
+    host-local rows through the real ``host_local_array_to_global_array``
+    path — matches the single-host ``model.apply`` <= 1e-5 with
+    identical argmax, across buckets including ragged tails (asserted
+    in-worker, see ``spmd_apply_worker.py``)."""
+    worker = os.path.join(os.path.dirname(__file__),
+                          "spmd_apply_worker.py")
+    world = DryrunWorld(num_processes=2, devices_per_process=2,
+                        workdir=str(tmp_path), grace_s=20)
+    codes = world.launch([sys.executable, worker]).wait(timeout_s=300)
+    for p in range(2):
+        assert codes[p] == 0, (p, codes, world.output(p)[-2000:])
+        assert f"SPMD_APPLY_OK pid={p}" in world.output(p)
+
+
 # -- world-size / checkpoint-format semantics (in-process) -------------------
 
 def _world_snapshot(ckdir, fingerprints, cursors, carries):
@@ -317,6 +334,11 @@ def _soak_plan(seed):
                      after=int(rng.randint(3)),
                      count=int(1 + rng.randint(2)), delay_s=0.1)
     plan.add("coord.step", kind="host_death", process_id=1, count=1)
+    # the overlap window (ISSUE 18): a second host_death aimed at the
+    # AWAIT point — between a round's dispatch and its await, when the
+    # allgather and the lagged carry snapshot are both in flight. Same
+    # process gate: dormant here, live in the dryrun worlds.
+    plan.add("coord.await", kind="host_death", process_id=1, count=1)
     return plan
 
 

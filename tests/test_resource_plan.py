@@ -94,6 +94,57 @@ def test_check_cli_budget_exit_codes(mesh8, capsys):
     assert check_main(["mnist.random_fft", "--budget", "much"]) == 2
 
 
+def test_check_budget_verifies_per_host_charge(mesh8, capsys):
+    """ISSUE 18 acceptance: ``check --budget --shards N`` verifies the
+    per-host charge device-free. ``data_shards`` reaches the plan (the
+    pad-to-shard width changes the charged rows), the CLI accepts the
+    spelling, and the serving admission arithmetic derived FROM that
+    plan divides the shardable fitted state across the shard count."""
+    from keystone_tpu.analysis.resources import (
+        serving_residency_nbytes,
+        sharded_apply_nbytes,
+    )
+
+    target = resolve_check_app("mnist.random_fft")()
+    # a 7-shard world pads 60000 rows to 60004: the plumbed-through
+    # width is visible in the plan's charged bytes
+    r7 = target.pipeline.check(target.input_spec, data_shards=7)
+    r8 = target.pipeline.check(target.input_spec, data_shards=8)
+    assert r7.plan.fit_peak_nbytes > r8.plan.fit_peak_nbytes
+    # the per-host serving charge from the SAME device-free plan: a
+    # fitted block model's shardable state divides across the shards,
+    # so the 8-shard charge undercuts the replicated one
+    X = np.random.RandomState(0).rand(64, 96).astype(np.float32)
+    Y = np.random.RandomState(1).rand(64, 8).astype(np.float32)
+    fitted = BlockLeastSquaresEstimator(32, num_iter=1, lam=1e-3)\
+        .with_data(StreamingDataset.from_numpy(X, chunk_size=32)
+                   .materialize(),
+                   StreamingDataset.from_numpy(Y, chunk_size=32)
+                   .materialize()).fit()
+    report = fitted.check(jax.ShapeDtypeStruct((96,), np.float32))
+    graph = fitted.to_pipeline().graph
+    from keystone_tpu.analysis.resources import fitted_model_nbytes
+
+    model_b = fitted_model_nbytes(graph)
+    shardable, gather = sharded_apply_nbytes(graph)
+    assert shardable > 0 and 0 < gather < shardable
+    charge1 = serving_residency_nbytes(model_b, report.plan, 16)
+    charge8 = serving_residency_nbytes(
+        model_b, report.plan, 16, data_shards=8,
+        shardable_nbytes=shardable, gather_nbytes=gather)
+    assert charge8 is not None and charge1 is not None
+    assert charge8 < charge1
+    assert charge8 == pytest.approx(
+        model_b - shardable + shardable / 8 + gather
+        + 2 * report.plan.apply_item_nbytes)  # ceil(16/8) rows
+    # the CLI spelling: --shards plumbs through with --budget
+    assert check_main(["mnist.random_fft", "--budget", "1TiB",
+                       "--shards", "8"]) == 0
+    assert check_main(["mnist.random_fft", "--budget", "1MiB",
+                       "--shards", "8"]) == 2
+    capsys.readouterr()
+
+
 def test_parse_bytes_spellings():
     assert _parse_bytes("1024") == 1024
     assert _parse_bytes("4k") == 4096

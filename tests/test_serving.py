@@ -35,6 +35,7 @@ from keystone_tpu.serving import (
     AdmissionError,
     BucketPolicy,
     MicroBatcher,
+    ModelCharge,
     ModelNotAdmitted,
     QueueFullError,
     ServingPlane,
@@ -114,6 +115,70 @@ def test_model_charge_uses_static_plan():
     assert charge.item_nbytes > 0
     assert charge.total_nbytes() == pytest.approx(
         charge.model_nbytes + 16 * charge.item_nbytes)
+
+
+def test_model_charge_per_host_arithmetic():
+    """``data_shards > 1`` turns total_nbytes into the PER-HOST charge
+    (ISSUE 18): the shardable fitted state divides across the data
+    axis, ONE gather transient is added, and the activation is this
+    host's row shard of the bucket (ceil division)."""
+    c = ModelCharge(model_nbytes=1000.0, item_nbytes=4.0, bucket_rows=16,
+                    data_shards=8, shardable_nbytes=800.0,
+                    gather_nbytes=100.0)
+    assert c.activation_nbytes() == pytest.approx(4.0 * 2)  # ceil(16/8)
+    assert c.total_nbytes() == pytest.approx(
+        (1000.0 - 800.0) + 800.0 / 8 + 100.0 + 8.0)
+    # the replicated (shards=1) charge ignores the gather transient and
+    # keeps the full model plus the full bucket's activation
+    c1 = ModelCharge(model_nbytes=1000.0, item_nbytes=4.0, bucket_rows=16,
+                     shardable_nbytes=800.0, gather_nbytes=100.0)
+    assert c1.total_nbytes() == pytest.approx(1000.0 + 16 * 4.0)
+
+
+def _make_block_fitted(d, k, block_size, seed=0, n=96):
+    from keystone_tpu.nodes.learning.linear import (
+        BlockLeastSquaresEstimator,
+    )
+
+    r = np.random.RandomState(seed)
+    X = r.rand(n, d).astype(np.float32)
+    Y = r.rand(n, k).astype(np.float32)
+    return BlockLeastSquaresEstimator(
+        block_size, num_iter=2, lam=1e-3).with_data(
+        ArrayDataset.from_numpy(X), ArrayDataset.from_numpy(Y)).fit()
+
+
+def test_sharded_charge_admits_model_exceeding_one_hosts_budget(
+        plane_factory):
+    """Acceptance (ISSUE 18): a BlockLinearMapper whose total
+    ``model_nbytes`` exceeds ONE host's budget is admitted on a
+    ``data_shards=8`` plane — the at-rest state divides across the
+    data axis and the gather transient is one block, not the whole
+    matrix — and the SAME budget refuses it on a replicated plane."""
+    fitted = _make_block_fitted(256, 16, block_size=64)
+    charge1 = model_charge(fitted, _sample(256), 16)
+    charge8 = model_charge(fitted, _sample(256), 16, data_shards=8)
+    assert charge8.data_shards == 8 and charge8.shardable_nbytes > 0
+    # one block gathers at a time: the transient is smaller than the
+    # at-rest shardable state it reassembles slices of
+    assert 0 < charge8.gather_nbytes < charge8.shardable_nbytes
+    assert charge8.total_nbytes() < charge1.total_nbytes()
+    # a budget BETWEEN the per-host and the replicated charge: too
+    # small for the whole model, roomy for one host's shard
+    budget = (charge8.total_nbytes() + charge1.total_nbytes()) / 2
+    assert charge8.model_nbytes > budget
+
+    replicated = plane_factory(hbm_budget=budget)
+    replicated.start()
+    with pytest.raises(AdmissionError, match="refusing"):
+        replicated.admit("blk", fitted, _sample(256))
+
+    sharded = plane_factory(hbm_budget=budget, data_shards=8)
+    sharded.start()
+    sharded.admit("blk", fitted, _sample(256))
+    state = sharded.state()
+    assert [m["name"] for m in state["models"]] == ["blk"]
+    assert state["hbm_charged_bytes"] <= budget
 
 
 # -- the load test (acceptance) ----------------------------------------------

@@ -1,0 +1,205 @@
+"""shard_map data-parallel apply (``parallel/spmd_apply.py``, ISSUE 18
+tentpole b): batch rows shard ``P('data')``, LinearMapper /
+BlockLinearMapper weights row-shard AT REST and gather transiently
+inside the body — the 8-virtual-device conftest mesh is the
+single-process stand-in for the world mesh (the cross-host case rides
+the dryrun worlds in ``test_elastic.py``).
+
+Pins the acceptance bar: parity with the single-host ``model.apply``
+<= 1e-5 with IDENTICAL prediction argmax, across bucket sizes
+including ragged tails (rows not divisible by the shard count, weight
+rows not divisible either — the zero-pad must never reach the math);
+plus the compile-once discipline (refits of the same shapes add no
+``_PROGRAMS`` entries, the serving warmup fence's contract).
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from keystone_tpu.nodes.learning.linear import (
+    BlockLinearMapper,
+    LinearMapper,
+)
+from keystone_tpu.nodes.stats import StandardScalerModel
+from keystone_tpu.parallel import spmd_apply
+from keystone_tpu.parallel.mesh import make_mesh, mesh_scope, num_data_shards
+from keystone_tpu.parallel.spmd_apply import (
+    shard_batch,
+    shard_rows,
+    sharded_apply,
+    sharded_chain_apply,
+    unshard_batch,
+)
+from keystone_tpu.workflow.optimizer.fusion import fused_transformer
+
+D, K = 37, 5  # 37: divides NEITHER 8 shards NOR the 16-row blocks
+
+# bucket ladder with ragged tails: 13 and 50 are not multiples of 8,
+# 64 spans several rows per shard, 1 exercises the degenerate pad
+BUCKETS = (1, 8, 13, 50, 64)
+
+
+def _affine_model(seed=0, scaled=True):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(D, K).astype(np.float32)
+    b = rng.randn(K).astype(np.float32)
+    scaler = None
+    if scaled:
+        scaler = StandardScalerModel(
+            rng.randn(D).astype(np.float32),
+            (0.5 + rng.rand(D)).astype(np.float32))
+    return LinearMapper(w, intercept=b, feature_scaler=scaler)
+
+
+def _block_model(seed=1):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(D, K).astype(np.float32)
+    blocks = [w[lo:lo + 16] for lo in range(0, D, 16)]  # 16/16/5
+    return BlockLinearMapper(
+        blocks, block_size=16,
+        intercept=rng.randn(K).astype(np.float32),
+        feature_means=rng.randn(D).astype(np.float32))
+
+
+def _x(n, seed=7):
+    return np.random.RandomState(seed + n).randn(n, D).astype(np.float32)
+
+
+def _assert_parity(ref, got, n):
+    ref, got = np.asarray(ref), np.asarray(got)
+    assert got.shape == ref.shape
+    rel = np.abs(ref - got).max() / max(float(np.abs(ref).max()), 1.0)
+    assert rel <= 1e-5, f"bucket {n}: sharded-apply delta {rel}"
+    np.testing.assert_array_equal(
+        np.argmax(ref, axis=1), np.argmax(got, axis=1))
+
+
+# -- shard/unshard plumbing ---------------------------------------------------
+
+def test_shard_rows_pads_to_shard_multiple(mesh8):
+    w = np.arange(D * K, dtype=np.float32).reshape(D, K)
+    placed = shard_rows(w, mesh8)
+    shards = num_data_shards(mesh8)
+    assert placed.shape[0] % shards == 0 and placed.shape[0] >= D
+    # pad rows are zero, payload rows untouched
+    host = np.asarray(placed)
+    np.testing.assert_array_equal(host[:D], w)
+    assert (host[D:] == 0).all()
+
+
+@pytest.mark.parametrize("n", BUCKETS)
+def test_shard_batch_roundtrip(mesh8, n):
+    x = _x(n)
+    xg, true_n = shard_batch(x, mesh8)
+    assert true_n == n and xg.shape[0] % num_data_shards(mesh8) == 0
+    np.testing.assert_array_equal(
+        np.asarray(unshard_batch(xg, true_n, mesh8)), x)
+
+
+# -- parity across buckets (the acceptance pin) ------------------------------
+
+@pytest.mark.parametrize("n", BUCKETS)
+def test_affine_parity_across_buckets(mesh8, n):
+    """LinearMapper (scaler + intercept, d=37 indivisible by the 8
+    shards): sharded apply == single-host apply <= 1e-5, same argmax."""
+    model = _affine_model()
+    x = _x(n)
+    _assert_parity(model.apply(x), sharded_apply(model, x, mesh8), n)
+
+
+def test_affine_parity_without_scaler(mesh8):
+    model = _affine_model(seed=3, scaled=False)
+    x = _x(50)
+    _assert_parity(model.apply(x), sharded_apply(model, x, mesh8), 50)
+
+
+@pytest.mark.parametrize("n", BUCKETS)
+def test_block_parity_uneven_blocks(mesh8, n):
+    """BlockLinearMapper with a ragged last block (16/16/5 over d=37),
+    feature means + intercept: the one-block-at-a-time gather body
+    must match the concatenated single-host GEMM."""
+    model = _block_model()
+    x = _x(n, seed=11)
+    _assert_parity(model.apply(x), sharded_apply(model, x, mesh8), n)
+
+
+def test_quantized_mapper_batch_only_parity(mesh8):
+    """Quantized mappers keep the fused dequant program — only the
+    batch shards. Sharded output must equal the mapper's own quantized
+    apply EXACTLY (same program, same params, just a sharded batch)."""
+    rng = np.random.RandomState(5)
+    model = LinearMapper(rng.randn(D, K).astype(np.float32),
+                         intercept=rng.randn(K).astype(np.float32),
+                         weight_dtype="bf16")
+    x = _x(13)
+    np.testing.assert_allclose(
+        np.asarray(sharded_apply(model, x, mesh8)),
+        np.asarray(model.apply(x)), rtol=0, atol=0)
+
+
+def test_chain_parity_fused_featurize(mesh8):
+    """A fused featurize chain rides batch sharding: GSPMD partitions
+    the one param-threaded program, parity holds at the same bar."""
+    rng = np.random.RandomState(9)
+    scaler = StandardScalerModel(rng.randn(D).astype(np.float32),
+                                 (0.5 + rng.rand(D)).astype(np.float32))
+    mapper = LinearMapper(rng.randn(D, K).astype(np.float32),
+                          intercept=rng.randn(K).astype(np.float32))
+    fused = fused_transformer([scaler, mapper])
+    x = _x(50, seed=21)
+    ref = mapper.apply(scaler.apply(x))
+    _assert_parity(ref, sharded_chain_apply(fused, x, mesh8), 50)
+
+
+def test_single_vs_eight_shard_mesh_parity():
+    """The same model applied on a 1-device mesh and the 8-device mesh
+    agrees <= 1e-5 with identical argmax — the shard count changes only
+    the f32 summation layout, never the math."""
+    model = _affine_model(seed=13)
+    x = _x(64, seed=17)
+    with mesh_scope(make_mesh(jax.devices()[:1])) as m1:
+        out1 = np.asarray(sharded_apply(model, x, m1))
+    with mesh_scope(make_mesh(jax.devices()[:8])) as m8:
+        out8 = np.asarray(sharded_apply(model, x, m8))
+    _assert_parity(out1, out8, 64)
+
+
+# -- compile discipline -------------------------------------------------------
+
+def test_programs_cached_per_mesh_and_static_dims(mesh8):
+    """Refits of the same shapes reuse the shard_map program: params
+    ride as arguments (the ``_affine_apply_batch`` content-free
+    discipline), so repeated applies and NEW model instances with the
+    same static dims add no ``_PROGRAMS`` entries — which is what
+    keeps the serving warmup fence clean across refits."""
+    model = _affine_model(seed=23)
+    x = _x(8)
+    sharded_apply(model, x, mesh8)
+    assert (mesh8, "affine", D) in spmd_apply._PROGRAMS
+    after_first = len(spmd_apply._PROGRAMS)
+    # same instance, new bucket: row count is not a static dim
+    sharded_apply(model, _x(64), mesh8)
+    # a refit (new instance, same shapes) reuses the program
+    sharded_apply(_affine_model(seed=29), x, mesh8)
+    assert len(spmd_apply._PROGRAMS) == after_first
+    # the block flavor keys on its bounds, not the model instance
+    blk = _block_model(seed=31)
+    sharded_apply(blk, x, mesh8)
+    assert (mesh8, "block", tuple(blk._block_bounds())) \
+        in spmd_apply._PROGRAMS
+    n_with_block = len(spmd_apply._PROGRAMS)
+    sharded_apply(_block_model(seed=37), _x(13), mesh8)
+    assert len(spmd_apply._PROGRAMS) == n_with_block
+
+
+def test_sharded_params_cached_on_model(mesh8):
+    """The at-rest placement is cached per (model, mesh) under a
+    ``_jit_`` attribute (pickling strips it); a second apply reuses
+    the placed shards instead of re-transferring."""
+    model = _affine_model(seed=41)
+    sharded_apply(model, _x(8), mesh8)
+    cached = model.__dict__["_jit_sharded_params"]
+    assert cached[0] is mesh8
+    sharded_apply(model, _x(13), mesh8)
+    assert model.__dict__["_jit_sharded_params"] is cached

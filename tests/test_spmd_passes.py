@@ -23,6 +23,7 @@ from keystone_tpu.analysis.spmd import (
     collective_divergence,
     scan_package,
     sharding_flow_lint,
+    unawaited_collective,
     world_checkpoint_consistency,
 )
 
@@ -310,6 +311,66 @@ def test_checkpoint_allowlist_suppresses():
                    "unbarriered_clear:clear",
                    "raw_carry_restore:carry"})
     assert hits == []
+
+
+# -- pass 5: unawaited coordination handles ----------------------------------
+
+def test_unawaited_collective_fires_on_offender():
+    hits = unawaited_collective(_tree("spmd_unawaited_offender"))
+    codes = sorted(c for _, c, _ in hits)
+    assert codes == ["stale-coordination-read"] + \
+        ["unawaited-collective"] * 3
+    msgs = " ".join(m for _, _, m in hits)
+    # the four hazard shapes: discarded handle, rebind-before-await,
+    # mid-flight result read, scope-exit leak — and NOT the pipelined
+    # loop or the inline dispatch+await
+    assert "discarded_dispatch" in msgs
+    assert "rebound_before_await" in msgs
+    assert "result_read_mid_flight" in msgs
+    assert "scope_exit_leak" in msgs
+    assert "pipelined_loop_is_clean" not in msgs
+    assert "inline_await_is_clean" not in msgs
+
+
+def test_unawaited_alias_transfer_and_post_loop_await_are_clean():
+    """The software-pipeline idiom WITHOUT a drain-at-break: the handle
+    alias-transfers through ``pending = new`` each round and the final
+    round is awaited after the loop — one await per handle, clean."""
+    src = (
+        "def pipelined(world, chunks):\n"
+        "    pending = None\n"
+        "    for idx, _ in enumerate(chunks):\n"
+        "        new = world.step_begin(cursor=idx, done=False)\n"
+        "        if pending is not None:\n"
+        "            world.step_await(pending)\n"
+        "        pending = new\n"
+        "    if pending is not None:\n"
+        "        world.step_await(pending)\n")
+    assert unawaited_collective(ast.parse(src)) == []
+    # dropping the post-loop await leaks the last round's handle
+    bad = src[:src.rindex("    if pending is not None:")]
+    hits = unawaited_collective(ast.parse(bad))
+    assert [c for _, c, _ in hits] == ["unawaited-collective"]
+    assert "escape the scope unawaited" in hits[0][2]
+
+
+def test_unawaited_allowlist_suppresses_by_scope():
+    hits = unawaited_collective(
+        _tree("spmd_unawaited_offender"),
+        allowlist={"discarded_dispatch:step_begin",
+                   "rebound_before_await:step_begin",
+                   "result_read_mid_flight:step_begin",
+                   "scope_exit_leak:step_begin"})
+    assert hits == []
+
+
+def test_shipped_overlap_loop_is_unawaited_clean():
+    """The real overlapped round loop (parallel/streaming.py) and the
+    coordinator itself must scan clean — the pass protects the overlap,
+    it must not flag it."""
+    for rel in ("parallel/streaming.py", "parallel/distributed.py"):
+        tree = ast.parse((REPO / "keystone_tpu" / rel).read_text())
+        assert unawaited_collective(tree) == [], rel
 
 
 def test_nested_defs_are_their_own_scanned_scopes():

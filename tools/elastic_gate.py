@@ -4,7 +4,7 @@ the resumed weights must be BIT-IDENTICAL to the uninterrupted run.
 
 The dynamic pin for the elastic multi-host plane
 (``parallel/distributed.py``), the cross-process complement of the
-recompile and numerics gates. Three worlds of 2 CPU processes (2
+recompile and numerics gates. Five worlds of 2 CPU processes (2
 virtual devices each) run the same shard-local streamed LinearMap fit
 through the real ``jax.distributed`` + gloo path:
 
@@ -13,11 +13,22 @@ through the real ``jax.distributed`` + gloo path:
    coordination round 2 (exit code 117, after exactly 2 coordinated
    checkpoints); the launcher applies gang semantics and reaps the
    wedged survivor — the world snapshot (per-host cursors + carries,
-   written by host 0 behind barriers) is what survives;
+   merged by host 0 from the durably-renamed sidecars) is what
+   survives;
 3. **relaunched** — the same world resumes from the shared
    ``StreamCheckpoint``: every worker must report ``resumed=1`` and
    ``unexpected_compiles=0`` (the PR 9 warmup fence stays clean across
-   a resume), and host 0's weights must equal run 1's bit for bit.
+   a resume), and host 0's weights must equal run 1's bit for bit;
+4. **killed mid-overlap** — the kill lands at round 2's AWAIT point,
+   i.e. BETWEEN a round's dispatch and its await under the overlapped
+   loop (PR 18): round 2's allgather and the lagged carry snapshot are
+   both in flight when the host dies — the hardest window, because the
+   surviving sidecars may legitimately trail the live cursor by one
+   round (the overlap's lagged-snapshot contract);
+5. **relaunched again** — resume from the mid-overlap kill's snapshot:
+   sidecar-trailing resume replays the un-snapshotted round and must
+   STILL produce bit-identical weights with a clean fence (resume
+   re-accumulates from the quiesced boundary, never from a torn one).
 
 Exit 1 names the divergent artifact (which run, which file, max
 delta). Run by ``bin/ci.sh``; standalone::
@@ -84,12 +95,12 @@ def main() -> int:
 
     world = DryrunWorld(num_processes=2, devices_per_process=2,
                         workdir=workdir, grace_s=20)
-    print("elastic gate: run 1/3 — uninterrupted 2-process streamed fit")
+    print("elastic gate: run 1/5 — uninterrupted 2-process streamed fit")
     codes = world.launch(base + ["--out", out_a]).wait(timeout_s=300)
     if not _check_world(world, codes, "uninterrupted", expect_resumed=0):
         return 1
 
-    print(f"elastic gate: run 2/3 — kill process 1 at round {KILL_ROUND}")
+    print(f"elastic gate: run 2/5 — kill process 1 at round {KILL_ROUND}")
     codes = world.launch(
         base + ["--checkpoint-dir", ckdir, "--checkpoint-every", "1",
                 "--die-process", "1",
@@ -105,7 +116,7 @@ def main() -> int:
               file=sys.stderr)
         return 1
 
-    print("elastic gate: run 3/3 — relaunch the world, resume, compare")
+    print("elastic gate: run 3/5 — relaunch the world, resume, compare")
     codes = world.launch(
         base + ["--checkpoint-dir", ckdir, "--checkpoint-every", "1",
                 "--out", out_c]).wait(timeout_s=300)
@@ -127,8 +138,55 @@ def main() -> int:
               "successful finalize (stale snapshots must be cleared)",
               file=sys.stderr)
         return 1
-    print("elastic gate OK: killed world resumed to bit-identical "
-          "weights, fence clean, snapshot cleared")
+
+    # -- the overlap window: kill BETWEEN dispatch and await -----------------
+    ckdir2 = os.path.join(workdir, "ck-overlap")
+    out_e = os.path.join(workdir, "resumed-overlap.npz")
+    print(f"elastic gate: run 4/5 — kill process 1 at round "
+          f"{KILL_ROUND}'s await (mid-overlap: allgather + carry "
+          "snapshot in flight)")
+    codes = world.launch(
+        base + ["--checkpoint-dir", ckdir2, "--checkpoint-every", "1",
+                "--die-process", "1",
+                "--die-at-await-round", str(KILL_ROUND)]
+    ).wait(timeout_s=300)
+    if world.host_death_exits(codes) != [1]:
+        print(f"elastic gate FAILED: expected process 1 to die of "
+              f"host_death at the await point (exit "
+              f"{HOST_DEATH_EXIT_CODE}), got exit codes {codes}",
+              file=sys.stderr)
+        return 1
+    if not os.path.exists(os.path.join(ckdir2, "stream_fit.ckpt")):
+        print("elastic gate FAILED: the mid-overlap kill left no "
+              f"shared world snapshot under {ckdir2} — nothing to "
+              "resume from", file=sys.stderr)
+        return 1
+
+    print("elastic gate: run 5/5 — relaunch after the mid-overlap "
+          "kill, resume, compare")
+    codes = world.launch(
+        base + ["--checkpoint-dir", ckdir2, "--checkpoint-every", "1",
+                "--out", out_e]).wait(timeout_s=300)
+    if not _check_world(world, codes, "overlap-resumed",
+                        expect_resumed=1):
+        return 1
+    w_e = np.load(out_e)["weights"]
+    if not (w_a == w_e).all():
+        delta = float(np.abs(w_a - w_e).max())
+        print(f"elastic gate FAILED: weights resumed from a "
+              f"mid-overlap kill diverge from the uninterrupted run "
+              f"(max |delta| {delta:.3e}; divergent artifact: {out_e} "
+              f"vs reference {out_a}) — the lagged-snapshot resume is "
+              "no longer bit-identical", file=sys.stderr)
+        return 1
+    if os.path.exists(os.path.join(ckdir2, "stream_fit.ckpt")):
+        print("elastic gate FAILED: the overlap-run world snapshot "
+              "survived a successful finalize (stale snapshots must "
+              "be cleared)", file=sys.stderr)
+        return 1
+    print("elastic gate OK: killed worlds (round entry AND "
+          "mid-overlap await) resumed to bit-identical weights, "
+          "fence clean, snapshots cleared")
     return 0
 
 
