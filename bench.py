@@ -1410,15 +1410,17 @@ def serving_bench():
     micro-batched QPS and tail latency through the REAL request path —
     slot-gated bounded queue, pad-to-bucket coalescing, two warm
     resident models (one f32, one bf16-quantized per the PR 13 serving
-    default) under an asserted HBM admission budget. Client threads
-    submit variable-size requests for a fixed window; latency is
+    default) under an asserted HBM admission budget. The window is
+    driven by the deterministic trace-replay load generator
+    (``serving/loadgen.py``, PR 19) — seeded bursty arrivals, Zipf
+    model popularity, mixed request sizes — instead of uniform client
+    threads, so the measured tail comes from traffic-shaped load and
+    the same trace replays identically across rounds; latency is
     measured per request end-to-end (enqueue -> result, the
     ``serving.request_ms`` semantics) and the compile-observatory
     fence stays armed for the whole window — a single steady-state
     recompile fails the section, because the zero-recompile invariant
     is asserted, not hoped (PERFORMANCE.md rule 14)."""
-    import threading
-
     from keystone_tpu.nodes.learning.linear import LinearMapEstimator
     from keystone_tpu.observability import compile_observatory
     from keystone_tpu.observability.utilization import UtilizationWindow
@@ -1431,8 +1433,6 @@ def serving_bench():
     max_batch = 32 if SMALL else 64
     window_s = 2.0 if SMALL else float(_scaled(8, mult=1, floor=4))
     clients = 4
-
-    rng = np.random.RandomState(3)
 
     def fit(d, seed, **kw):
         r = np.random.RandomState(seed)
@@ -1478,48 +1478,53 @@ def serving_bench():
         qw_total0, disp_total0 = qw_h.total, disp_h.total
         req_total0 = req_h.total
         good0, bad0 = plane.slo.totals()
+        rows0 = reg.counter("serving.rows_total").value
         u0 = plane.unexpected_recompiles()
-        stop = threading.Event()
-        latencies = [[] for _ in range(clients)]
-        rows_done = [0] * clients
-        sizes = rng.randint(1, max_batch // 2 + 1, size=256)
 
-        def client(i):
-            data = (X1, X2)
-            names = ("f32", "bf16")
-            j = 0
-            while not stop.is_set():
-                pick = (i + j) % 2
-                n = int(sizes[(i * 31 + j) % len(sizes)])
-                x = data[pick][(j * 7) % (n_fit - n):][:n]
-                t0 = time.perf_counter()
-                plane.predict(names[pick], x, timeout_s=60.0)
-                latencies[i].append(time.perf_counter() - t0)
-                rows_done[i] += n
-                j += 1
+        # the deterministic load window (PR 19): a seeded trace —
+        # bursty arrivals, Zipf popularity across the two models,
+        # mixed request sizes — replayed by closed-loop senders. The
+        # schedule is oversubscribed on purpose: the senders fall
+        # behind the arrival clock and drive the plane flat out, so
+        # the qps line still measures capacity while the model/size
+        # SEQUENCE stays identical across rounds.
+        from keystone_tpu.serving.loadgen import LoadSpec, generate_trace
+        from keystone_tpu.serving.loadgen import replay as replay_trace
+
+        spec = LoadSpec(
+            seed=5, duration_s=window_s, rate_rps=1500.0,
+            arrival="bursty", models=("f32", "bf16"), zipf_s=1.2,
+            sizes=(1, 4, 8, max_batch // 2),
+            burst_mult=2.0, burst_on_s=0.5, burst_off_s=0.25)
+        trace = generate_trace(spec)
+        data = {"f32": X1, "bf16": X2}
+
+        def input_for(model, n):
+            return data[model][:n]
 
         with UtilizationWindow() as uw:
-            threads = [threading.Thread(target=client, args=(i,),
-                                        daemon=True)
-                       for i in range(clients)]
-            t_start = time.perf_counter()
-            for t in threads:
-                t.start()
-            time.sleep(window_s)
-            stop.set()
-            for t in threads:
-                t.join(timeout=60)
-            wall = time.perf_counter() - t_start
+            report = replay_trace(
+                trace, plane, input_for, senders=clients,
+                submit_timeout_s=30.0, result_timeout_s=60.0)
+        wall = report.wall_s
 
         unexpected = plane.unexpected_recompiles() - u0
         if unexpected:
             raise RuntimeError(
                 f"{unexpected:.0f} steady-state serving recompile(s) — "
                 "the zero-recompile invariant is asserted, not hoped")
-        lat_ms = np.asarray(sorted(sum(latencies, [])), np.float64) * 1e3
+        broken = (report.outcomes["error"]
+                  + report.outcomes["unclassified"]
+                  + report.outcomes["poisoned"])
+        if broken:
+            raise RuntimeError(
+                f"{broken} request(s) FAILED in the fault-free bench "
+                f"window: {report.errors[:4]}")
+        lat_ms = np.sort(np.asarray(report.latencies_ms, np.float64))
         if lat_ms.size == 0:
             raise RuntimeError("serving window completed zero requests")
-        qps_rows = sum(rows_done) / wall
+        qps_rows = (reg.counter("serving.rows_total").value
+                    - rows0) / wall
         per_chip = qps_rows / n_dev
         requests_per_sec = lat_ms.size / wall
         batches = reg.counter("serving.batches_total").value - batches0
@@ -1530,6 +1535,8 @@ def serving_bench():
         common = dict(
             models=2, clients=clients, window_s=round(wall, 2),
             max_batch=max_batch,
+            loadgen=dict(seed=spec.seed, arrival=spec.arrival,
+                         rate_rps=spec.rate_rps, zipf_s=spec.zipf_s),
             requests_per_sec=round(requests_per_sec, 1),
             batches_per_sec=round(batches / wall, 1),
             batch_fill=(None if mean_fill is None
@@ -1655,6 +1662,74 @@ def serving_bench():
                       round(trace_share / 0.02, 3), **common)
     finally:
         plane.close()
+
+
+#: chaos-soak bench lines, one gated pair per scenario. The names are
+#: spelled out literally (not derived from the scenario registry) so
+#: the BENCH_METRIC_NAMES catalogue test can hold them to the same
+#: rename discipline as every other bench line — and so a scenario
+#: silently dropped from the catalogue fails THIS section loudly
+#: instead of its lines just vanishing from the artifact.
+_SOAK_LINES = {
+    "burst": ("soak_burst_p99_ms", "soak_burst_availability"),
+    "diurnal": ("soak_diurnal_p99_ms", "soak_diurnal_availability"),
+    "zipf_churn": ("soak_zipf_churn_p99_ms",
+                   "soak_zipf_churn_availability"),
+    "straggler_dispatch": ("soak_straggler_dispatch_p99_ms",
+                           "soak_straggler_dispatch_availability"),
+    "poisoned_batch": ("soak_poisoned_batch_p99_ms",
+                       "soak_poisoned_batch_availability"),
+    "overload_shed": ("soak_overload_shed_p99_ms",
+                      "soak_overload_shed_availability"),
+}
+
+
+def serving_soak_bench():
+    """The chaos soak (PR 19): replay each ``serving/scenarios``
+    catalogue entry — deterministic loadgen trace under its seeded
+    fault plan — against a fresh plane, and emit the gated pair per
+    scenario: p99 of served requests (lower-better ``_ms``) and
+    accepted-request availability (higher-better, the PR 16
+    ``availability`` marker). vs_baseline is the scenario's own floor,
+    so >1.0 on a ``_ms`` line or <1.0 on an availability line reads as
+    "this round violated the chaos-gate floor". Floors are ENFORCED by
+    ``tools/chaos_gate.py`` in CI; here a violation is emitted (with
+    the violations named on the line), never raised — a bench round
+    must record the regression, not hide the whole section."""
+    from keystone_tpu.serving.scenarios import (
+        SCENARIOS,
+        load_catalogue,
+        run_scenario,
+    )
+
+    load_catalogue()
+    missing = sorted(set(_SOAK_LINES) - set(SCENARIOS))
+    if missing:
+        raise RuntimeError(
+            f"scenario(s) {missing} dropped from the catalogue but "
+            "still carry catalogued soak bench lines")
+    # SMALL smoke runs keep the pair of scenarios that exercise both
+    # ends of the contract (fair-weather tail + classified faults);
+    # full runs soak the whole catalogue
+    names = (("burst", "poisoned_batch") if SMALL
+             else tuple(sorted(_SOAK_LINES)))
+    for name in names:
+        p99_line, avail_line = _SOAK_LINES[name]
+        res = run_scenario(name, seed=0)
+        extra = dict(
+            scenario=name, seed=0, injections=res.injections,
+            clean=res.clean,
+            p99_floor_ms=res.floors.p99_ms,
+            availability_floor=res.floors.availability,
+            outcomes={k: int(v) for k, v in res.report.outcomes.items()})
+        if not res.clean:
+            extra["violations"] = res.violations
+            extra["postmortem"] = res.postmortem_path
+        _emit(p99_line, round(res.p99_ms, 3), "ms",
+              round(res.p99_ms / res.floors.p99_ms, 4), **extra)
+        _emit(avail_line, round(res.availability, 6), "fraction",
+              round(res.availability / res.floors.availability, 4),
+              **extra)
 
 
 def elastic_coordination_bench():
@@ -2122,6 +2197,7 @@ def main():
         (imagenet_rehearsal_bench, 130),
         (pallas_kernels_bench, 60),
         (serving_bench, 45),
+        (serving_soak_bench, 40),
         (e2e_bench, 60),
         (loader_bench, 60),
         (streamed_e2e_bench, 60),
@@ -2220,6 +2296,7 @@ if __name__ == "__main__":
         "--voc": voc_bench,
         "--streamed-e2e": streamed_e2e_bench,
         "--serving": serving_bench,
+        "--serving-soak": serving_soak_bench,
     }
     argv = list(sys.argv[1:])
     trace_out = _pop_trace_out(argv)

@@ -61,6 +61,16 @@
 #                                  both models, and fail on any fenced
 #                                  steady-state recompile or a
 #                                  /healthz-not-ready timeout
+#   2b''''. chaos gate             tools/chaos_gate.py — the serving
+#                                  scenario catalogue (burst, diurnal,
+#                                  zipf-churn, straggler-dispatch,
+#                                  poisoned-batch, overload-shed) at
+#                                  bounded seeds: deterministic trace
+#                                  replay under seeded serve.* faults;
+#                                  every run ends clean or CLASSIFIED
+#                                  with a post-mortem naming
+#                                  scenario+seed; a violated
+#                                  p99/availability floor exits 1 by name
 #   2c. bounded-seed stress        the deterministic-interleaving suite
 #                                  (tests/test_concurrency_sched.py):
 #                                  historical-race regression schedules +
@@ -154,6 +164,16 @@ if (( run_tests )); then
   # armed observatory fence must record ZERO steady-state recompiles
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     "$PY" "$KEYSTONE_HOME/tools/serving_gate.py"
+
+  echo "== ci: chaos gate (scenario catalogue at bounded seeds, SLO floors) =="
+  # the dynamic pin for graceful degradation (tools/chaos_gate.py): the
+  # full serving/scenarios catalogue — bursty/diurnal/Zipf traffic,
+  # churn under load, seeded dispatch/admit faults — replayed in
+  # process at bounded seeds; every run must end clean or in a
+  # CLASSIFIED failure with a post-mortem naming scenario+seed, and a
+  # violated p99/availability floor fails the gate by name
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    "$PY" "$KEYSTONE_HOME/tools/chaos_gate.py" --seeds 2
 
   echo "== ci: bounded-seed concurrency stress (regression schedules + fuzz) =="
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \

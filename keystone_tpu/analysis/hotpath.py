@@ -147,6 +147,11 @@ HOTPATH_ALLOWLIST: FrozenSet[str] = frozenset({
     # the `dispatch` stamp (parallel/dataset.py, parallel/mesh.py)
     "bucketed_dataset:asarray",
     "_shard_pytree:asarray",
+    # the poisoned-batch guard (PR 19): runs AFTER _collect already
+    # materialized the outputs on the host, so the asarray is a
+    # zero-copy view of host numpy, never a device readback — one
+    # vectorized isfinite pass per leaf is the guard's whole cost
+    "_count_nonfinite:asarray",
     "_shard_pytree:device_put",
     "shard_put:device_put",  # the transfer itself
     # waiting on the pool's per-shard puts is the staging barrier: the
@@ -195,6 +200,12 @@ HOTPATH_COLD: FrozenSet[str] = frozenset({
     # the drift-unscorable epilogue: runs once per model lifetime
     # (flips drift_disabled), records a numerics event
     "ServingPlane._disable_drift",
+    # the batch failure path (PR 19): runs only when a batch RAISED
+    # (poisoned outputs, injected dispatch fault) — classifies the
+    # failure onto the batch's undone futures and writes the throttled
+    # post-mortem; deliberately I/O and lazy-import, deliberately off
+    # the steady-state request path (a clean batch never enters it)
+    "ServingPlane._fail_batch",
 })
 
 #: publication-pass exceptions, keyed ``"Class.method:field"``; same

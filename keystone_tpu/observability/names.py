@@ -124,6 +124,23 @@ METRIC_NAMES: FrozenSet[str] = frozenset({
     "serving.slo_violations_total",  # counter: windows that crossed the
                                  # availability target (one post-mortem
                                  # each)
+    # graceful degradation under chaos (PR 19): the shed/poison verdict
+    # counters the chaos gate and dashboards read back — a deadline
+    # shed or a poisoned batch that doesn't move a counter is silent
+    # damage
+    "serving.deadline_expired_total",  # counter: requests whose
+                                 # deadline expired while queued —
+                                 # failed BEFORE dispatch, zero device
+                                 # time burned
+    "serving.shed_total",        # counter: requests shed at batch
+                                 # formation (currently == deadline
+                                 # sheds; kept separate so future
+                                 # load-shedding policies share the
+                                 # dashboard line)
+    "serving.poisoned_batches_total",  # counter: batches whose outputs
+                                 # came back non-finite — the whole
+                                 # batch fails classified (500 +
+                                 # post-mortem), the worker survives
 })
 
 #: catalogued name FAMILIES: a dynamic metric name must start with one
@@ -150,6 +167,13 @@ METRIC_PREFIXES: Tuple[str, ...] = (
     "serving.error_budget_burn_rate.",  # per-model burn-rate gauges
     "slo.",                      # observability/slo.py: one counter per
                                  # SLO event kind (record_slo_event)
+    "chaos.",                    # serving/scenarios: chaos-suite run
+                                 # accounting (chaos.runs_total,
+                                 # chaos.injections_total,
+                                 # chaos.violations_total,
+                                 # chaos.clean_total) — one family so
+                                 # new scenarios don't each touch the
+                                 # catalogue
 )
 
 
@@ -202,6 +226,27 @@ BENCH_METRIC_NAMES: FrozenSet[str] = frozenset({
     "coord_overlap_occupancy",   # 1 - coord_overhead_share, the bench
                                  # twin of the coord.overlap_occupancy
                                  # gauge
+    # the chaos soak (PR 19): serving_soak replays each scenario's
+    # deterministic load trace (serving/loadgen.py) against a fresh
+    # plane under its seeded fault plan and emits the gated pair per
+    # scenario — the p99 of served requests (lower-better, `_ms`) and
+    # accepted-request availability (higher-better, the `availability`
+    # marker landed in PR 16). These are the bench twins of the
+    # chaos-gate floors: benchdiff bands them across rounds so a tail
+    # or availability regression under chaos shows up as a named line,
+    # not a vibe.
+    "soak_burst_p99_ms",
+    "soak_burst_availability",
+    "soak_diurnal_p99_ms",
+    "soak_diurnal_availability",
+    "soak_zipf_churn_p99_ms",
+    "soak_zipf_churn_availability",
+    "soak_straggler_dispatch_p99_ms",
+    "soak_straggler_dispatch_availability",
+    "soak_poisoned_batch_p99_ms",
+    "soak_poisoned_batch_availability",
+    "soak_overload_shed_p99_ms",
+    "soak_overload_shed_availability",
 })
 
 

@@ -20,7 +20,16 @@ site                  where
 ``serve.dispatch``    per micro-batch device dispatch
                       (``serving.plane.ServingPlane._serve_batch``) —
                       a ``straggler`` here is the slow-batch tail the
-                      SLO gate trips on
+                      SLO gate trips on; a ``corrupt`` rule poisons the
+                      MERGED batch value pre-dispatch (the plane's
+                      nonfinite guard must classify it, not serve NaN)
+``serve.admit``       per admission, twice: once BEFORE any plane
+                      mutation (atomic refusal) and once per warmup
+                      bucket (``ServingPlane._warm`` — a mid-warmup
+                      fault must roll the whole admission back)
+``serve.evict``       per explicit eviction, before any mutation
+                      (``ServingPlane.evict`` — eviction under fault
+                      is atomic: fully done or not started)
 ====================  =====================================================
 
 ``inject`` is a single global read when no plan is active — zero cost

@@ -8,10 +8,17 @@ HBM budget with static-planner admission charges and LRU-with-cost
 eviction. ``python -m keystone_tpu serve`` is the CLI;
 ``ServingPlane`` the embeddable core. See README "Serving".
 """
-from .batcher import BucketPolicy, MicroBatcher, QueueFullError, Request
+from .batcher import (
+    BucketPolicy,
+    DeadlineExpiredError,
+    MicroBatcher,
+    QueueFullError,
+    Request,
+)
 from .plane import (
     ModelNotAdmitted,
     ModelWarming,
+    PoisonedBatchError,
     ServedModel,
     ServingPlane,
 )
@@ -20,10 +27,12 @@ from .residency import AdmissionError, ModelCharge, ResidencyLedger, model_charg
 __all__ = [
     "AdmissionError",
     "BucketPolicy",
+    "DeadlineExpiredError",
     "MicroBatcher",
     "ModelCharge",
     "ModelNotAdmitted",
     "ModelWarming",
+    "PoisonedBatchError",
     "QueueFullError",
     "Request",
     "ResidencyLedger",

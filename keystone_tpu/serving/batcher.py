@@ -45,7 +45,22 @@ from ..utils.guarded import (TracedLock, TracedSemaphore, guarded_by,
 
 class QueueFullError(RuntimeError):
     """The bounded request queue is full (slot gate refused within the
-    submit timeout) — the caller should shed load / retry later."""
+    submit timeout) — the caller should shed load / retry later.
+    ``retry_after_s`` is the batcher's drain-rate-based estimate of
+    when a slot will plausibly free (the HTTP surface serves it as a
+    ``Retry-After`` header, so a 429 under sustained overload tells
+    clients WHEN, not just no)."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+class DeadlineExpiredError(RuntimeError):
+    """The request's deadline passed before the worker dispatched it —
+    the request was SHED from the queue without burning device time
+    (the caller's answer would have been too late anyway). The HTTP
+    surface maps this to 504."""
 
 
 @dataclass(frozen=True)
@@ -103,6 +118,17 @@ class Request:
     enqueued_s: float = field(default_factory=time.perf_counter)
     future: Future = field(default_factory=Future)
     trace: Optional[ReqTrace] = None
+    #: absolute perf_counter deadline (None = no deadline). A request
+    #: past its deadline is shed BEFORE dispatch — see
+    #: ``ServingPlane._shed_expired``.
+    deadline_s: Optional[float] = None
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        """True when this request's deadline has passed."""
+        if self.deadline_s is None:
+            return False
+        return (time.perf_counter() if now is None else now) \
+            > self.deadline_s
 
 
 @published_by("_lock", "_closed")
@@ -127,23 +153,43 @@ class MicroBatcher:
         self._pending: Deque[Request] = deque()
         self._closed = False
         self._ready = threading.Event()
+        # drain-rate EWMA (requests/s, fed by done()): the basis of the
+        # Retry-After hint a 429 carries. 0.0 = never drained yet.
+        self._drain_rps = 0.0
+        self._last_done_s = time.perf_counter()
+
+    def retry_after_s(self) -> float:
+        """Seconds until a queue slot plausibly frees: pending depth
+        over the observed drain rate, clamped to [0.05, 10]. Before any
+        drain is observed the submit timeout is the honest hint."""
+        rate = self._drain_rps
+        if rate <= 0.0:
+            return max(self.submit_timeout_s, 0.05)
+        with self._lock:
+            depth = len(self._pending)
+        return min(max(max(depth, 1) / rate, 0.05), 10.0)
 
     # -- producer side (handler threads) -----------------------------------
     @hotpath
     def submit(self, model: str, x: Any, n: int,
-               timeout_s: Optional[float] = None) -> Future:
+               timeout_s: Optional[float] = None,
+               deadline_ms: Optional[float] = None) -> Future:
         """Enqueue one request behind the slot gate; returns its
         future. Raises :class:`QueueFullError` when no slot frees
         within the timeout (bounded queue = bounded latency: better an
         honest 429 than an unbounded wait)."""
-        return self.submit_request(model, x, n, timeout_s=timeout_s).future
+        return self.submit_request(model, x, n, timeout_s=timeout_s,
+                                   deadline_ms=deadline_ms).future
 
     @hotpath
     def submit_request(self, model: str, x: Any, n: int,
-                       timeout_s: Optional[float] = None) -> Request:
+                       timeout_s: Optional[float] = None,
+                       deadline_ms: Optional[float] = None) -> Request:
         """:meth:`submit`, returning the whole :class:`Request` — the
         trace-aware spelling (the HTTP surface echoes
-        ``request.trace.trace_id`` back as ``X-Keystone-Trace``)."""
+        ``request.trace.trace_id`` back as ``X-Keystone-Trace``).
+        ``deadline_ms`` is a client budget relative to enqueue: a
+        request still queued past it is shed before dispatch."""
         inject("serve.enqueue", context=model)
         # lock-free published read: a closed batcher refuses BEFORE the
         # slot gate, so shutdown never costs callers the submit timeout
@@ -158,7 +204,8 @@ class MicroBatcher:
             reg.counter(f"serving.rejected_total.{model}").inc()
             raise QueueFullError(
                 f"serving queue full ({self.queue_depth} slots) — "
-                f"request for {model!r} rejected after {timeout:.1f}s")
+                f"request for {model!r} rejected after {timeout:.1f}s",
+                retry_after_s=self.retry_after_s())
         trace = ReqTrace.new(model, int(n)) if tracing_active() else None
         if trace is None:
             req = Request(model=model, x=x, n=int(n))
@@ -168,6 +215,8 @@ class MicroBatcher:
             # starts here)
             req = Request(model=model, x=x, n=int(n),
                           enqueued_s=trace.enqueued_s, trace=trace)
+        if deadline_ms is not None:
+            req.deadline_s = req.enqueued_s + float(deadline_ms) / 1e3
         with self._lock:
             if self._closed:
                 self._slots.release()
@@ -221,8 +270,17 @@ class MicroBatcher:
     def done(self, count: int) -> None:
         """Free ``count`` slots once their requests' futures resolved —
         the release half of the staging discipline: live queue
-        occupancy provably never exceeds ``queue_depth``."""
+        occupancy provably never exceeds ``queue_depth``. Also feeds
+        the drain-rate EWMA behind :meth:`retry_after_s` (two float
+        writes — single-writer: only the plane worker calls done)."""
         if count > 0:
+            now = time.perf_counter()
+            dt = max(now - self._last_done_s, 1e-6)
+            self._last_done_s = now
+            sample = count / dt
+            prior = self._drain_rps
+            self._drain_rps = sample if prior <= 0.0 \
+                else 0.8 * prior + 0.2 * sample
             self._slots.release(count)
 
     def depth(self) -> int:

@@ -13,7 +13,11 @@ telemetry plane):
   (PR 16) — the handle a client quotes when it asks "where did my
   2-second request spend its time".
   Errors map to honest statuses: 404 unknown model, 503 warming,
-  429 bounded-queue full, 400 shape/JSON errors.
+  429 bounded-queue full (with a drain-rate ``Retry-After`` header),
+  504 deadline shed (an optional ``deadline_ms`` body key bounds how
+  long the request may queue before dispatch), 400 shape/JSON errors,
+  500 batch failure (a poisoned batch names its post-mortem artifact
+  in the error body).
 * ``GET /healthz`` — the REAL readiness gate: 503 ``warming`` until
   every admitted model's warmup compile completed
   (``ServingPlane.ready`` via the ``serve_metrics`` ready-probe).
@@ -47,6 +51,7 @@ to opt out.
 from __future__ import annotations
 
 import json
+import math
 import sys
 from typing import Any, Dict, List, Optional
 from urllib.parse import parse_qs, urlsplit
@@ -58,7 +63,7 @@ from ..observability.reqtrace import exemplar_reservoir
 from ..observability.sampler import _MetricsHandler, _MetricsServer
 from ..observability.slo import SloPolicy
 from ..utils.guarded import hotpath
-from .batcher import QueueFullError
+from .batcher import DeadlineExpiredError, QueueFullError
 from .plane import ModelNotAdmitted, ModelWarming, ServingPlane
 from .residency import AdmissionError
 
@@ -111,11 +116,17 @@ class ServingHandler(_MetricsHandler):
             blob = json.loads(self.rfile.read(length) or b"null")
             instances = (blob.get("instances")
                          if isinstance(blob, dict) else blob)
+            deadline_ms = (blob.get("deadline_ms")
+                           if isinstance(blob, dict) else None)
+            if deadline_ms is not None:
+                deadline_ms = float(deadline_ms)
+                if deadline_ms <= 0:
+                    raise ValueError("deadline_ms must be > 0")
             if not isinstance(instances, list) or not instances:
                 raise ValueError(
                     'body must be {"instances": [...]} or a JSON array')
             out, trace_id = self.plane.predict_traced(
-                name, np.asarray(instances))
+                name, np.asarray(instances), deadline_ms=deadline_ms)
             body = json.dumps({
                 "model": name,
                 "rows": len(instances),
@@ -129,8 +140,18 @@ class ServingHandler(_MetricsHandler):
             self._reply(404, _err(exc))
         except ModelWarming as exc:
             self._reply(503, _err(exc))
+        except DeadlineExpiredError as exc:
+            # the request was shed before dispatch: the honest verdict
+            # is "too late", not "server broke" — 504, like a gateway
+            # giving up on an upstream budget
+            self._reply(504, _err(exc))
         except QueueFullError as exc:
-            self._reply(429, _err(exc))
+            # sustained overload answers WHEN, not just no: the header
+            # carries the batcher's drain-rate estimate (integer
+            # seconds per RFC 9110, floored at 1)
+            self._reply(429, _err(exc), headers={
+                "Retry-After":
+                    str(max(1, math.ceil(exc.retry_after_s)))})
         except (ValueError, TypeError, json.JSONDecodeError) as exc:
             self._reply(400, _err(exc))
         except Exception as exc:  # batch execution failure: honest 500

@@ -65,9 +65,10 @@ from ..observability.reqtrace import (PHASES, exemplar_reservoir,
                                       mint_flow_id)
 from ..observability.timeline import flight_recorder
 from ..parallel.dataset import ArrayDataset, Dataset, bucketed_dataset
-from ..resilience.faults import inject
+from ..resilience.faults import corrupt, inject
 from ..utils.guarded import TracedLock, guarded_by, hotpath, published_by
-from .batcher import BucketPolicy, MicroBatcher, Request
+from .batcher import (BucketPolicy, DeadlineExpiredError, MicroBatcher,
+                      Request)
 from .residency import AdmissionError, ModelCharge, ResidencyLedger, model_charge
 
 
@@ -78,6 +79,14 @@ class ModelNotAdmitted(LookupError):
 class ModelWarming(RuntimeError):
     """The named model is admitted but its warmup has not completed —
     retry after ``/healthz`` reports ready."""
+
+
+class PoisonedBatchError(RuntimeError):
+    """A dispatched batch came back with non-finite outputs (NaN born
+    between enqueue and collect — a poisoned input, or a numeric
+    breakdown in the model). Exactly this batch's requests fail
+    (classified 500, post-mortem attached); the worker and the queue
+    survive to serve the next batch."""
 
 
 #: seconds of request history the QPS estimate looks back over
@@ -160,6 +169,18 @@ class _EvictedModel:
     sample: Any
     weight_dtype: Optional[str]
     evicted_s: float = field(default_factory=time.perf_counter)
+
+
+def _count_nonfinite(outputs: Any) -> int:
+    """Non-finite values in a host output pytree (float leaves only —
+    an integer wire cannot carry NaN). One vectorized pass per leaf:
+    the poisoned-batch guard's whole cost."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(outputs):
+        arr = np.asarray(leaf)
+        if arr.size and np.issubdtype(arr.dtype, np.floating):
+            total += int(arr.size) - int(np.isfinite(arr).sum())
+    return total
 
 
 def _zeros_batch(sample: Any, rows: int) -> Any:
@@ -247,7 +268,9 @@ class ServingPlane:
                  drift_every: int = 32,
                  policy: Optional[BucketPolicy] = None,
                  mesh: Any = None, steady_fence: bool = True,
-                 slo_policy: Any = None, data_shards: int = 1):
+                 slo_policy: Any = None, data_shards: int = 1,
+                 nonfinite_guard: bool = True,
+                 postmortem_min_interval_s: float = 30.0):
         from ..observability.slo import SloTracker
         from ..parallel.mesh import get_mesh, num_data_shards
 
@@ -270,6 +293,14 @@ class ServingPlane:
         self.drift_every = max(int(drift_every), 1)
         self.default_weight_dtype = default_weight_dtype
         self.steady_fence = steady_fence
+        #: fail a batch whose outputs carry NaN/inf instead of handing
+        #: clients silently-poisoned predictions (PoisonedBatchError)
+        self.nonfinite_guard = bool(nonfinite_guard)
+        #: at most one batch-failure post-mortem per this many seconds
+        #: (a chaos storm must not write one artifact per failed batch;
+        #: the scenario harness sets 0 to capture every failure)
+        self.postmortem_min_interval_s = float(postmortem_min_interval_s)
+        self._last_batch_pm_s = -1e18
         self._models: Dict[str, ServedModel] = {}
         #: published lock-free snapshot of the READY residents; only
         #: ever rebound whole under the lock (_publish_locked / close)
@@ -366,10 +397,16 @@ class ServingPlane:
         model must not wedge readiness at 503 forever (review
         finding)."""
         with self._lock:
-            entries = list(self._models.values())
-            return (self._warming == 0
-                    and self._admitted_total >= self._expected
-                    and all(e.ready for e in entries))
+            return self._ready_locked()
+
+    def _ready_locked(self) -> bool:
+        """The readiness verdict with the lock already held — shared by
+        :meth:`ready` and :meth:`state` so a ``/models`` body can never
+        pair a ready=True verdict with a model list from a different
+        instant (the churn-scenario race)."""
+        return (self._warming == 0
+                and self._admitted_total >= self._expected
+                and all(e.ready for e in self._models.values()))
 
     # -- admission ---------------------------------------------------------
     def admit(self, name: str, fitted: Any, sample: Any,
@@ -415,6 +452,11 @@ class ServingPlane:
             name=name, fitted=pipeline, blob=blob, sample=sample,
             charge=charge, buckets=buckets, weight_dtype=wd,
             baseline=_find_baseline(pipeline.graph))
+        # fault site BEFORE any plane mutation: an injected admission
+        # fault here refuses atomically (nothing registered, nothing
+        # evicted); faults MID-warmup fire per bucket inside _warm and
+        # roll back through _finish_warmup instead
+        inject("serve.admit", context=name)
         with self._lock:
             if self._closed:
                 raise RuntimeError("serving plane closed")
@@ -482,7 +524,11 @@ class ServingPlane:
 
     def evict(self, name: str) -> None:
         """Explicitly evict a resident model (its canonical bytes stay
-        host-side for :meth:`readmit`)."""
+        host-side for :meth:`readmit`). The fault site fires BEFORE the
+        lock: an injected eviction fault aborts with the model fully
+        resident — eviction is atomic (all mutations happen in one lock
+        hold, or none happen at all)."""
+        inject("serve.evict", context=name)
         with self._lock:
             if name not in self._models:
                 raise ModelNotAdmitted(f"model {name!r} is not resident")
@@ -592,6 +638,10 @@ class ServingPlane:
         numerics gauges stay untouched (a zeros warmup batch is not
         traffic)."""
         for bucket in entry.buckets:
+            # mid-warmup fault site: a fault between buckets must roll
+            # the whole admission back (_finish_warmup ok=False) — no
+            # half-warmed model is ever published
+            inject("serve.admit", context=(entry.name, bucket))
             self._execute(entry, _zeros_batch(entry.sample, bucket), bucket)
             if bucket > 1:
                 partial = bucket - 1
@@ -617,16 +667,22 @@ class ServingPlane:
     # -- request path ------------------------------------------------------
     @hotpath
     def submit(self, name: str, x: Any,
-               timeout_s: Optional[float] = None):
+               timeout_s: Optional[float] = None,
+               deadline_ms: Optional[float] = None):
         """Enqueue one request; returns a Future resolving to the model
         output for exactly the submitted rows (pad stripped). ``x`` is
         one item (the admitted sample shape) or a leading-dim batch of
-        them, up to the largest bucket."""
-        return self.submit_request(name, x, timeout_s=timeout_s).future
+        them, up to the largest bucket. ``deadline_ms`` (relative to
+        enqueue) sheds the request BEFORE dispatch if it is still
+        queued past the budget — the future then raises
+        :class:`~.batcher.DeadlineExpiredError`."""
+        return self.submit_request(name, x, timeout_s=timeout_s,
+                                   deadline_ms=deadline_ms).future
 
     @hotpath
     def submit_request(self, name: str, x: Any,
-                       timeout_s: Optional[float] = None) -> Request:
+                       timeout_s: Optional[float] = None,
+                       deadline_ms: Optional[float] = None) -> Request:
         """:meth:`submit`, returning the whole
         :class:`~.batcher.Request` — ``request.trace`` carries the
         request-path span record (trace id, phase stamps)."""
@@ -649,20 +705,24 @@ class ServingPlane:
                         f"model {name!r} is still warming")
         x_tree, n = self._normalize(name, entry.sample, x)
         return self.batcher.submit_request(name, x_tree, n,
-                                           timeout_s=timeout_s)
+                                           timeout_s=timeout_s,
+                                           deadline_ms=deadline_ms)
 
     @hotpath
-    def predict(self, name: str, x: Any, timeout_s: float = 60.0):
+    def predict(self, name: str, x: Any, timeout_s: float = 60.0,
+                deadline_ms: Optional[float] = None):
         """Synchronous convenience: submit + wait."""
-        return self.submit(name, x).result(timeout=timeout_s)
+        return self.submit(name, x, deadline_ms=deadline_ms).result(
+            timeout=timeout_s)
 
     @hotpath
-    def predict_traced(self, name: str, x: Any, timeout_s: float = 60.0):
+    def predict_traced(self, name: str, x: Any, timeout_s: float = 60.0,
+                       deadline_ms: Optional[float] = None):
         """:meth:`predict`, returning ``(output, trace_id)`` —
         ``trace_id`` is ``""`` when tracing is suppressed/disabled.
         The HTTP handler serves this as the ``X-Keystone-Trace``
         response header."""
-        req = self.submit_request(name, x)
+        req = self.submit_request(name, x, deadline_ms=deadline_ms)
         out = req.future.result(timeout=timeout_s)
         return out, ("" if req.trace is None else req.trace.trace_id)
 
@@ -797,9 +857,15 @@ class ServingPlane:
 
     @hotpath
     def _serve_batch(self, requests: List[Request]) -> None:
-        name = requests[0].model
+        taken = len(requests)
         reg = MetricsRegistry.get_or_create()
         try:
+            requests = self._shed_expired(requests, reg)
+            if not requests:
+                # every member expired while queued: zero device work
+                # for the whole batch (the finally still frees slots)
+                return
+            name = requests[0].model
             with self._lock:
                 entry = self._models.get(name)
             if entry is None or not entry.ready:
@@ -810,11 +876,26 @@ class ServingPlane:
             merged = jax.tree_util.tree_map(
                 lambda *leaves: np.concatenate(leaves, axis=0),
                 *[r.x for r in requests])
+            # value-carrying fault site: a kind="corrupt" rule poisons
+            # the merged batch exactly where a bad client payload or a
+            # host-memory flip would land — upstream of the device
+            merged = corrupt("serve.dispatch", merged, context=name)
             ds = self._bucketed(entry, merged, rows)
-            inject("serve.dispatch", context=name)
+            # abort= lets a "hang" injection end at shutdown: without
+            # it, close() burns its whole join timeout waiting out a
+            # hung dispatch (the bug the straggler scenario caught)
+            inject("serve.dispatch", context=name,
+                   abort=self._stop.is_set)
             t0 = time.perf_counter()       # device dispatch starts
             outputs = self._collect(entry, ds, rows)
             t_done = time.perf_counter()   # block_until_ready returned
+            if self.nonfinite_guard:
+                bad = _count_nonfinite(outputs)
+                if bad:
+                    raise PoisonedBatchError(
+                        f"batch for {name!r} produced {bad} non-finite "
+                        f"output value(s) over {rows} rows — failing "
+                        "this batch's requests; the worker survives")
             batch_ms = (t_done - t0) * 1e3
             bucket = ds.padded_n
             fill = rows / float(bucket)
@@ -871,13 +952,66 @@ class ServingPlane:
                 reg.histogram("serving.phase_ms.drift_score").observe(
                     (time.perf_counter() - t_drift) * 1e3)
         except BaseException as exc:
-            reg.counter("serving.errors_total").inc()
-            for req in requests:
-                if not req.future.done():
-                    req.future.set_exception(exc)
-                self.slo.record(name, None, ok=False)
+            self._fail_batch(requests, exc, reg)
         finally:
-            self.batcher.done(len(requests))
+            self.batcher.done(taken)
+
+    def _shed_expired(self, requests: List[Request],
+                      reg: MetricsRegistry) -> List[Request]:
+        """Fail every deadline-expired member BEFORE dispatch (504-
+        shaped :class:`~.batcher.DeadlineExpiredError`) and return the
+        still-live remainder. An expired request burns zero device
+        time: it never reaches ``_bucketed``/``_collect``. One clock
+        read decides for the whole batch, so a batch is split exactly
+        once (no member can expire 'between' shed and the verdict)."""
+        now = time.perf_counter()
+        live = [r for r in requests if not r.expired(now)]
+        if len(live) == len(requests):
+            return live
+        shed = [r for r in requests if r.expired(now)]
+        for req in shed:
+            if not req.future.done():
+                req.future.set_exception(DeadlineExpiredError(
+                    f"request for {req.model!r} spent "
+                    f"{(now - req.enqueued_s) * 1e3:.1f} ms queued, "
+                    "past its deadline — shed before dispatch"))
+                self.slo.record(req.model, None, ok=False)
+        reg.counter("serving.deadline_expired_total").inc(len(shed))
+        reg.counter("serving.shed_total").inc(len(shed))
+        return live
+
+    def _fail_batch(self, requests: List[Request], exc: BaseException,
+                    reg: MetricsRegistry) -> None:
+        """The failed-batch epilogue: classify, attach one (throttled)
+        post-mortem, fail exactly the still-unresolved futures, and
+        record ONE SLO outcome per request failed HERE — a request
+        whose future already resolved (or was shed) was already
+        recorded, and re-recording it skews the availability window
+        (the double-count the chaos suite caught). Routing verdicts
+        (not-admitted / warming) stay classification-only: they carry
+        no post-mortem. Cold by design (HOTPATH_COLD): runs once per
+        failed batch, never on the request fast path."""
+        name = requests[0].model
+        reg.counter("serving.errors_total").inc()
+        if isinstance(exc, PoisonedBatchError):
+            reg.counter("serving.poisoned_batches_total").inc()
+        if not isinstance(exc, (ModelNotAdmitted, ModelWarming)):
+            now = time.perf_counter()
+            if (now - self._last_batch_pm_s
+                    >= self.postmortem_min_interval_s):
+                self._last_batch_pm_s = now
+                from ..observability.postmortem import attach_postmortem
+
+                attach_postmortem(exc, "serving_batch_failure", context={
+                    "model": name,
+                    "requests": len(requests),
+                    "rows": sum(r.n for r in requests),
+                    "error": f"{type(exc).__name__}: {exc}",
+                })
+        for req in requests:
+            if not req.future.done():
+                req.future.set_exception(exc)
+                self.slo.record(name, None, ok=False)
 
     def _record_batch_trace(self, name: str, traces: List[Any],
                             start_s: float, bucket: int,
@@ -968,13 +1102,20 @@ class ServingPlane:
 
     # -- introspection -----------------------------------------------------
     def state(self) -> Dict[str, Any]:
-        """JSON-able plane state (the ``/models`` endpoint body)."""
-        ready = self.ready()  # takes the lock itself; not reentrant
+        """JSON-able plane state (the ``/models`` endpoint body). The
+        readiness verdict, model list, warming count, and evicted set
+        come from ONE lock hold: a poll racing admit/evict churn sees
+        a coherent instant (ready=True with a half-warmed model list
+        was the bug — the verdict and the list it judged were read at
+        different times)."""
         with self._lock:
+            ready = self._ready_locked()
             models = [e.state() for e in self._models.values()]
             evicted = sorted(self._evicted)
+            warming = self._warming
         return {
             "ready": ready,
+            "warming": warming,
             "hbm_budget_bytes": self.ledger.budget,
             "hbm_charged_bytes": self.ledger.used(),
             "buckets": list(self.policy.rows(self._shards)),

@@ -719,9 +719,12 @@ class _UnfixedBatcher(MicroBatcher):
     ``_closed`` fast-fail: the slot gate is paid FIRST, so a closed
     batcher whose slots are still held (taken-but-not-done requests)
     costs callers the full submit timeout and reports shutdown as a
-    QueueFullError 429."""
+    QueueFullError 429. ``deadline_ms`` is accepted (the base
+    ``submit`` passes it through) and ignored, as pre-deadline code
+    would."""
 
-    def submit_request(self, model, x, n, timeout_s=None):
+    def submit_request(self, model, x, n, timeout_s=None,
+                       deadline_ms=None):
         timeout = self.submit_timeout_s if timeout_s is None else timeout_s
         if not self._slots.acquire(timeout=timeout):
             raise QueueFullError(

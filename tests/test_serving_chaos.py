@@ -1,0 +1,429 @@
+"""The serving chaos suite (ISSUE 19): deterministic trace-replay
+loadgen, serve.* fault sites, graceful degradation, and the scenario
+catalogue.
+
+The acceptance pins:
+
+* loadgen determinism — same seed replays the identical event
+  sequence (first events pinned literally); a different seed differs;
+* graceful degradation under injected faults — deadline-expired
+  requests shed BEFORE dispatch (zero device time), 429s carry a
+  drain-rate Retry-After, a poisoned batch fails classified (500 +
+  post-mortem) without wedging the worker, admission/eviction stay
+  atomic under mid-warmup faults;
+* the two real bugs the suite caught, pinned as regressions:
+  (1) a kind="hang" injection at serve.dispatch ignored plane
+  shutdown — close() burned its whole join timeout because the
+  inject() call passed no abort callback;
+  (2) a failed batch SLO-recorded every member request, including
+  ones whose futures had already resolved (recorded good earlier in
+  the same batch) — double-counting that skewed availability windows;
+* interleaving coverage on the real TracedLock yield points
+  (tests/sched.py): shed-vs-dispatch exclusivity under seeded chaos
+  schedules, and warmup-rollback atomicity under the deterministic
+  scheduler;
+* the catalogue itself: >= 6 registered scenarios, and a bounded run
+  ends clean or classified-with-post-mortem.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from keystone_tpu.nodes.learning.linear import LinearMapEstimator
+from keystone_tpu.observability.metrics import MetricsRegistry
+from keystone_tpu.parallel.dataset import ArrayDataset
+from keystone_tpu.resilience.faults import FaultPlan
+from keystone_tpu.resilience.retry import TransientError
+from keystone_tpu.serving import (
+    DeadlineExpiredError,
+    MicroBatcher,
+    PoisonedBatchError,
+    QueueFullError,
+    ServingPlane,
+)
+from keystone_tpu.serving.loadgen import (
+    ChurnEvent,
+    LoadSpec,
+    RequestEvent,
+    generate_trace,
+)
+
+from tests.sched import DeterministicScheduler, chaos
+
+D, K = 6, 2
+
+
+def _make_fitted(d=D, k=K, seed=0, n=96):
+    r = np.random.RandomState(seed)
+    X = r.rand(n, d).astype(np.float32)
+    Y = r.rand(n, k).astype(np.float32)
+    fitted = LinearMapEstimator(lam=1e-3).with_data(
+        ArrayDataset.from_numpy(X), ArrayDataset.from_numpy(Y)).fit()
+    return fitted, X
+
+
+def _sample(d=D):
+    return jax.ShapeDtypeStruct((d,), np.float32)
+
+
+@pytest.fixture
+def plane_factory():
+    planes = []
+
+    def make(**kw):
+        kw.setdefault("max_batch", 8)
+        plane = ServingPlane(**kw)
+        planes.append(plane)
+        return plane
+
+    yield make
+    for plane in planes:
+        plane.close()
+
+
+def _serving_plane(make, name="m", **kw):
+    fitted, X = _make_fitted()
+    plane = make(**kw)
+    plane.start()
+    plane.admit(name, fitted, _sample())
+    return plane, X
+
+
+# -- loadgen determinism ----------------------------------------------------
+
+_PIN_SPEC = dict(seed=0, duration_s=1.0, rate_rps=50.0,
+                 arrival="poisson", models=("a", "b", "c"),
+                 zipf_s=1.2, sizes=(1, 2, 4))
+
+
+def test_loadgen_same_seed_identical_and_pinned():
+    t1 = generate_trace(LoadSpec(**_PIN_SPEC))
+    t2 = generate_trace(LoadSpec(**_PIN_SPEC))
+    assert t1.arrivals == t2.arrivals
+    assert t1.churn == t2.churn
+    # the first events, pinned literally: a refactor that changes RNG
+    # draw ORDER silently reshuffles every scenario's traffic and
+    # invalidates the recorded floors — it must fail here by value
+    first = t1.arrivals[0]
+    assert first == RequestEvent(
+        t_s=pytest.approx(0.015917490163262202), model="a", n=2, seq=0)
+    assert t1.arrivals[1].model == "a" and t1.arrivals[1].n == 1
+    assert t1.arrivals[2].t_s == pytest.approx(0.05950056833866034)
+    # Zipf popularity is skewed but not degenerate
+    models = {ev.model for ev in t1.arrivals}
+    assert "a" in models and len(models) >= 2
+
+
+def test_loadgen_different_seed_differs():
+    spec1 = LoadSpec(**_PIN_SPEC)
+    spec2 = LoadSpec(**{**_PIN_SPEC, "seed": 1})
+    assert generate_trace(spec1).arrivals != generate_trace(spec2).arrivals
+
+
+def test_loadgen_spec_validation_and_churn_ordering():
+    with pytest.raises(ValueError):
+        LoadSpec(**{**_PIN_SPEC, "arrival": "flat"})
+    with pytest.raises(ValueError):
+        LoadSpec(**{**_PIN_SPEC, "rate_rps": 0.0})
+    spec = LoadSpec(**{**_PIN_SPEC, "churn": (
+        ChurnEvent(t_s=0.5, action="evict", model="a"),
+        ChurnEvent(t_s=0.7, action="readmit", model="a"))})
+    trace = generate_trace(spec)
+    assert [c.action for c in trace.churn] == ["evict", "readmit"]
+    # arrivals are time-ordered with sequential seq
+    ts = [ev.t_s for ev in trace.arrivals]
+    assert ts == sorted(ts)
+    assert [ev.seq for ev in trace.arrivals] == list(range(len(ts)))
+
+
+# -- graceful degradation ---------------------------------------------------
+
+def test_queue_full_carries_retry_after_hint():
+    b = MicroBatcher(queue_depth=1, submit_timeout_s=0.01)
+    b.submit("m", np.zeros((1, D), np.float32), 1)
+    with pytest.raises(QueueFullError) as ei:
+        b.submit("m", np.zeros((1, D), np.float32), 1)
+    # never-drained queue: the hint falls back to the submit timeout
+    assert ei.value.retry_after_s > 0
+    b.close()
+
+
+def test_deadline_shed_before_dispatch(plane_factory):
+    plane, X = _serving_plane(plane_factory)
+    reg = MetricsRegistry.get_or_create()
+    shed0 = reg.counter("serving.shed_total").value
+    expired0 = reg.counter("serving.deadline_expired_total").value
+    collected = []
+    orig_collect = plane._collect
+
+    def counting_collect(entry, ds, rows):
+        collected.append(rows)
+        return orig_collect(entry, ds, rows)
+
+    plane._collect = counting_collect
+    # a deadline that is already past when the worker reads its clock:
+    # the request must fail 504-shaped without touching the device
+    req = plane.submit_request("m", X[:2], deadline_ms=1e-4)
+    with pytest.raises(DeadlineExpiredError):
+        req.future.result(timeout=10.0)
+    assert collected == []  # zero device dispatches for the shed batch
+    assert reg.counter("serving.shed_total").value == shed0 + 1
+    assert (reg.counter("serving.deadline_expired_total").value
+            == expired0 + 1)
+    # the worker is untouched: the next undeadlined request serves
+    out = plane.predict("m", X[:3], timeout_s=10.0)
+    assert np.asarray(out).shape == (3, K)
+
+
+def test_poisoned_batch_fails_classified_and_worker_survives(
+        plane_factory):
+    plane, X = _serving_plane(plane_factory,
+                              postmortem_min_interval_s=0.0)
+    reg = MetricsRegistry.get_or_create()
+    poisoned0 = reg.counter("serving.poisoned_batches_total").value
+    with FaultPlan(0) as fp:
+        fp.add("serve.dispatch", kind="corrupt", count=1)
+        with pytest.raises(PoisonedBatchError) as ei:
+            plane.predict("m", X[:4], timeout_s=10.0)
+    # classified: the error carries its post-mortem artifact
+    assert getattr(ei.value, "postmortem_path", None)
+    assert (reg.counter("serving.poisoned_batches_total").value
+            == poisoned0 + 1)
+    # the worker survives: the very next batch serves clean
+    out = plane.predict("m", X[:4], timeout_s=10.0)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_regression_hang_injection_aborts_on_close(plane_factory):
+    # REAL BUG (found by the straggler scenario work): the
+    # serve.dispatch inject() passed no abort callback, so a
+    # kind="hang" fault ignored plane shutdown and close() burned its
+    # entire worker-join timeout waiting out the hang
+    plane, X = _serving_plane(plane_factory)
+    with FaultPlan(0) as fp:
+        fp.add("serve.dispatch", kind="hang", delay_s=8.0, count=1)
+        plane.submit("m", X[:2])
+        time.sleep(0.3)  # let the worker enter the hung dispatch
+        worker = plane._worker
+        t0 = time.perf_counter()
+        plane.close()
+        elapsed = time.perf_counter() - t0
+    assert elapsed < 5.0, (
+        f"close() took {elapsed:.1f}s under a hung dispatch — the "
+        "hang abort regressed")
+    assert worker is not None and not worker.is_alive()
+
+
+def test_regression_failed_batch_records_each_request_once(
+        plane_factory):
+    # REAL BUG (found by the chaos suite): the batch except path
+    # SLO-recorded ok=False for EVERY member request, including ones
+    # whose futures had already resolved and been recorded good
+    # earlier in _serve_batch — each late-epilogue failure
+    # double-counted the whole batch and skewed availability windows
+    plane, X = _serving_plane(plane_factory,
+                              postmortem_min_interval_s=0.0)
+    reg = MetricsRegistry.get_or_create()
+    errors0 = reg.counter("serving.errors_total").value
+
+    def boom(*a, **kw):
+        raise RuntimeError("late epilogue failure")
+
+    plane._record_batch_trace = boom
+    good0, bad0 = plane.slo.totals()
+    out = plane.predict("m", X[:2], timeout_s=10.0)  # client still wins
+    assert np.asarray(out).shape == (2, K)
+    deadline = time.monotonic() + 5.0
+    while (reg.counter("serving.errors_total").value == errors0
+           and time.monotonic() < deadline):
+        time.sleep(0.01)
+    assert reg.counter("serving.errors_total").value == errors0 + 1
+    good, bad = plane.slo.totals()
+    assert good - good0 == 1
+    assert bad - bad0 == 0, (
+        "a request whose future already resolved was re-recorded "
+        "ok=False by the failure epilogue")
+
+
+def test_admit_fault_mid_warmup_rolls_back_atomically(plane_factory):
+    fitted, X = _make_fitted()
+    plane = plane_factory()
+    plane.start()
+    with FaultPlan(0) as fp:
+        # after=1 skips the pre-mutation visit: the error lands on the
+        # FIRST warmup-bucket visit, mid-warmup by construction
+        fp.add("serve.admit", kind="error", after=1, count=1)
+        with pytest.raises(TransientError):
+            plane.admit("m", fitted, _sample())
+        assert fp.injections("serve.admit") == 1
+    s = plane.state()
+    assert "m" not in {m["name"] for m in s["models"]}
+    assert s["warming"] == 0
+    assert plane.ledger.used() == 0, "failed admission kept its charge"
+    assert plane.ready()
+    # nothing half-registered: the same admission succeeds on retry
+    plane.admit("m", fitted, _sample())
+    out = plane.predict("m", X[:2], timeout_s=10.0)
+    assert np.asarray(out).shape == (2, K)
+
+
+def test_evict_fault_leaves_model_serving(plane_factory):
+    plane, X = _serving_plane(plane_factory)
+    with FaultPlan(0) as fp:
+        fp.add("serve.evict", kind="error", count=1)
+        with pytest.raises(TransientError):
+            plane.evict("m")
+    s = plane.state()
+    assert "m" in {m["name"] for m in s["models"]}
+    assert "m" not in s["evicted"]
+    out = plane.predict("m", X[:2], timeout_s=10.0)
+    assert np.asarray(out).shape == (2, K)
+    plane.evict("m")  # the clean eviction still works afterwards
+    assert "m" in plane.state()["evicted"]
+
+
+def test_state_stays_coherent_mid_warmup(plane_factory):
+    fitted, X = _make_fitted()
+    plane = plane_factory()
+    plane.start()
+    hold = threading.Event()
+    release = threading.Event()
+    orig_warm = plane._warm
+
+    def slow_warm(entry):
+        hold.set()
+        assert release.wait(10.0)
+        return orig_warm(entry)
+
+    plane._warm = slow_warm
+    t = threading.Thread(
+        target=lambda: plane.admit("m", fitted, _sample()), daemon=True)
+    t.start()
+    assert hold.wait(10.0)
+    # one lock hold computes the whole verdict: a warming model is
+    # counted in `warming`, absent from BOTH the ready and evicted
+    # lists, and readiness is false — never a half-published mixture
+    s = plane.state()
+    assert s["warming"] == 1
+    assert not s["ready"]
+    warming_names = {m["name"] for m in s["models"] if not m["ready"]}
+    ready_names = {m["name"] for m in s["models"] if m["ready"]}
+    # coherent mid-warmup instant: "m" may appear in the model list
+    # only as not-ready, never ready, and never as evicted
+    assert "m" not in ready_names
+    assert "m" not in s["evicted"]
+    release.set()
+    t.join(timeout=30.0)
+    s = plane.state()
+    assert s["ready"] and s["warming"] == 0
+    assert "m" in {m["name"] for m in s["models"] if m["ready"]}
+    assert warming_names <= {"m"}
+
+
+# -- interleavings on the real yield points ---------------------------------
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_shed_vs_dispatch_exclusive_under_chaos(seed, plane_factory):
+    """Seeded perturbation at every TracedLock/TracedSemaphore yield
+    point while borderline-deadline requests race the worker: each
+    request resolves EXACTLY once, to either a real output or a
+    DeadlineExpiredError — and a request that was expired when its
+    batch formed never reaches dispatch (the scenarios' dispatch guard
+    watches every batch)."""
+    from keystone_tpu.serving.scenarios import _guard_dispatch
+
+    plane, X = _serving_plane(plane_factory)
+    violations = []
+    _guard_dispatch(plane, violations)
+    reqs = []
+    with chaos(seed):
+        for i in range(24):
+            # deadlines straddle the worker's take latency, so some
+            # requests shed and some serve, schedule-dependently
+            reqs.append(plane.submit_request(
+                "m", X[:1 + i % 3], deadline_ms=0.05 + (i % 5) * 0.2))
+        outcomes = {"ok": 0, "shed": 0}
+        for req in reqs:
+            try:
+                out = req.future.result(timeout=10.0)
+                assert np.asarray(out).shape == (req.n, K)
+                outcomes["ok"] += 1
+            except DeadlineExpiredError:
+                outcomes["shed"] += 1
+    assert outcomes["ok"] + outcomes["shed"] == len(reqs)
+    assert violations == [], violations
+    # the plane survived the storm
+    assert np.asarray(plane.predict("m", X[:2])).shape == (2, K)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_warmup_rollback_atomic_under_scheduler(seed, plane_factory):
+    """Deterministic-scheduler interleavings of a mid-warmup admission
+    fault against a concurrent submitter: whatever the schedule, the
+    submitter only ever sees typed routing verdicts, and the failed
+    admission leaves NOTHING behind — no entry, no warming count, no
+    ledger charge — so the retry admission succeeds."""
+    fitted, X = _make_fitted()
+    plane = plane_factory()
+    plane.start()
+    submit_verdicts = []
+
+    def admitter():
+        with pytest.raises(TransientError):
+            plane.admit("m", fitted, _sample())
+
+    def submitter():
+        for _ in range(4):
+            try:
+                plane.predict("m", X[:2], timeout_s=5.0)
+                submit_verdicts.append("ok")
+            except Exception as exc:
+                submit_verdicts.append(type(exc).__name__)
+
+    with FaultPlan(seed) as fp:
+        fp.add("serve.admit", kind="error", after=1, count=1)
+        sched = DeterministicScheduler(seed=seed)
+        sched.spawn(admitter, name="admit")
+        sched.spawn(submitter, name="submit")
+        with sched:
+            sched.run()
+        assert fp.injections("serve.admit") == 1
+    # the submitter saw only typed verdicts, never a raw internal error
+    assert set(submit_verdicts) <= {"ok", "ModelNotAdmitted",
+                                    "ModelWarming"}
+    s = plane.state()
+    assert "m" not in {m["name"] for m in s["models"]}
+    assert s["warming"] == 0
+    assert plane.ledger.used() == 0
+    plane.admit("m", fitted, _sample())
+    assert np.asarray(plane.predict("m", X[:2])).shape == (2, K)
+
+
+# -- the catalogue ----------------------------------------------------------
+
+def test_catalogue_registers_required_scenarios():
+    from keystone_tpu.serving.scenarios import SCENARIOS, load_catalogue
+
+    load_catalogue()
+    assert len(SCENARIOS) >= 6
+    assert {"burst", "diurnal", "zipf_churn", "straggler_dispatch",
+            "poisoned_batch", "overload_shed"} <= set(SCENARIOS)
+    for sc in SCENARIOS.values():
+        assert sc.floors.p99_ms > 0
+        assert 0 < sc.floors.availability <= 1.0
+
+
+def test_catalogue_scenario_runs_clean_or_classified():
+    from keystone_tpu.serving.scenarios import run_scenario
+
+    res = run_scenario("burst", seed=0, duration_s=0.4)
+    # a bounded run either holds its floors or fails CLASSIFIED: the
+    # violation writes a post-mortem naming scenario and seed
+    if not res.clean:
+        assert res.postmortem_path, res.violations
+    assert res.report.outcomes["unclassified"] == 0
+    assert res.p99_ms >= 0.0 and 0.0 <= res.availability <= 1.0
