@@ -1732,6 +1732,214 @@ def serving_soak_bench():
               **extra)
 
 
+def fleet_bench():
+    """Multi-replica serving scale-out (PR 20): the same deterministic
+    loadgen workload measured twice in one section — first against a
+    single saturated plane (the ``single_qps`` baseline, same
+    semantics as ``serve_qps_per_chip``), then against a 3-replica
+    fleet behind the ``FleetRouter`` with placement solved by the
+    fleet controller under finite per-replica budgets. The fleet
+    transport is IN-PROCESS (``LocalReplicaClient`` — direct plane
+    calls): the section measures router + scale-out, not JSON framing;
+    the real-HTTP wire path is drilled by the fleet chaos scenarios
+    and ``tools/fleet_gate.py``, where correctness (not rows/sec) is
+    the product.
+
+    Placement is load-bearing: six equal-charge models FFD-spread two
+    per replica, and the two Zipf-hottest are REPLICATED into the
+    leftover budget (an earned solver decision — ``qps`` demand priced
+    against warmup cost), so the router's depth-ordered spill can
+    level the Zipf skew across replicas instead of pinning the hot
+    primary. ``router_spill_share`` prices exactly that leveling
+    (lower is calmer, but zero under skew means the fleet is NOT
+    balancing — PERFORMANCE.md rule 19: watch the spill share, not
+    just the p99).
+
+    The comparison is throughput-at-operating-point, the serving
+    scale-out claim: ONE seeded trace, replayed twice. The single
+    window replays it TIME-STRETCHED by the replica count (the same
+    requests, byte-identical, at the per-replica rate — it keeps up,
+    so ``single_qps`` is the rows/sec one replica serves at its
+    operating point); the fleet window replays it at full speed
+    against the fleet. A fleet that keeps up delivers ~Nx; the 2.4x
+    acceptance bar leaves room for routing overhead and placement
+    imbalance. On a single-core
+    CPU sim both windows share one core, so the fleet number prices
+    the router/placement/spill machinery absorbing 3x the offered
+    load (batch coalescing has to survive the 3-way split); on
+    multi-core or TPU hosts the same section measures real parallel
+    capacity.
+
+    * ``fleet_qps`` — fleet-window rows/sec; vs_baseline is the ratio
+      against 2.4x the same-run single-replica operating point (the
+      PR 20 acceptance bar), so >= 1.0 reads "scale-out delivered".
+    * ``fleet_p99_ms`` — closed-loop end-to-end p99 over the fleet
+      window (banded like ``serve_p99_ms``).
+    * ``router_spill_share`` — spilled / routed requests over the
+      window (the shared lower-better ``_share`` marker).
+    """
+    from keystone_tpu.nodes.learning.linear import LinearMapEstimator
+    from keystone_tpu.observability import MetricsRegistry
+    from keystone_tpu.parallel.dataset import ArrayDataset
+    from keystone_tpu.serving import ServingPlane, model_charge
+    from keystone_tpu.serving.fleet import FleetController
+    from keystone_tpu.serving.loadgen import LoadSpec, generate_trace
+    from keystone_tpu.serving.loadgen import replay as replay_trace
+    from keystone_tpu.serving.router import FleetRouter, LocalReplicaClient
+
+    n_dev = len(jax.devices())
+    n_replicas = 3
+    d, k = (64, 10) if SMALL else (256, 10)
+    n_fit = 512 if SMALL else _scaled(4_096, mult=512, floor=1_024)
+    max_batch = 32 if SMALL else 64
+    window_s = 2.0 if SMALL else float(_scaled(8, mult=1, floor=4))
+    base_clients = 4
+    fleet_clients = base_clients * n_replicas
+
+    r = np.random.RandomState(7)
+    X = r.rand(n_fit, d).astype(np.float32)
+    Y = r.rand(n_fit, k).astype(np.float32)
+    fitted = LinearMapEstimator(lam=1e-3).with_data(
+        ArrayDataset.from_numpy(X),
+        ArrayDataset.from_numpy(Y)).fit()
+    sample = jax.ShapeDtypeStruct((d,), np.float32)
+    charge = model_charge(fitted, sample, max_batch).total_nbytes()
+    # six names over one fitted model: equal charges make the FFD
+    # spread deterministic (two per replica) and keep the section's
+    # fit cost at one solve
+    names = tuple(f"m{i}" for i in range(2 * n_replicas))
+
+    reg = MetricsRegistry.get_or_create()
+    # one trace, two replays: the fleet at full speed, the single
+    # plane time-stretched x n_replicas (per-replica rate, identical
+    # request sequence)
+    spec = LoadSpec(
+        seed=9, duration_s=window_s, rate_rps=1_200.0,
+        arrival="bursty", models=names, zipf_s=1.2,
+        sizes=(4, 8, max_batch // 2),
+        burst_mult=2.0, burst_on_s=0.5, burst_off_s=0.25)
+    trace = generate_trace(spec)
+
+    def input_for(model, n):
+        return X[:n]
+
+    def warm(plane, hosted):
+        # pay the per-bucket serve compiles BEFORE the measured
+        # window: the single window runs at light load and a cold
+        # bucket compile would stretch its wall into the qps
+        for name in hosted:
+            for n in (1, max_batch):
+                plane.predict(name, X[:n], timeout_s=60.0)
+
+    def run_window(target, senders, time_scale):
+        """One closed-loop load window; returns (rows/sec, sorted
+        latencies ms, report)."""
+        rows0 = reg.counter("serving.rows_total").value
+        report = replay_trace(
+            trace, target, input_for, senders=senders,
+            time_scale=time_scale,
+            submit_timeout_s=30.0, result_timeout_s=60.0)
+        broken = (report.outcomes["error"]
+                  + report.outcomes["unclassified"]
+                  + report.outcomes["poisoned"])
+        if broken:
+            raise RuntimeError(
+                f"{broken} request(s) FAILED in the fault-free fleet "
+                f"window: {report.errors[:4]}")
+        lat_ms = np.sort(np.asarray(report.latencies_ms, np.float64))
+        if lat_ms.size == 0:
+            raise RuntimeError("fleet window completed zero requests")
+        qps = (reg.counter("serving.rows_total").value
+               - rows0) / report.wall_s
+        return qps, lat_ms, report
+
+    def make_plane(budget):
+        plane = ServingPlane(hbm_budget=budget, max_batch=max_batch,
+                             queue_depth=1024)
+        plane.start()
+        return plane
+
+    # -- single-replica baseline: one saturated plane, all six models
+    base_plane = make_plane(len(names) * charge + (1 << 20))
+    planes = []
+    try:
+        for name in names:
+            base_plane.admit(name, fitted, sample, weight_dtype=None)
+        warm(base_plane, names)
+        u0 = base_plane.unexpected_recompiles()
+        single_qps, _, _ = run_window(
+            base_plane, base_clients, time_scale=float(n_replicas))
+        if base_plane.unexpected_recompiles() - u0:
+            raise RuntimeError(
+                "steady-state recompile in the fleet baseline window")
+
+        # -- the fleet: 3 planes, placement solved under budgets that
+        # fit two homes plus ONE earned replica copy each
+        planes = [make_plane(int(3.3 * charge) + (1 << 20))
+                  for _ in range(n_replicas)]
+        clients = [LocalReplicaClient(f"r{i}", plane)
+                   for i, plane in enumerate(planes)]
+        # closed-loop senders keep per-plane depth <= sender count, so
+        # the proactive-spill threshold sits BELOW it: a primary with
+        # a couple queued loses the request to an idler sibling
+        router = FleetRouter(clients, spill_queue_depth=2)
+        controller = FleetController(router, bucket_rows=max_batch)
+        for i, name in enumerate(names):
+            # the two Zipf-hottest names carry demand, so the solver
+            # replicates exactly them into the leftover budget
+            qps = 500.0 if i < 2 else 0.0
+            controller.register(name, fitted, sample, qps=qps,
+                                warmup_s=1.0 if qps else 0.0)
+        for client in clients:
+            controller.set_budget(client.replica_id, 3.3 * charge)
+        controller.rebalance()
+        placed = controller.placement
+        copies = {name: len(placed.replicas_for(name))
+                  for name in names}
+        for client in clients:
+            warm(client.plane, client.models())
+
+        u1 = sum(p.unexpected_recompiles() for p in planes)
+        routed0 = reg.counter("router.requests_total").value
+        spill0 = reg.counter("router.spill_total").value
+        fleet_qps, lat_ms, report = run_window(
+            router, fleet_clients, time_scale=1.0)
+        if sum(p.unexpected_recompiles() for p in planes) - u1:
+            raise RuntimeError(
+                "steady-state recompile in the fleet scale-out window")
+        routed = reg.counter("router.requests_total").value - routed0
+        spilled = reg.counter("router.spill_total").value - spill0
+        spill_share = spilled / routed if routed else 0.0
+        scaling = fleet_qps / single_qps if single_qps else 0.0
+
+        common = dict(
+            replicas=n_replicas, models=len(names),
+            clients=fleet_clients, window_s=round(report.wall_s, 2),
+            max_batch=max_batch,
+            loadgen=dict(seed=spec.seed, arrival=spec.arrival,
+                         rate_rps=spec.rate_rps, zipf_s=spec.zipf_s),
+            single_qps=round(single_qps / n_dev, 1),
+            scaling=round(scaling, 3),
+            copies=copies,
+            spilled=int(spilled), routed=int(routed),
+            unexpected_recompiles=0,
+        )
+        _emit("fleet_qps", round(fleet_qps / n_dev, 1),
+              "rows/sec/chip",
+              round(fleet_qps / (2.4 * single_qps), 4)
+              if single_qps else 0.0, **common)
+        _emit("fleet_p99_ms",
+              round(float(np.percentile(lat_ms, 99)), 3), "ms",
+              round(float(np.percentile(lat_ms, 99)) / 10.0, 4),
+              **common)
+        _emit("router_spill_share", round(spill_share, 4), "share",
+              round(spill_share / 0.5, 4), **common)
+    finally:
+        base_plane.close()
+        for plane in planes:
+            plane.close()
+
+
 def elastic_coordination_bench():
     """Multi-host coordination cost on the CPU dryrun harness (PR 18):
     shells out to ``tools/elastic_bench.py`` — real ``jax.distributed``
@@ -2198,6 +2406,7 @@ def main():
         (pallas_kernels_bench, 60),
         (serving_bench, 45),
         (serving_soak_bench, 40),
+        (fleet_bench, 50),
         (e2e_bench, 60),
         (loader_bench, 60),
         (streamed_e2e_bench, 60),
@@ -2297,6 +2506,7 @@ if __name__ == "__main__":
         "--streamed-e2e": streamed_e2e_bench,
         "--serving": serving_bench,
         "--serving-soak": serving_soak_bench,
+        "--fleet": fleet_bench,
     }
     argv = list(sys.argv[1:])
     trace_out = _pop_trace_out(argv)

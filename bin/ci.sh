@@ -71,6 +71,15 @@
 #                                  with a post-mortem naming
 #                                  scenario+seed; a violated
 #                                  p99/availability floor exits 1 by name
+#   2b'''''. fleet gate            tools/fleet_gate.py — 3 replica
+#                                  subprocesses behind the real-HTTP
+#                                  fleet router, placement solved under
+#                                  finite budgets; SIGKILL the busiest
+#                                  replica mid-replay: the reactor must
+#                                  re-place its models sha-verified,
+#                                  keep p99 under the drill floor, and
+#                                  classify every refusal (429/503),
+#                                  never an unclassified error
 #   2c. bounded-seed stress        the deterministic-interleaving suite
 #                                  (tests/test_concurrency_sched.py):
 #                                  historical-race regression schedules +
@@ -174,6 +183,18 @@ if (( run_tests )); then
   # violated p99/availability floor fails the gate by name
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     "$PY" "$KEYSTONE_HOME/tools/chaos_gate.py" --seeds 2
+
+  echo "== ci: fleet gate (3 subprocess replicas, SIGKILL one mid-replay) =="
+  # the dynamic pin for the serving fleet (tools/fleet_gate.py): three
+  # replica SUBPROCESSES behind the real-HTTP router, placement solved
+  # under finite per-replica budgets and admitted sha-verified; mid-
+  # replay the busiest replica is SIGKILLed cold — the reactor must
+  # count exactly one death, drop the corpse from the membership,
+  # re-place its models from canonical bytes (sha-verified again), the
+  # p99 must stay under the drill floor, and every refusal in the
+  # window must be classified (429/503) — never an unclassified error
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    "$PY" "$KEYSTONE_HOME/tools/fleet_gate.py"
 
   echo "== ci: bounded-seed concurrency stress (regression schedules + fuzz) =="
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \

@@ -2,8 +2,8 @@
 ``bin/run-pipeline.sh <class> --flags``, SURVEY.md section 2.13):
 
     python -m keystone_tpu <app> [--flags]
-    python -m keystone_tpu check <app> [--json PATH] [--budget BYTES] [--shards N]
-    python -m keystone_tpu check --all [--budget BYTES]
+    python -m keystone_tpu check <app> [--json PATH] [--budget BYTES] [--shards N] [--replicas N]
+    python -m keystone_tpu check --all [--budget BYTES] [--replicas N]
     python -m keystone_tpu benchdiff BASE.json CURRENT.json [--force]
     python -m keystone_tpu numerics POSTMORTEM.json
     python -m keystone_tpu serve NAME=PATH@SHAPE[:DTYPE] ... [--port P]
@@ -96,14 +96,18 @@ def _parse_bytes(text: str) -> float:
 
 def check_main(rest) -> int:
     """``python -m keystone_tpu check <app>|--all [--json PATH]
-    [--budget BYTES] [--shards N] [--xla]``.
+    [--budget BYTES] [--shards N] [--replicas N] [--xla]``.
 
     ``--budget`` (bytes; ``MiB``/``GiB`` suffixes accepted) gates every
     checked app on its static HBM plan — the device-free prediction of
     the fit path's peak residency. ``--shards N`` overrides the
     planner's data-axis width, so ``--budget`` verifies the PER-HOST
     charge of an N-shard world from a single-host machine (the
-    sharded-apply sizing runbook, CLUSTER.md "Serving topology"). ``--xla`` cross-checks that plan
+    sharded-apply sizing runbook, CLUSTER.md "Serving topology").
+    ``--replicas N`` (with ``--budget`` as the PER-REPLICA budget)
+    additionally solves the checked apps' static serving charges into
+    an N-replica fleet placement (``serving/placement.py``) — exit 2
+    names the first app no replica can host. ``--xla`` cross-checks that plan
     against XLA's own memory model: every planner-resolved node with a
     per-item program is compiled-without-executing on the sample spec
     and its ``memory_analysis`` output/temp bytes are compared with the
@@ -155,6 +159,27 @@ def check_main(rest) -> int:
                   f"{rest[i + 1]!r}", file=sys.stderr)
             return 2
         del rest[i:i + 2]
+    replicas = None
+    if "--replicas" in rest:
+        i = rest.index("--replicas")
+        if i + 1 >= len(rest):
+            print("--replicas requires a replica count (e.g. 3)",
+                  file=sys.stderr)
+            return 2
+        try:
+            replicas = int(rest[i + 1])
+            if replicas < 1:
+                raise ValueError(replicas)
+        except ValueError:
+            print(f"--replicas expects a positive integer, got "
+                  f"{rest[i + 1]!r}", file=sys.stderr)
+            return 2
+        del rest[i:i + 2]
+    if replicas is not None and budget is None:
+        print("--replicas needs --budget BYTES (the per-replica HBM "
+              "budget the fleet placement is solved against)",
+              file=sys.stderr)
+        return 2
     xla_verify = "--xla" in rest
     if xla_verify:
         rest.remove("--xla")
@@ -163,7 +188,8 @@ def check_main(rest) -> int:
 
     if not rest or rest[0] in ("-h", "--help"):
         print("usage: python -m keystone_tpu check <app>|--all "
-              "[--json PATH] [--budget BYTES] [--shards N] [--xla]\n\n"
+              "[--json PATH] [--budget BYTES] [--shards N] "
+              "[--replicas N] [--xla]\n\n"
               "apps:")
         for name in sorted(CHECK_APPS):
             print(f"  {name}")
@@ -224,12 +250,14 @@ def check_main(rest) -> int:
               + (1 if spmd else 0) + (1 if hotpath else 0))
     over_budget = 0
     reports = []
+    app_names = []
     for build in builders:
         target = build()
         report = target.pipeline.check(target.input_spec, name=target.name,
                                        hbm_budget=budget,
                                        data_shards=shards)
         reports.append(report)
+        app_names.append(target.name)
         print(report.summary(), file=sys.stderr)
         if xla_verify:
             from keystone_tpu.analysis.resources import (
@@ -253,6 +281,62 @@ def check_main(rest) -> int:
         else:
             status = f"FAIL ({len(report.diagnostics)} diagnostic(s))"
         print(f"{target.name}: {status}")
+    # fleet-placement verification (PR 20): solve the checked apps'
+    # STATIC serving charges into an N-replica placement under the
+    # per-replica --budget — the device-free answer to "does this
+    # catalogue fit a fleet of N such replicas", before any replica
+    # boots. Exit 2 names the first unplaceable app.
+    fleet_placement = None
+    if replicas is not None:
+        from keystone_tpu.analysis.resources import serving_residency_nbytes
+        from keystone_tpu.serving.placement import (
+            ModelDemand,
+            PlacementError,
+            plan_placement,
+        )
+
+        bucket_rows = 64
+        demands, unsized = [], []
+        for app, report in zip(app_names, reports):
+            charge = serving_residency_nbytes(
+                report.plan.model_nbytes, report.plan, bucket_rows,
+                data_shards=shards or 1)
+            if charge is None:
+                # unresolved plan: the per-app summary above already
+                # names the unresolved nodes; placement cannot invent
+                # a charge for it
+                unsized.append(app)
+                continue
+            demands.append(
+                ModelDemand(name=app, charge_nbytes=float(charge)))
+        if unsized:
+            print(f"fleet: skipping {', '.join(unsized)} — no static "
+                  f"serving charge (unresolved plan)", file=sys.stderr)
+        try:
+            placed = plan_placement(
+                demands,
+                {f"r{i}": float(budget) for i in range(replicas)})
+        except PlacementError as exc:
+            over_budget += 1
+            fleet_placement = {"replicas": replicas,
+                               "budget_nbytes": float(budget),
+                               "infeasible": str(exc),
+                               "model": exc.model}
+            print(f"fleet: INFEASIBLE at {replicas} replica(s) x "
+                  f"{budget / (1 << 20):.2f} MiB — {exc}")
+        else:
+            max_load = max(placed.loads.values()) if placed.loads else 0.0
+            fleet_placement = {
+                "replicas": replicas,
+                "budget_nbytes": float(budget),
+                "bucket_rows": bucket_rows,
+                "assignments": {m: list(r) for m, r
+                                in sorted(placed.assignments.items())},
+                "loads": dict(sorted(placed.loads.items())),
+            }
+            print(f"fleet: {len(demands)} app(s) place on {replicas} "
+                  f"replica(s) x {budget / (1 << 20):.2f} MiB "
+                  f"(max replica load {max_load / (1 << 20):.2f} MiB)")
     print(f"concurrency: {'clean' if not concurrency else f'{len(concurrency)} diagnostic(s)'}")
     print(f"metrics names: {'clean' if not metrics_names else f'{len(metrics_names)} diagnostic(s)'}")
     print(f"spmd: {'clean' if not spmd else f'{len(spmd)} diagnostic(s)'}")
@@ -278,6 +362,8 @@ def check_main(rest) -> int:
                     "metrics_names": metrics_names,
                     "spmd": spmd,
                     "hotpath": hotpath}
+        if fleet_placement is not None:
+            blob["fleet_placement"] = fleet_placement
         with open(json_out, "w") as f:
             f.write(_json.dumps(blob, indent=2))
         print(f"report written to {json_out}", file=sys.stderr)

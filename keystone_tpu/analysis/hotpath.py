@@ -167,11 +167,24 @@ HOTPATH_ALLOWLIST: FrozenSet[str] = frozenset({
     # site, not per implementation line
     "TracedSemaphore.acquire:acquire",
     # reading the POST body is the request (bounded by
-    # Content-Length); coercing it is the input copy (host JSON, no
-    # device value possible); writing the response is the respond phase
+    # Content-Length); writing the response is the respond phase — the
+    # shared _reply lives on the base handler since the fleet split,
+    # so the router and replica surfaces inherit the one allowlisted
+    # write instead of each growing their own
     "ServingHandler.do_POST:read",
-    "ServingHandler.do_POST:asarray",
-    "ServingHandler._reply:write",
+    "_JsonReplyHandler._reply:write",
+    # the router's forwarding surface repeats the same pair: reading
+    # the POST body bounded by Content-Length IS the request
+    "RouterHandler.do_POST:read",
+    # the HTTP replica transport: reading the replica's response body
+    # IS the forwarded request completing — the router's spill/refusal
+    # logic cannot decide without it (bounded by the client timeout)
+    "HttpReplicaClient._request:read",
+    # the input coercion in the SHARED predict path (serving/http.py
+    # predict_response, run by the single-process handler and the
+    # router's local replica client alike): host JSON rows, no device
+    # value possible — the one admitted-sample dtype cast per request
+    "predict_response:asarray",
     # the reservoir is bounded per model by construction (cap slowest
     # traces, the fastest evicted on overflow); distinct-model-name
     # cardinality is the same one the per-model metric families

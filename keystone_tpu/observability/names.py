@@ -141,6 +141,31 @@ METRIC_NAMES: FrozenSet[str] = frozenset({
                                  # came back non-finite — the whole
                                  # batch fails classified (500 +
                                  # post-mortem), the worker survives
+    # the serving fleet (PR 20): queue-wait is the one measured
+    # congestion signal the router's spill eligibility, the autoscaler,
+    # and the bench fleet line all share (satellite: "attack the 0.65
+    # serve_queue_wait_share")
+    "serving.queue_wait_s",      # histogram: seconds a request spent
+                                 # queued, enqueue -> coalesce start
+                                 # (per-model family rides the prefix)
+    # serving/router.py — the fleet front door. Every refusal is a
+    # counted, classified verdict: an unavailable fleet answers 503
+    # with Retry-After, never an unclassified error.
+    "router.requests_total",     # counter: requests the router fronted
+    "router.spill_total",        # counter: requests NOT served by their
+                                 # rendezvous-primary replica (spilled
+                                 # to the least-loaded eligible one on
+                                 # queue depth / refusal)
+    "router.rebalance_total",    # counter: model migrations completed
+                                 # (admit on target -> verify canonical
+                                 # bytes -> evict on source)
+    "router.unavailable_total",  # counter: requests refused 503 — no
+                                 # eligible replica hosted the model
+    "router.replicas_live",      # gauge: replicas passing health probes
+    "fleet.models_placed",       # gauge: (model, replica) assignments
+                                 # in the live placement
+    "fleet.replica_deaths_total",  # counter: replicas declared dead and
+                                 # re-placed around
 })
 
 #: catalogued name FAMILIES: a dynamic metric name must start with one
@@ -165,8 +190,17 @@ METRIC_PREFIXES: Tuple[str, ...] = (
                                  # storm names its model)
     "serving.availability.",     # per-model rolling availability gauges
     "serving.error_budget_burn_rate.",  # per-model burn-rate gauges
+    "serving.queue_wait_s.",     # per-model queued-time family (the
+                                 # router's spill signal, PR 20)
     "slo.",                      # observability/slo.py: one counter per
                                  # SLO event kind (record_slo_event)
+    "placement.",                # serving/placement.py: solver
+                                 # accounting (placement.solves_total,
+                                 # placement.replicated_models,
+                                 # placement.migrations_planned) — one
+                                 # family, like "chaos." below
+    "router.spill_total.",       # per-model spill family: a spill storm
+                                 # names its model (PR 20)
     "chaos.",                    # serving/scenarios: chaos-suite run
                                  # accounting (chaos.runs_total,
                                  # chaos.injections_total,
@@ -247,6 +281,16 @@ BENCH_METRIC_NAMES: FrozenSet[str] = frozenset({
     "soak_poisoned_batch_availability",
     "soak_overload_shed_p99_ms",
     "soak_overload_shed_availability",
+    # the serving fleet (PR 20): 3 in-process replicas behind the
+    # router, same seeded trace family as the serving section. The
+    # existing benchdiff markers already band all three: `_qps`
+    # higher-is-better, `_ms` lower-is-better, `_share`
+    # lower-is-better (a rising spill share means primaries are
+    # saturating even if the p99 hasn't moved yet — PERFORMANCE.md
+    # rule 19).
+    "fleet_qps",
+    "fleet_p99_ms",
+    "router_spill_share",
 })
 
 

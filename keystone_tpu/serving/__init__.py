@@ -7,6 +7,14 @@ compile-observatory fence), and multi-model residency under an explicit
 HBM budget with static-planner admission charges and LRU-with-cost
 eviction. ``python -m keystone_tpu serve`` is the CLI;
 ``ServingPlane`` the embeddable core. See README "Serving".
+
+The fleet layer (ISSUE 20) scales the plane out: ``plan_placement``
+packs models onto replicas under per-replica budgets, ``FleetRouter``
+fronts N replicas with rendezvous routing and honest spill,
+``FleetController`` owns the canonical model bytes and applies every
+placement change admit -> sha-verify -> evict, and ``FleetAutoscaler``
+turns scraped telemetry into membership changes. See README "Fleet
+serving" and CLUSTER.md "Fleet topology".
 """
 from .batcher import (
     BucketPolicy,
@@ -15,6 +23,14 @@ from .batcher import (
     QueueFullError,
     Request,
 )
+from .fleet import (
+    FleetAutoscaler,
+    FleetController,
+    FleetError,
+    FleetModel,
+    run_reactor,
+)
+from .placement import ModelDemand, Placement, PlacementError, plan_placement
 from .plane import (
     ModelNotAdmitted,
     ModelWarming,
@@ -23,15 +39,31 @@ from .plane import (
     ServingPlane,
 )
 from .residency import AdmissionError, ModelCharge, ResidencyLedger, model_charge
+from .router import (
+    FleetRouter,
+    HttpReplicaClient,
+    LocalReplicaClient,
+    serve_router,
+)
 
 __all__ = [
     "AdmissionError",
     "BucketPolicy",
     "DeadlineExpiredError",
+    "FleetAutoscaler",
+    "FleetController",
+    "FleetError",
+    "FleetModel",
+    "FleetRouter",
+    "HttpReplicaClient",
+    "LocalReplicaClient",
     "MicroBatcher",
     "ModelCharge",
+    "ModelDemand",
     "ModelNotAdmitted",
     "ModelWarming",
+    "Placement",
+    "PlacementError",
     "PoisonedBatchError",
     "QueueFullError",
     "Request",
@@ -39,4 +71,7 @@ __all__ = [
     "ServedModel",
     "ServingPlane",
     "model_charge",
+    "plan_placement",
+    "run_reactor",
+    "serve_router",
 ]

@@ -53,7 +53,8 @@ from __future__ import annotations
 import json
 import math
 import sys
-from typing import Any, Dict, List, Optional
+import threading
+from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
@@ -68,7 +69,81 @@ from .plane import ModelNotAdmitted, ModelWarming, ServingPlane
 from .residency import AdmissionError
 
 
-class ServingHandler(_MetricsHandler):
+class _JsonReplyHandler(_MetricsHandler):
+    """The JSON-reply half every keystone HTTP surface shares: the
+    single-process serving handler below, the fleet router's
+    forwarding handler (``serving/router.py``), and the replica admin
+    surface (``serving/replica.py``) all speak through this one
+    ``_reply`` — same headers, same framing, one allowlisted hot-path
+    write."""
+
+    def _reply(self, status: int, body: bytes,
+               ctype: str = "application/json",
+               headers: Optional[Dict[str, str]] = None) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for key, value in (headers or {}).items():
+            self.send_header(key, value)
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def predict_response(plane: Any, name: str, raw: bytes
+                     ) -> Tuple[int, bytes, Optional[Dict[str, str]]]:
+    """One predict call against ``plane``, mapped to the HTTP verdict:
+    ``(status, body, extra headers)``. This is THE shared request-path
+    code the plane split exists for — the single-process handler below
+    and the fleet router's local replica client both run exactly this
+    function, so every serving surface maps the exception family to the
+    same honest statuses (404 unknown / 503 warming / 504 shed /
+    429-with-Retry-After full / 400 bad shape / 500 batch failure)."""
+    try:
+        blob = json.loads(raw or b"null")
+        instances = (blob.get("instances")
+                     if isinstance(blob, dict) else blob)
+        deadline_ms = (blob.get("deadline_ms")
+                       if isinstance(blob, dict) else None)
+        if deadline_ms is not None:
+            deadline_ms = float(deadline_ms)
+            if deadline_ms <= 0:
+                raise ValueError("deadline_ms must be > 0")
+        if not isinstance(instances, list) or not instances:
+            raise ValueError(
+                'body must be {"instances": [...]} or a JSON array')
+        out, trace_id = plane.predict_traced(
+            name, np.asarray(instances), deadline_ms=deadline_ms)
+        body = json.dumps({
+            "model": name,
+            "rows": len(instances),
+            "predictions": _jsonable(out),
+        }).encode()
+        # the trace id rides a header, not the body — existing
+        # clients keep parsing the same JSON shape
+        headers = {"X-Keystone-Trace": trace_id} if trace_id else None
+        return 200, body, headers
+    except ModelNotAdmitted as exc:
+        return 404, _err(exc), None
+    except ModelWarming as exc:
+        return 503, _err(exc), None
+    except DeadlineExpiredError as exc:
+        # the request was shed before dispatch: the honest verdict
+        # is "too late", not "server broke" — 504, like a gateway
+        # giving up on an upstream budget
+        return 504, _err(exc), None
+    except QueueFullError as exc:
+        # sustained overload answers WHEN, not just no: the header
+        # carries the batcher's drain-rate estimate (integer
+        # seconds per RFC 9110, floored at 1)
+        return 429, _err(exc), {
+            "Retry-After": str(max(1, math.ceil(exc.retry_after_s)))}
+    except (ValueError, TypeError, json.JSONDecodeError) as exc:
+        return 400, _err(exc), None
+    except Exception as exc:  # batch execution failure: honest 500
+        return 500, _err(exc), None
+
+
+class ServingHandler(_JsonReplyHandler):
     """Extends the metrics/healthz handler with the predict data plane
     (``plane`` is bound per server by :func:`serve`)."""
 
@@ -113,60 +188,12 @@ class ServingHandler(_MetricsHandler):
         name = path[len("/predict/"):]
         try:
             length = int(self.headers.get("Content-Length", "0"))
-            blob = json.loads(self.rfile.read(length) or b"null")
-            instances = (blob.get("instances")
-                         if isinstance(blob, dict) else blob)
-            deadline_ms = (blob.get("deadline_ms")
-                           if isinstance(blob, dict) else None)
-            if deadline_ms is not None:
-                deadline_ms = float(deadline_ms)
-                if deadline_ms <= 0:
-                    raise ValueError("deadline_ms must be > 0")
-            if not isinstance(instances, list) or not instances:
-                raise ValueError(
-                    'body must be {"instances": [...]} or a JSON array')
-            out, trace_id = self.plane.predict_traced(
-                name, np.asarray(instances), deadline_ms=deadline_ms)
-            body = json.dumps({
-                "model": name,
-                "rows": len(instances),
-                "predictions": _jsonable(out),
-            }).encode()
-            # the trace id rides a header, not the body — existing
-            # clients keep parsing the same JSON shape
-            headers = {"X-Keystone-Trace": trace_id} if trace_id else None
-            self._reply(200, body, "application/json", headers=headers)
-        except ModelNotAdmitted as exc:
-            self._reply(404, _err(exc))
-        except ModelWarming as exc:
-            self._reply(503, _err(exc))
-        except DeadlineExpiredError as exc:
-            # the request was shed before dispatch: the honest verdict
-            # is "too late", not "server broke" — 504, like a gateway
-            # giving up on an upstream budget
-            self._reply(504, _err(exc))
-        except QueueFullError as exc:
-            # sustained overload answers WHEN, not just no: the header
-            # carries the batcher's drain-rate estimate (integer
-            # seconds per RFC 9110, floored at 1)
-            self._reply(429, _err(exc), headers={
-                "Retry-After":
-                    str(max(1, math.ceil(exc.retry_after_s)))})
-        except (ValueError, TypeError, json.JSONDecodeError) as exc:
+            raw = self.rfile.read(length)
+        except (ValueError, TypeError) as exc:
             self._reply(400, _err(exc))
-        except Exception as exc:  # batch execution failure: honest 500
-            self._reply(500, _err(exc))
-
-    def _reply(self, status: int, body: bytes,
-               ctype: str = "application/json",
-               headers: Optional[Dict[str, str]] = None) -> None:
-        self.send_response(status)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(body)))
-        for key, value in (headers or {}).items():
-            self.send_header(key, value)
-        self.end_headers()
-        self.wfile.write(body)
+            return
+        status, body, headers = predict_response(self.plane, name, raw)
+        self._reply(status, body, "application/json", headers=headers)
 
 
 def _err(exc: BaseException) -> bytes:
@@ -185,6 +212,25 @@ def _jsonable(out: Any) -> Any:
     return out
 
 
+def bind_server(handler_cls: type, attrs: Dict[str, Any],
+                port: int = 0, host: str = "127.0.0.1",
+                thread_name: str = "keystone-http") -> _MetricsServer:
+    """Bind a per-instance subclass of ``handler_cls`` (class attrs in
+    ``attrs``, e.g. the plane/registry/ready probe) on ``host:port``
+    and serve it from a daemon thread. The one server-construction
+    idiom every serving surface uses — single-process plane, fleet
+    router, replica admin — so shutdown/join semantics stay uniform
+    (``.shutdown()`` joins the thread and releases the port)."""
+    handler = type("_Bound" + handler_cls.__name__, (handler_cls,),
+                   dict(attrs))
+    server = _MetricsServer((host, port), handler)
+    t = threading.Thread(target=server.serve_forever,
+                         name=thread_name, daemon=True)
+    server._keystone_thread = t
+    t.start()
+    return server
+
+
 def serve(plane: ServingPlane, port: int = 0, host: str = "127.0.0.1",
           registry: Optional[MetricsRegistry] = None) -> _MetricsServer:
     """Bind the serving endpoints for ``plane`` on ``host:port``
@@ -192,17 +238,11 @@ def serve(plane: ServingPlane, port: int = 0, host: str = "127.0.0.1",
     start serving from a daemon thread. ``/healthz`` is readiness-gated
     on ``plane.ready``. Returns the server; ``.shutdown()`` releases
     the port."""
-    import threading
-
-    handler = type("_BoundServingHandler", (ServingHandler,),
-                   {"registry": registry, "plane": plane,
-                    "ready_probe": staticmethod(plane.ready)})
-    server = _MetricsServer((host, port), handler)
-    t = threading.Thread(target=server.serve_forever,
-                         name="keystone-serving-http", daemon=True)
-    server._keystone_thread = t
-    t.start()
-    return server
+    return bind_server(
+        ServingHandler,
+        {"registry": registry, "plane": plane,
+         "ready_probe": staticmethod(plane.ready)},
+        port=port, host=host, thread_name="keystone-serving-http")
 
 
 # -- CLI ----------------------------------------------------------------------
@@ -300,8 +340,6 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"{entry.weight_dtype or 'f32'}", flush=True)
         print(f"serving ready ({len(specs)} models) on "
               f"{host}:{server.server_port}", flush=True)
-        import threading
-
         threading.Event().wait()  # serve until interrupted
     except AdmissionError as exc:
         print(f"serve: admission refused: {exc}", file=sys.stderr)

@@ -37,6 +37,7 @@ bounds on them.
 """
 from __future__ import annotations
 
+import json
 import math
 import threading
 import time
@@ -274,6 +275,96 @@ def _classify(exc: BaseException) -> str:
                         TimeoutError, _FutTimeout)):
         return "error"
     return "unclassified"
+
+
+class HttpServingClient:
+    """A plane-shaped adapter over a real HTTP serving endpoint (one
+    replica or the fleet router — same wire surface), so
+    :func:`replay` drives real sockets with zero changes: the sender
+    pool calls ``submit_request`` exactly as it would on a plane, the
+    POST happens synchronously inside it, and the HTTP status comes
+    back RECONSTRUCTED as the serving exception it encodes (429 ->
+    ``QueueFullError`` carrying the ``Retry-After`` hint, 503 ->
+    ``ModelWarming`` or router-unavailable, 404 -> ``ModelNotAdmitted``,
+    504 -> ``DeadlineExpiredError``, 500 -> ``PoisonedBatchError`` when
+    the body names it). The classifier then lands every outcome in the
+    same bucket it would land for an in-process plane — the chaos
+    floors and the fleet gate assert over ONE vocabulary regardless of
+    transport. Connection failures surface as ``ConnectionError``
+    (classified ``error``): a dead replica mid-kill is an honest,
+    counted outcome, never an unclassified crash."""
+
+    def __init__(self, host: str, port: int,
+                 request_timeout_s: float = 30.0):
+        self.host = host
+        self.port = int(port)
+        self.request_timeout_s = float(request_timeout_s)
+
+    def _raise_for(self, status: int, body: bytes,
+                   headers: Dict[str, str]) -> None:
+        from .batcher import DeadlineExpiredError, QueueFullError
+        from .plane import (ModelNotAdmitted, ModelWarming,
+                            PoisonedBatchError)
+
+        try:
+            text = json.loads(body or b"{}").get("error", "")
+        except ValueError:
+            text = body[:200].decode(errors="replace")
+        retry_after = float(headers.get("Retry-After", 1.0) or 1.0)
+        if status == 429:
+            raise QueueFullError(text or "queue full",
+                                 retry_after_s=retry_after)
+        if status == 503:
+            if "ModelWarming" in text:
+                raise ModelWarming(text)
+            raise QueueFullError(text or "unavailable",
+                                 retry_after_s=retry_after)
+        if status == 404:
+            raise ModelNotAdmitted(text or "not admitted")
+        if status == 504:
+            raise DeadlineExpiredError(text or "deadline expired")
+        if status == 500 and "PoisonedBatchError" in text:
+            raise PoisonedBatchError(text)
+        # 400 and the rest are honest errors, never unclassified —
+        # RuntimeError (not ValueError) keeps the classifier verdict
+        raise RuntimeError(f"HTTP {status}: {text or body[:200]!r}")
+
+    def submit_request(self, model: str, x: Any,
+                       timeout_s: Optional[float] = None,
+                       deadline_ms: Optional[float] = None) -> Any:
+        import http.client
+        from concurrent.futures import Future
+
+        from .batcher import Request
+
+        payload: Dict[str, Any] = {
+            "instances": np.asarray(x).tolist()}
+        if deadline_ms is not None:
+            payload["deadline_ms"] = deadline_ms
+        conn = http.client.HTTPConnection(
+            self.host, self.port, timeout=self.request_timeout_s)
+        try:
+            try:
+                conn.request("POST", f"/predict/{model}",
+                             body=json.dumps(payload).encode(),
+                             headers={"Content-Type":
+                                      "application/json"})
+                resp = conn.getresponse()
+                body = resp.read()
+                headers = {k: v for k, v in resp.getheaders()}
+                status = resp.status
+            except (OSError, http.client.HTTPException) as exc:
+                raise ConnectionError(
+                    f"{self.host}:{self.port}: {exc}") from exc
+        finally:
+            conn.close()
+        if status != 200:
+            self._raise_for(status, body, headers)
+        out = json.loads(body)
+        future: Future = Future()
+        future.set_result(np.asarray(out["predictions"]))
+        return Request(model=model, x=x, n=int(out.get("rows", 1)),
+                       enqueued_s=time.perf_counter(), future=future)
 
 
 def replay(trace: LoadTrace, plane: Any,

@@ -1,0 +1,183 @@
+"""The model-record layer of the serving plane.
+
+Split out of ``serving/plane.py`` (the fleet PR): everything that
+describes ONE served model — the live :class:`ServedModel` record with
+its QPS window and LRU-with-cost retention value, the host-side
+:class:`_EvictedModel` remainder the canonical-bytes contract keeps for
+bit-identical readmission, and the pure helpers admission/warmup use
+(zeros batches, weight-dtype narrowing, the non-finite guard, the drift
+baseline probe). ``plane.py`` keeps the orchestration (admission
+control, the worker, the publish discipline); the fleet placement
+solver (``serving/placement.py``) and the migration reactor
+(``serving/fleet.py``) consume these records without importing the
+whole plane.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..observability.metrics import MetricsRegistry
+from .residency import ModelCharge
+
+#: seconds of request history the QPS estimate looks back over
+_QPS_WINDOW_S = 30.0
+
+
+@dataclass
+class ServedModel:
+    """One warm resident model. Mutable serving stats are only touched
+    under the owning plane's lock (the plane declares the guard; this
+    record carries no lock of its own)."""
+
+    name: str
+    fitted: Any                      # the working FittedPipeline
+    blob: bytes                      # canonical pickle (readmission source)
+    sample: Any                      # ShapeDtypeStruct pytree of ONE item
+    charge: ModelCharge
+    buckets: Tuple[int, ...]
+    weight_dtype: Optional[str] = None
+    ready: bool = False
+    warmup_s: float = 0.0
+    last_used_s: float = field(default_factory=time.perf_counter)
+    served_rows: int = 0
+    served_requests: int = 0
+    batches: int = 0
+    baseline: Any = None             # DriftBaseline or None
+    drift_disabled: bool = False
+    _recent: Deque[Tuple[float, int]] = field(default_factory=deque)
+
+    def note_served(self, rows: int, requests: int, now: float) -> None:
+        self.last_used_s = now
+        self.served_rows += rows
+        self.served_requests += requests
+        self.batches += 1
+        self._recent.append((now, rows))
+        while self._recent and self._recent[0][0] < now - _QPS_WINDOW_S:
+            self._recent.popleft()
+
+    def qps(self, now: Optional[float] = None) -> float:
+        """Observed rows/sec over the recent window (0 before any
+        traffic) — the demand half of the retention value."""
+        if not self._recent:
+            return 0.0
+        now = time.perf_counter() if now is None else now
+        t0 = self._recent[0][0]
+        span = max(now - t0, 1e-3)
+        return sum(r for _, r in self._recent) / span
+
+    def retention_value(self, now: Optional[float] = None) -> float:
+        """LRU-with-cost: observed QPS x recompute (warmup) cost, with
+        recency as an epsilon tiebreak so two idle models evict
+        least-recently-used first."""
+        return (self.qps(now) * max(self.warmup_s, 1e-3)
+                + 1e-9 * self.last_used_s)
+
+    def state(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "ready": self.ready,
+            "weight_dtype": self.weight_dtype,
+            "charge_nbytes": self.charge.total_nbytes(),
+            "charge_source": self.charge.source,
+            "buckets": list(self.buckets),
+            "warmup_s": round(self.warmup_s, 4),
+            "served_rows": self.served_rows,
+            "served_requests": self.served_requests,
+            "batches": self.batches,
+            "qps": round(self.qps(), 3),
+            "drift_baseline": self.baseline is not None
+            and not self.drift_disabled,
+        }
+
+
+@dataclass
+class _EvictedModel:
+    """Host-side remainder of an evicted model: everything readmission
+    needs to restore bit-identical serving."""
+
+    blob: bytes
+    sample: Any
+    weight_dtype: Optional[str]
+    evicted_s: float = field(default_factory=time.perf_counter)
+
+
+def _count_nonfinite(outputs: Any) -> int:
+    """Non-finite values in a host output pytree (float leaves only —
+    an integer wire cannot carry NaN). One vectorized pass per leaf:
+    the poisoned-batch guard's whole cost."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(outputs):
+        arr = np.asarray(leaf)
+        if arr.size and np.issubdtype(arr.dtype, np.floating):
+            total += int(arr.size) - int(np.isfinite(arr).sum())
+    return total
+
+
+def _zeros_batch(sample: Any, rows: int) -> Any:
+    return jax.tree_util.tree_map(
+        lambda leaf: np.zeros((rows,) + tuple(leaf.shape),
+                              np.dtype(leaf.dtype)),
+        sample,
+        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+
+
+def _apply_weight_dtype(graph: Any, weight_dtype: Optional[str]) -> int:
+    """Narrow every quantizable mapper in ``graph`` that did not choose
+    a dtype itself (explicit per-model choices always win). Mirrors the
+    LinearMapper constructor's constraint: only a plain (or absent)
+    StandardScalerModel feature scaler keeps the quantized apply one
+    fused affine program — other scalers stay f32 rather than raise."""
+    from ..nodes.learning.linear import (
+        BlockLinearMapper,
+        LinearMapper,
+        StandardScalerModel,
+        _canon_weight_dtype,
+    )
+
+    wd = _canon_weight_dtype(weight_dtype)
+    if wd is None:
+        return 0
+    changed = 0
+    for node in graph.nodes:
+        op = graph.get_operator(node)
+        if not isinstance(op, (LinearMapper, BlockLinearMapper)):
+            continue
+        if op.weight_dtype is not None:
+            continue
+        scaler = getattr(op, "feature_scaler", None)
+        if scaler is not None and type(scaler) is not StandardScalerModel:
+            continue
+        op.weight_dtype = wd
+        # drop memoized programs/eq keys: the quantized apply is a
+        # different program family (struct keys carry weight_dtype)
+        for attr in [k for k in op.__dict__ if k.startswith("_jit_")]:
+            del op.__dict__[attr]
+        op.__dict__.pop("_eq_key_val", None)
+        changed += 1
+    return changed
+
+
+def _evicted_record(entry: ServedModel) -> _EvictedModel:
+    """Host-side remainder for one eviction (also counts it); the dict
+    mutations stay inline at the call sites, under the plane lock."""
+    MetricsRegistry.get_or_create().counter(
+        "serving.evictions_total").inc()
+    return _EvictedModel(blob=entry.blob, sample=entry.sample,
+                         weight_dtype=entry.weight_dtype)
+
+
+def _find_baseline(graph: Any) -> Any:
+    """First fit-time drift sketch riding the fitted operators
+    (``model.numerics_baseline``, attached by ``fit_streaming``)."""
+    for node in graph.nodes:
+        baseline = getattr(graph.get_operator(node),
+                           "numerics_baseline", None)
+        if baseline is not None:
+            return baseline
+    return None

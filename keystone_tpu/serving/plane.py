@@ -43,19 +43,19 @@ ReqTrace` whose phase stamps (queue_wait / coalesce / dispatch /
   and drive the rolling-window SLO tracker (``self.slo``) — one
   post-mortem per violated availability window.
 
-Thread model: handler/caller threads run ``admit``/``submit``; one
-worker thread drains the batcher. ``_models``/``_evicted``/
+Thread model: handler/caller threads run ``admit``/``submit``;
+``workers`` worker threads (default 1, ``KEYSTONE_SERVE_WORKERS``)
+drain the batcher. ``_models``/``_evicted``/
 ``_warming``/``_expected`` are ``@guarded_by`` the plane lock; device
 work (warmup, batch execution) always runs OUTSIDE it.
 """
 from __future__ import annotations
 
+import os
 import pickle
 import threading
 import time
-from collections import deque
-from dataclasses import dataclass, field
-from typing import Any, Deque, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -69,7 +69,7 @@ from ..resilience.faults import corrupt, inject
 from ..utils.guarded import TracedLock, guarded_by, hotpath, published_by
 from .batcher import (BucketPolicy, DeadlineExpiredError, MicroBatcher,
                       Request)
-from .residency import AdmissionError, ModelCharge, ResidencyLedger, model_charge
+from .residency import AdmissionError, ResidencyLedger, model_charge
 
 
 class ModelNotAdmitted(LookupError):
@@ -89,162 +89,13 @@ class PoisonedBatchError(RuntimeError):
     survive to serve the next batch."""
 
 
-#: seconds of request history the QPS estimate looks back over
-_QPS_WINDOW_S = 30.0
-
-
-@dataclass
-class ServedModel:
-    """One warm resident model. Mutable serving stats are only touched
-    under the owning plane's lock (the plane declares the guard; this
-    record carries no lock of its own)."""
-
-    name: str
-    fitted: Any                      # the working FittedPipeline
-    blob: bytes                      # canonical pickle (readmission source)
-    sample: Any                      # ShapeDtypeStruct pytree of ONE item
-    charge: ModelCharge
-    buckets: Tuple[int, ...]
-    weight_dtype: Optional[str] = None
-    ready: bool = False
-    warmup_s: float = 0.0
-    last_used_s: float = field(default_factory=time.perf_counter)
-    served_rows: int = 0
-    served_requests: int = 0
-    batches: int = 0
-    baseline: Any = None             # DriftBaseline or None
-    drift_disabled: bool = False
-    _recent: Deque[Tuple[float, int]] = field(default_factory=deque)
-
-    def note_served(self, rows: int, requests: int, now: float) -> None:
-        self.last_used_s = now
-        self.served_rows += rows
-        self.served_requests += requests
-        self.batches += 1
-        self._recent.append((now, rows))
-        while self._recent and self._recent[0][0] < now - _QPS_WINDOW_S:
-            self._recent.popleft()
-
-    def qps(self, now: Optional[float] = None) -> float:
-        """Observed rows/sec over the recent window (0 before any
-        traffic) — the demand half of the retention value."""
-        if not self._recent:
-            return 0.0
-        now = time.perf_counter() if now is None else now
-        t0 = self._recent[0][0]
-        span = max(now - t0, 1e-3)
-        return sum(r for _, r in self._recent) / span
-
-    def retention_value(self, now: Optional[float] = None) -> float:
-        """LRU-with-cost: observed QPS x recompute (warmup) cost, with
-        recency as an epsilon tiebreak so two idle models evict
-        least-recently-used first."""
-        return (self.qps(now) * max(self.warmup_s, 1e-3)
-                + 1e-9 * self.last_used_s)
-
-    def state(self) -> Dict[str, Any]:
-        return {
-            "name": self.name,
-            "ready": self.ready,
-            "weight_dtype": self.weight_dtype,
-            "charge_nbytes": self.charge.total_nbytes(),
-            "charge_source": self.charge.source,
-            "buckets": list(self.buckets),
-            "warmup_s": round(self.warmup_s, 4),
-            "served_rows": self.served_rows,
-            "served_requests": self.served_requests,
-            "batches": self.batches,
-            "qps": round(self.qps(), 3),
-            "drift_baseline": self.baseline is not None
-            and not self.drift_disabled,
-        }
-
-
-@dataclass
-class _EvictedModel:
-    """Host-side remainder of an evicted model: everything readmission
-    needs to restore bit-identical serving."""
-
-    blob: bytes
-    sample: Any
-    weight_dtype: Optional[str]
-    evicted_s: float = field(default_factory=time.perf_counter)
-
-
-def _count_nonfinite(outputs: Any) -> int:
-    """Non-finite values in a host output pytree (float leaves only —
-    an integer wire cannot carry NaN). One vectorized pass per leaf:
-    the poisoned-batch guard's whole cost."""
-    total = 0
-    for leaf in jax.tree_util.tree_leaves(outputs):
-        arr = np.asarray(leaf)
-        if arr.size and np.issubdtype(arr.dtype, np.floating):
-            total += int(arr.size) - int(np.isfinite(arr).sum())
-    return total
-
-
-def _zeros_batch(sample: Any, rows: int) -> Any:
-    return jax.tree_util.tree_map(
-        lambda leaf: np.zeros((rows,) + tuple(leaf.shape),
-                              np.dtype(leaf.dtype)),
-        sample,
-        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
-
-
-def _apply_weight_dtype(graph: Any, weight_dtype: Optional[str]) -> int:
-    """Narrow every quantizable mapper in ``graph`` that did not choose
-    a dtype itself (explicit per-model choices always win). Mirrors the
-    LinearMapper constructor's constraint: only a plain (or absent)
-    StandardScalerModel feature scaler keeps the quantized apply one
-    fused affine program — other scalers stay f32 rather than raise."""
-    from ..nodes.learning.linear import (
-        BlockLinearMapper,
-        LinearMapper,
-        StandardScalerModel,
-        _canon_weight_dtype,
-    )
-
-    wd = _canon_weight_dtype(weight_dtype)
-    if wd is None:
-        return 0
-    changed = 0
-    for node in graph.nodes:
-        op = graph.get_operator(node)
-        if not isinstance(op, (LinearMapper, BlockLinearMapper)):
-            continue
-        if op.weight_dtype is not None:
-            continue
-        scaler = getattr(op, "feature_scaler", None)
-        if scaler is not None and type(scaler) is not StandardScalerModel:
-            continue
-        op.weight_dtype = wd
-        # drop memoized programs/eq keys: the quantized apply is a
-        # different program family (struct keys carry weight_dtype)
-        for attr in [k for k in op.__dict__ if k.startswith("_jit_")]:
-            del op.__dict__[attr]
-        op.__dict__.pop("_eq_key_val", None)
-        changed += 1
-    return changed
-
-
-def _evicted_record(entry: ServedModel) -> _EvictedModel:
-    """Host-side remainder for one eviction (also counts it); the dict
-    mutations stay inline at the call sites, under the plane lock."""
-    MetricsRegistry.get_or_create().counter(
-        "serving.evictions_total").inc()
-    return _EvictedModel(blob=entry.blob, sample=entry.sample,
-                         weight_dtype=entry.weight_dtype)
-
-
-def _find_baseline(graph: Any) -> Any:
-    """First fit-time drift sketch riding the fitted operators
-    (``model.numerics_baseline``, attached by ``fit_streaming``)."""
-    for node in graph.nodes:
-        baseline = getattr(graph.get_operator(node),
-                           "numerics_baseline", None)
-        if baseline is not None:
-            return baseline
-    return None
+# the model-record layer lives in serving/models.py since the fleet
+# split (placement/fleet import the records without the whole plane);
+# re-exported here because this module IS the historical home of these
+# names (tests and callers import them from serving.plane)
+from .models import (_QPS_WINDOW_S, ServedModel, _EvictedModel,  # noqa: F401
+                     _apply_weight_dtype, _count_nonfinite,
+                     _evicted_record, _find_baseline, _zeros_batch)
 
 
 @published_by("_lock", "_live")
@@ -270,7 +121,8 @@ class ServingPlane:
                  mesh: Any = None, steady_fence: bool = True,
                  slo_policy: Any = None, data_shards: int = 1,
                  nonfinite_guard: bool = True,
-                 postmortem_min_interval_s: float = 30.0):
+                 postmortem_min_interval_s: float = 30.0,
+                 workers: Optional[int] = None):
         from ..observability.slo import SloTracker
         from ..parallel.mesh import get_mesh, num_data_shards
 
@@ -312,7 +164,18 @@ class ServingPlane:
         self._fence_armed = False
         self._lock = TracedLock("serving.plane")
         self._stop = threading.Event()
+        #: dispatch concurrency: N worker threads drain the batcher
+        #: concurrently (JAX dispatch releases the GIL, so batches for
+        #: different models genuinely overlap). Default 1 preserves the
+        #: single-worker semantics exactly; the KEYSTONE_SERVE_WORKERS
+        #: env var is the fleet-deployment knob (PERFORMANCE.md rule 19
+        #: — measure serving.queue_wait_s before reaching for it).
+        if workers is None:
+            workers = int(os.environ.get("KEYSTONE_SERVE_WORKERS",
+                                         "1") or "1")
+        self.workers = max(int(workers), 1)
         self._worker: Optional[threading.Thread] = None
+        self._workers: List[threading.Thread] = []
         # the serving thread's identity, cached once at worker start so
         # the per-batch defer does not pay a current_thread() lookup
         # (defaults cover tests driving _serve_batch directly)
@@ -338,15 +201,20 @@ class ServingPlane:
         self.close()
 
     def start(self) -> "ServingPlane":
-        """Start the batch worker (idempotent)."""
+        """Start the batch worker(s) (idempotent)."""
         with self._lock:
             if self._worker is None and not self._closed:
                 self._stop = threading.Event()
-                t = threading.Thread(target=self._worker_loop,
-                                     name="keystone-serving-worker",
-                                     daemon=True)
-                self._worker = t
-                t.start()
+                for i in range(self.workers):
+                    t = threading.Thread(
+                        target=self._worker_loop, args=(i == 0,),
+                        name=("keystone-serving-worker" if i == 0
+                              else f"keystone-serving-worker-{i}"),
+                        daemon=True)
+                    self._workers.append(t)
+                self._worker = self._workers[0]
+                for t in self._workers:
+                    t.start()
         return self
 
     def close(self) -> None:
@@ -358,13 +226,14 @@ class ServingPlane:
             # atomic flip: lock-free submitters fall to the locked slow
             # path, which sees _closed and the batcher refusal
             self._live = {}
-            worker = self._worker
+            workers = list(self._workers)
+            self._workers = []
             self._worker = None
             self._stop.set()
             if self._fence_armed:
                 self._fence_armed = False
                 self._observatory().disarm_fence()
-        if worker is not None:
+        for worker in workers:
             worker.join(timeout=10.0)
         for req in self.batcher.close():
             if not req.future.done():
@@ -841,10 +710,14 @@ class ServingPlane:
         return pairs
 
     # -- the worker --------------------------------------------------------
-    def _worker_loop(self) -> None:
+    def _worker_loop(self, primary: bool = True) -> None:
         t = threading.current_thread()
-        self._worker_tid = t.ident or 0
-        self._worker_name = t.name
+        if primary:
+            # only the primary worker owns the cached span identity;
+            # extra workers (KEYSTONE_SERVE_WORKERS > 1) resolve theirs
+            # per batch in _record_batch_trace
+            self._worker_tid = t.ident or 0
+            self._worker_name = t.name
         max_rows = self.policy.max_rows(self._shards)
         while not self._stop.is_set():
             batch = self.batcher.take(max_rows, timeout_s=0.05)
@@ -931,6 +804,15 @@ class ServingPlane:
                 reg.histogram("serving.request_ms").observe(wait_ms)
                 reg.histogram(
                     f"serving.request_ms.{name}").observe(wait_ms)
+                # queued time in SECONDS (enqueue -> coalesce start):
+                # the one measured congestion signal the router's spill
+                # eligibility and the bench fleet line both read — a
+                # replica with a deep queue_wait tail is not eligible
+                # to absorb spilled traffic (satellite: queue-wait)
+                qwait_s = max(t_merge - req.enqueued_s, 0.0)
+                reg.histogram("serving.queue_wait_s").observe(qwait_s)
+                reg.histogram(
+                    f"serving.queue_wait_s.{name}").observe(qwait_s)
                 self.slo.record(name, wait_ms)
             if traced:
                 self._record_batch_trace(name, traced, t_merge,
@@ -1036,11 +918,18 @@ class ServingPlane:
         one deque append."""
         rec = flight_recorder()
         batch_id = mint_flow_id()
+        if self.workers > 1:
+            # concurrent dispatch: the span must land on the lane of
+            # the thread that actually served this batch
+            wt = threading.current_thread()
+            tid, thread = wt.ident or 0, wt.name
+        else:
+            tid, thread = self._worker_tid, self._worker_name
         if rec.enabled:
             members = tuple(traces)
             rec.defer(lambda: self._materialize_batch_telemetry(
                 rec, name, members, start_s, bucket, fill, batch_id,
-                self._worker_tid, self._worker_name))
+                tid, thread))
         else:
             # no recorder, no flush point: the scrape surface still
             # owes the phase histograms and the reservoir its

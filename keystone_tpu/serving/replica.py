@@ -1,0 +1,188 @@
+"""One fleet replica: a serving plane that starts EMPTY and takes its
+models over HTTP from the fleet controller.
+
+``python -m keystone_tpu.serving.replica --port 0`` binds a plane with
+zero models and prints the machine-parseable ``replica on HOST:PORT``
+line (the fleet gate and chaos scenarios parse it, the same contract
+as the single-process server's ``serving on ...``). Everything a
+replica hosts arrives through the admin surface:
+
+* ``POST /admin/admit`` — ``{"name", "blob_b64", "sample",
+  "weight_dtype"}``: the controller ships the CANONICAL pickled bytes
+  (the plane's own ``entry.blob`` currency), the replica admits and
+  answers ``{"sha256", "charge_nbytes", "warmup_s"}``. The sha is the
+  migration bit-identity verdict: the controller compares it against
+  the source replica's before it evicts anything (admit -> verify ->
+  evict, never a lossy hop).
+* ``POST /admin/evict`` — ``{"name"}``: the drain half of a migration.
+* ``GET /admin/models`` — ``{name: sha256}`` for every live model: what
+  this replica would answer for, byte-attested.
+
+The predict surface is inherited UNCHANGED from
+:class:`~.http.ServingHandler` — a replica is a plain serving process
+plus an admin plane; clients cannot tell the difference, which is what
+lets the router front either. Admin calls are cold-path by design
+(admission compiles, eviction republishes) and never run per request.
+
+The admin payloads carry pickled bytes, so a replica trusts its
+controller exactly as far as a checkpoint file trusts its writer —
+bind admin surfaces to loopback or an equally private interface.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import pickle
+import sys
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..observability.metrics import MetricsRegistry
+from .http import ServingHandler, _err, bind_server
+from .plane import ModelNotAdmitted, ServingPlane
+from .residency import AdmissionError
+
+
+def encode_sample_spec(sample: Any) -> str:
+    """The admitted-sample wire form (base64 pickle): samples are
+    host-side numpy pytrees whose shape/dtype drive the warmup
+    compiles — shipped exactly, not re-derived."""
+    return base64.b64encode(pickle.dumps(sample)).decode()
+
+
+def decode_sample_spec(spec: str) -> Any:
+    return pickle.loads(base64.b64decode(spec))
+
+
+class ReplicaAdminHandler(ServingHandler):
+    """The replica's HTTP surface: the full predict/observability
+    surface by inheritance, plus the controller-facing admin plane."""
+
+    def do_GET(self):  # noqa: N802 (stdlib handler API)
+        if self.path.split("?")[0] == "/admin/models":
+            shas = {name: hashlib.sha256(entry.blob).hexdigest()
+                    for name, entry in sorted(self.plane._live.items())}
+            self._reply(200, json.dumps(shas).encode())
+            return
+        super().do_GET()
+
+    def do_POST(self):  # noqa: N802 (stdlib handler API)
+        path = self.path.split("?")[0]
+        if not path.startswith("/admin/"):
+            super().do_POST()
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, TypeError) as exc:
+            self._reply(400, _err(exc))
+            return
+        if path == "/admin/admit":
+            self._admit(payload)
+        elif path == "/admin/evict":
+            self._evict(payload)
+        else:
+            self._reply(404, b'{"error": "unknown admin endpoint"}\n')
+
+    def _admit(self, payload: Dict[str, Any]) -> None:
+        try:
+            name = payload["name"]
+            blob = base64.b64decode(payload["blob_b64"])
+            sample = decode_sample_spec(payload["sample"])
+            weight_dtype = payload.get("weight_dtype")
+        except (KeyError, TypeError, ValueError) as exc:
+            self._reply(400, _err(exc))
+            return
+        try:
+            entry = self.plane.admit(name, pickle.loads(blob), sample,
+                                     weight_dtype=weight_dtype)
+        except AdmissionError as exc:
+            # the replica's honest refusal: over-budget admission is
+            # the CONTROLLER's planning error to hear about, loudly
+            self._reply(507, _err(exc))
+            return
+        except Exception as exc:  # noqa: BLE001 (verdict, not a crash)
+            self._reply(500, _err(exc))
+            return
+        self._reply(200, json.dumps({
+            "name": name,
+            "sha256": hashlib.sha256(entry.blob).hexdigest(),
+            "charge_nbytes": entry.charge.total_nbytes(),
+            "warmup_s": entry.warmup_s,
+        }).encode())
+
+    def _evict(self, payload: Dict[str, Any]) -> None:
+        name = payload.get("name")
+        if not isinstance(name, str) or not name:
+            self._reply(400, b'{"error": "evict needs a model name"}\n')
+            return
+        try:
+            self.plane.evict(name)
+        except (ModelNotAdmitted, KeyError) as exc:
+            self._reply(404, _err(exc))
+            return
+        self._reply(200, json.dumps({"evicted": name}).encode())
+
+
+def serve_replica(plane: ServingPlane, port: int = 0,
+                  host: str = "127.0.0.1",
+                  registry: Optional[MetricsRegistry] = None):
+    """Bind a replica (predict + admin surfaces) on ``host:port``."""
+    return bind_server(
+        ReplicaAdminHandler,
+        {"registry": registry, "plane": plane,
+         "ready_probe": staticmethod(plane.ready)},
+        port=port, host=host, thread_name="keystone-replica-http")
+
+
+def _pop_flag(argv: List[str], flag: str,
+              default: Optional[str] = None) -> Optional[str]:
+    if flag not in argv:
+        return default
+    i = argv.index(flag)
+    if i + 1 >= len(argv):
+        raise ValueError(f"{flag} requires a value")
+    value = argv[i + 1]
+    del argv[i:i + 2]
+    return value
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m keystone_tpu.serving.replica`` — see module
+    docstring. Starts empty; models arrive via ``/admin/admit``."""
+    from ..__main__ import _parse_bytes
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    try:
+        port = int(_pop_flag(argv, "--port", "0"))
+        host = _pop_flag(argv, "--host", "127.0.0.1")
+        budget_text = _pop_flag(argv, "--hbm-budget")
+        budget = None if budget_text is None else _parse_bytes(budget_text)
+        max_batch = int(_pop_flag(argv, "--max-batch", "64"))
+        queue_depth = int(_pop_flag(argv, "--queue-depth", "256"))
+        workers_text = _pop_flag(argv, "--workers")
+        workers = None if workers_text is None else int(workers_text)
+    except ValueError as exc:
+        print(f"replica: {exc}", file=sys.stderr)
+        return 2
+    if argv:
+        print(f"replica: unknown arguments {argv}", file=sys.stderr)
+        return 2
+    plane = ServingPlane(hbm_budget=budget, max_batch=max_batch,
+                         queue_depth=queue_depth, workers=workers)
+    plane.start()
+    server = serve_replica(plane, port=port, host=host)
+    print(f"replica on {host}:{server.server_port}", flush=True)
+    try:
+        threading.Event().wait()  # serve until killed by the fleet
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        plane.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

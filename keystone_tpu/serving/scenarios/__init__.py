@@ -27,9 +27,12 @@ invariants every run must keep:
 
 The catalogue (see each module's docstring): ``burst``, ``diurnal``,
 ``zipf_churn``, ``straggler_dispatch``, ``poisoned_batch``,
-``overload_shed``. ``tools/chaos_gate.py`` runs all of them at bounded
-seeds in CI; the ``serving_soak`` bench section emits their
-p99/availability as ``soak_<scenario>_*`` lines for benchdiff.
+``overload_shed``, plus the fleet pair (``replica_death``,
+``migration_under_load`` — N replicas behind the real-HTTP router via
+a scenario-owned ``run_fn`` substrate). ``tools/chaos_gate.py`` runs
+all of them at bounded seeds in CI; the ``serving_soak`` bench section
+emits their p99/availability as ``soak_<scenario>_*`` lines for
+benchdiff.
 
 Scenario planes share one (d, k) model family and bucket ladder on
 purpose: the global JIT caches make every warmup after the first a
@@ -82,6 +85,16 @@ class Scenario:
     queue_depth: int = 64
     submit_timeout_s: float = 0.25
     senders: int = 6
+    #: a scenario that brings its own substrate (the fleet scenarios
+    #: run N planes behind real HTTP instead of one in-process plane):
+    #: ``run_fn(scenario, trace, seed, time_scale, violations)`` owns
+    #: build/replay/teardown and returns ``(report, injections)``; the
+    #: harness keeps the shared epilogue (floors, clean-or-classified,
+    #: chaos.* counters, the post-mortem) so every catalogue entry is
+    #: judged identically. None = the standard single-plane substrate.
+    run_fn: Optional[Callable[
+        ["Scenario", LoadTrace, int, float, List[str]],
+        Tuple[ReplayReport, int]]] = None
 
 
 @dataclass
@@ -201,52 +214,64 @@ def run_scenario(name: str, seed: int, time_scale: float = 1.0,
     violations: List[str] = []
     t_run = time.perf_counter()
 
-    # a live SLO policy sized to the scenario window, so the SLO plane
-    # (rolling windows, burn rate, its own post-mortems) is exercised
-    # by every run rather than idling at defaults
-    plane = ServingPlane(
-        max_batch=MAX_BATCH, queue_depth=scenario.queue_depth,
-        slo_policy=SloPolicy(latency_threshold_ms=scenario.floors.p99_ms,
-                             availability_target=0.5, window=256,
-                             min_count=64),
-        postmortem_min_interval_s=0.0)
-    _guard_dispatch(plane, violations)
-    plane.start()
-    worker = None
-    plan = scenario.plan_fn(seed)
-    injections = 0
-    try:
-        for model in spec.models:
-            plane.admit(model, _fit_catalogue_model(seed),
-                        (np.zeros((MODEL_D,), np.float32)))
-        worker = plane._worker
-        if plan is not None:
-            with plan:
-                report = replay(trace, plane, _input_for,
-                                senders=scenario.senders,
-                                time_scale=time_scale,
-                                submit_timeout_s=scenario.submit_timeout_s)
-            injections = plan.injections()
-        else:
-            report = replay(trace, plane, _input_for,
-                            senders=scenario.senders,
-                            time_scale=time_scale,
-                            submit_timeout_s=scenario.submit_timeout_s)
+    if scenario.run_fn is not None:
+        # custom substrate (fleet scenarios); the epilogue below still
+        # judges the result exactly like every other catalogue entry
+        report, injections = scenario.run_fn(scenario, trace, seed,
+                                             time_scale, violations)
+    else:
+        # a live SLO policy sized to the scenario window, so the SLO
+        # plane (rolling windows, burn rate, its own post-mortems) is
+        # exercised by every run rather than idling at defaults
+        plane = ServingPlane(
+            max_batch=MAX_BATCH, queue_depth=scenario.queue_depth,
+            slo_policy=SloPolicy(
+                latency_threshold_ms=scenario.floors.p99_ms,
+                availability_target=0.5, window=256,
+                min_count=64),
+            postmortem_min_interval_s=0.0)
+        _guard_dispatch(plane, violations)
+        plane.start()
+        worker = None
+        plan = scenario.plan_fn(seed)
+        injections = 0
+        try:
+            for model in spec.models:
+                plane.admit(model, _fit_catalogue_model(seed),
+                            (np.zeros((MODEL_D,), np.float32)))
+            worker = plane._worker
+            if plan is not None:
+                with plan:
+                    report = replay(
+                        trace, plane, _input_for,
+                        senders=scenario.senders,
+                        time_scale=time_scale,
+                        submit_timeout_s=scenario.submit_timeout_s)
+                injections = plan.injections()
+            else:
+                report = replay(
+                    trace, plane, _input_for,
+                    senders=scenario.senders,
+                    time_scale=time_scale,
+                    submit_timeout_s=scenario.submit_timeout_s)
 
-        # zero-wedged-workers probe: every READY resident must still
-        # answer (the queue drains, the worker thread is alive)
-        for model in list(plane._live):
-            try:
-                plane.predict(model, _input_for(model, 1), timeout_s=10.0)
-            except BaseException as exc:
-                violations.append(
-                    f"wedged_worker: post-chaos probe for {model!r} "
-                    f"failed: {type(exc).__name__}: {exc}")
-    finally:
-        plane.close()
-    if worker is not None and worker.is_alive():
-        violations.append("wedged_worker: the plane worker thread "
-                          "survived close() — the queue is wedged")
+            # zero-wedged-workers probe: every READY resident must
+            # still answer (the queue drains, the worker is alive)
+            for model in list(plane._live):
+                try:
+                    plane.predict(model, _input_for(model, 1),
+                                  timeout_s=10.0)
+                except BaseException as exc:
+                    violations.append(
+                        f"wedged_worker: post-chaos probe for "
+                        f"{model!r} failed: "
+                        f"{type(exc).__name__}: {exc}")
+        finally:
+            plane.close()
+        if worker is not None and worker.is_alive():
+            violations.append(
+                "wedged_worker: the plane worker thread survived "
+                "close() — the queue is wedged")
 
     p99 = report.p99_ms()
     availability = report.availability()
@@ -294,7 +319,7 @@ def load_catalogue() -> Dict[str, Scenario]:
     """Import every scenario module (idempotent) and return the
     registry — the one entry point the gate, the bench, and the tests
     share."""
-    from . import (burst, diurnal, overload_shed, poisoned_batch,  # noqa: F401
-                   straggler_dispatch, zipf_churn)
+    from . import (burst, diurnal, fleet_chaos, overload_shed,  # noqa: F401
+                   poisoned_batch, straggler_dispatch, zipf_churn)
 
     return SCENARIOS

@@ -246,12 +246,14 @@ def greedy_select(initial, candidates_fn, mem_of, objective,
                   budget: float) -> frozenset:
     """The profile-under-budget greedy selection loop (reference
     ``AutoCacheRule.scala:526-549``), decoupled from Cacher insertion so
-    one algorithm serves both residency planners: intermediate-result
-    caching here (:meth:`AutoCacheRule._greedy`: minimize the estimated
-    pipeline runtime of the cache set) and the serving plane's
-    multi-model placement/eviction (``serving/plane.py``: maximize the
-    retained LRU-with-cost value — observed QPS x recompute cost —
-    under the HBM budget).
+    one algorithm serves all three residency planners:
+    intermediate-result caching here (:meth:`AutoCacheRule._greedy`:
+    minimize the estimated pipeline runtime of the cache set), the
+    serving plane's multi-model placement/eviction (``serving/plane.py``:
+    maximize the retained LRU-with-cost value — observed QPS x recompute
+    cost — under the HBM budget), and the fleet placement solver's
+    hot-model replication (``serving/placement.py``: maximize the same
+    currency into each replica's leftover capacity).
 
     Starting from ``initial``, repeatedly add the candidate whose
     addition MINIMIZES ``objective(selected | {c})`` while the summed

@@ -1,0 +1,440 @@
+"""The serving fleet (ISSUE 20): rendezvous routing with honest spill,
+the canonical-bytes fleet controller, and the autoscaling reactor.
+
+The acceptance pins:
+
+* routing is rendezvous hashing — deterministic, coordination-free,
+  and stable under membership change (removing a non-primary replica
+  never re-routes a model; removing the primary re-routes ONLY it);
+* spill is measured and honest — a congested or refusing primary
+  loses the request to the least-loaded sibling and the router counts
+  it (``router.spill_total``); when every replica refuses, the LAST
+  classified verdict surfaces (429/503 with Retry-After over HTTP),
+  never an unclassified error;
+* migration is bit-identical or aborted — the controller's
+  admit -> sha-verify -> evict order, with the impostor copy evicted
+  before anything routes to it;
+* death recovery is a verified migration, not a guess — the corpse
+  leaves the membership, ``fleet.replica_deaths_total`` counts it, and
+  the lost models re-admit from canonical bytes on the survivors;
+* the reactor acts only on sustained measured signals (queue depth,
+  failed probes, demand drift) — one bursty scrape must not flap the
+  fleet;
+* the whole loadgen trace is pinned by sha256 of its canonical
+  serialization — an RNG draw-order refactor reshuffles every
+  scenario's traffic and must fail here by value, not by eyeball.
+
+Every router/controller test runs on duck-typed fake replicas (the
+real transports are exercised end-to-end by ``tools/fleet_gate.py``
+and the fleet chaos scenarios) — these tests pin the routing and
+placement LOGIC at unit speed.
+"""
+import hashlib
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from keystone_tpu.nodes.learning.linear import LinearMapEstimator
+from keystone_tpu.observability.metrics import MetricsRegistry
+from keystone_tpu.parallel.dataset import ArrayDataset
+from keystone_tpu.serving.batcher import QueueFullError
+from keystone_tpu.serving.fleet import (
+    FleetAutoscaler,
+    FleetController,
+    FleetError,
+)
+from keystone_tpu.serving.loadgen import LoadSpec, generate_trace
+from keystone_tpu.serving.plane import ModelNotAdmitted
+from keystone_tpu.serving.router import FleetRouter, _rendezvous_score
+
+D, K = 6, 2
+
+
+class FakeReplica:
+    """A duck-typed replica client: the same surface Local/Http
+    clients implement, with dial-a-behavior knobs for depth, refusal,
+    and death."""
+
+    def __init__(self, replica_id, depth=0):
+        self.replica_id = replica_id
+        self.depth = depth
+        self.hosted = {}          # name -> sha256 of the admitted blob
+        self.dead = False
+        self.refuse = None        # exception submit_request raises
+        self.served = []
+
+    def _check_alive(self):
+        if self.dead:
+            raise ConnectionError(f"replica {self.replica_id} is down")
+
+    def models(self):
+        self._check_alive()
+        return tuple(sorted(self.hosted))
+
+    def model_shas(self):
+        self._check_alive()
+        return dict(self.hosted)
+
+    def queue_depth(self):
+        self._check_alive()
+        return self.depth
+
+    def submit_request(self, name, x, timeout_s=None, deadline_ms=None):
+        self._check_alive()
+        if self.refuse is not None:
+            raise self.refuse
+        self.served.append(name)
+        return (self.replica_id, name)
+
+    def predict_raw(self, name, raw):
+        self._check_alive()
+        if self.refuse is not None:
+            return 429, b'{"error": "full"}\n', None
+        self.served.append(name)
+        return 200, b'{"predictions": []}\n', None
+
+    def admit_blob(self, name, blob, sample, weight_dtype):
+        self._check_alive()
+        sha = hashlib.sha256(blob).hexdigest()
+        self.hosted[name] = sha
+        return sha
+
+    def evict(self, name):
+        self._check_alive()
+        self.hosted.pop(name, None)
+
+    def probe(self):
+        return "dead" if self.dead else "ready"
+
+
+_FITTED = {}
+
+
+def _fitted(seed=0):
+    if seed not in _FITTED:
+        r = np.random.RandomState(seed)
+        X = r.rand(64, D).astype(np.float32)
+        Y = r.rand(64, K).astype(np.float32)
+        _FITTED[seed] = LinearMapEstimator(lam=1e-3).with_data(
+            ArrayDataset.from_numpy(X),
+            ArrayDataset.from_numpy(Y)).fit()
+    return _FITTED[seed]
+
+
+def _sample():
+    return jax.ShapeDtypeStruct((D,), np.float32)
+
+
+def _fleet(n=3, names=("m",), depth=0):
+    replicas = [FakeReplica(f"r{i}", depth=depth) for i in range(n)]
+    for rep in replicas:
+        for name in names:
+            rep.hosted[name] = "sha-" + name
+    router = FleetRouter(replicas, spill_queue_depth=8)
+    return replicas, router
+
+
+# -- rendezvous routing -----------------------------------------------------
+
+def test_rendezvous_score_is_stable_and_salted_by_pair():
+    assert _rendezvous_score("m", "r0") == _rendezvous_score("m", "r0")
+    assert _rendezvous_score("m", "r0") != _rendezvous_score("m", "r1")
+    assert _rendezvous_score("m", "r0") != _rendezvous_score("n", "r0")
+
+
+def test_primary_is_deterministic_and_stable_under_membership():
+    replicas, router = _fleet(n=4)
+    _, primary = router._route("m")
+    assert router._route("m")[1] is primary
+    # removing a NON-primary replica must not re-route the model
+    bystander = next(r for r in replicas if r is not primary)
+    router.remove_replica(bystander.replica_id)
+    assert router._route("m")[1] is primary
+    # removing the primary re-routes to the next-highest score —
+    # deterministically
+    router.remove_replica(primary.replica_id)
+    survivors = [r for r in replicas
+                 if r not in (primary, bystander)]
+    expected = max(survivors, key=lambda r: _rendezvous_score(
+        "m", r.replica_id))
+    assert router._route("m")[1] is expected
+
+
+def test_unknown_model_refuses_honestly():
+    _, router = _fleet()
+    with pytest.raises(ModelNotAdmitted):
+        router.submit_request("ghost", None)
+    status, _, _ = router.predict_raw("ghost", b"{}")
+    assert status == 404
+
+
+# -- spill ------------------------------------------------------------------
+
+def test_congested_primary_spills_to_shallow_sibling():
+    replicas, router = _fleet(n=2)
+    _, primary = router._route("m")
+    sibling = next(r for r in replicas if r is not primary)
+    primary.depth = 100          # >= spill_queue_depth, sibling at 0
+    reg = MetricsRegistry.get_or_create()
+    spills0 = reg.counter("router.spill_total").value
+    rid, _ = router.submit_request("m", None)
+    assert rid == sibling.replica_id
+    assert reg.counter("router.spill_total").value == spills0 + 1
+    assert reg.counter("router.spill_total.m").value >= 1
+
+
+def test_refusing_primary_spills_and_counts():
+    replicas, router = _fleet(n=2)
+    _, primary = router._route("m")
+    sibling = next(r for r in replicas if r is not primary)
+    primary.refuse = QueueFullError("full", retry_after_s=0.5)
+    rid, _ = router.submit_request("m", None)
+    assert rid == sibling.replica_id
+
+
+def test_dead_primary_routes_around_without_crashing():
+    replicas, router = _fleet(n=2)
+    _, primary = router._route("m")
+    sibling = next(r for r in replicas if r is not primary)
+    primary.dead = True          # stats probe AND submit now raise
+    rid, _ = router.submit_request("m", None)
+    assert rid == sibling.replica_id
+
+
+def test_all_refusing_surfaces_last_classified_verdict():
+    replicas, router = _fleet(n=2)
+    for rep in replicas:
+        rep.refuse = QueueFullError("full", retry_after_s=0.5)
+    reg = MetricsRegistry.get_or_create()
+    unavail0 = reg.counter("router.unavailable_total").value
+    with pytest.raises(QueueFullError):
+        router.submit_request("m", None)
+    assert reg.counter("router.unavailable_total").value == unavail0 + 1
+    # over HTTP the same outcome must carry Retry-After — a 429/503
+    # without WHEN is an unclassified shrug
+    status, _, headers = router.predict_raw("m", b"{}")
+    assert status in (429, 503)
+    assert "Retry-After" in (headers or {})
+
+
+def test_all_dead_refuses_with_retry_after():
+    replicas, router = _fleet(n=2)
+    for rep in replicas:
+        rep.dead = True
+    with pytest.raises(QueueFullError):
+        router.submit_request("m", None)
+    status, _, headers = router.predict_raw("m", b"{}")
+    assert status == 503
+    assert "Retry-After" in (headers or {})
+
+
+def test_refresh_rebuilds_from_what_replicas_host_now():
+    replicas, router = _fleet(n=2, names=("a", "b"))
+    replicas[0].hosted.pop("a")
+    replicas[1].dead = True
+    router.refresh()
+    table = router.state()["models"]
+    assert table.get("b") == ["r0"]
+    assert "a" not in table      # r0 dropped it, r1 is dead
+    replicas[1].dead = False
+    router.refresh()
+    assert set(router.state()["models"]["a"]) == {"r1"}
+
+
+# -- the fleet controller ---------------------------------------------------
+
+def _controller(n=2, budget_mults=3.3):
+    replicas = [FakeReplica(f"r{i}") for i in range(n)]
+    router = FleetRouter(replicas)
+    controller = FleetController(router)
+    return replicas, router, controller
+
+
+def test_register_canonicalizes_and_rejects_duplicates():
+    _, _, controller = _controller()
+    model = controller.register("m", _fitted(), _sample())
+    assert model.sha256 == hashlib.sha256(model.blob).hexdigest()
+    assert model.charge_nbytes > 0
+    with pytest.raises(ValueError):
+        controller.register("m", _fitted(), _sample())
+
+
+def test_rebalance_places_all_models_sha_verified():
+    replicas, router, controller = _controller(n=2)
+    charges = []
+    for i, name in enumerate(("a", "b", "c")):
+        model = controller.register(name, _fitted(i), _sample())
+        charges.append(model.charge_nbytes)
+    for rep in replicas:
+        controller.set_budget(rep.replica_id, 3.3 * max(charges))
+    steps = controller.rebalance()
+    assert steps and all(kind == "admit" for kind, _, _ in steps)
+    table = router.state()["models"]
+    assert set(table) == {"a", "b", "c"}
+    canonical = {m: controller._models[m].sha256 for m in table}
+    for rep in replicas:
+        for name, sha in rep.model_shas().items():
+            assert sha == canonical[name]
+
+
+def test_migration_aborts_on_sha_mismatch_and_evicts_impostor():
+    replicas, _, controller = _controller(n=1)
+    controller.register("m", _fitted(), _sample())
+
+    def bad_admit(name, blob, sample, weight_dtype):
+        replicas[0].hosted[name] = "not-the-canonical-sha"
+        return "not-the-canonical-sha"
+
+    replicas[0].admit_blob = bad_admit
+    with pytest.raises(FleetError, match="bit-identical"):
+        controller.rebalance()
+    # the impostor copy must not be left routable
+    assert "m" not in replicas[0].hosted
+
+
+def test_handle_death_readmits_from_canonical_bytes():
+    replicas, router, controller = _controller(n=3)
+    for i, name in enumerate(("a", "b")):
+        controller.register(name, _fitted(i), _sample())
+    controller.rebalance()
+    reg = MetricsRegistry.get_or_create()
+    deaths0 = reg.counter("fleet.replica_deaths_total").value
+    # kill whoever hosts model "a"
+    victim = controller.placement.assignments["a"][0]
+    corpse = next(r for r in replicas if r.replica_id == victim)
+    corpse.dead = True
+    steps = controller.handle_death(victim)
+    assert reg.counter(
+        "fleet.replica_deaths_total").value == deaths0 + 1
+    assert victim not in router.replica_ids()
+    table = router.state()["models"]
+    assert set(table) == {"a", "b"}
+    assert all(victim not in reps for reps in table.values())
+    # recovery re-admitted (a migration, not a guess): the survivors'
+    # copies carry the canonical shas
+    canonical = {m: controller._models[m].sha256 for m in ("a", "b")}
+    for rep in replicas:
+        if rep is corpse:
+            continue
+        for name, sha in rep.model_shas().items():
+            assert sha == canonical[name]
+    assert any(kind == "admit" for kind, _, _ in steps) or not steps
+
+
+def test_drain_refuses_the_last_replica():
+    _, _, controller = _controller(n=1)
+    controller.register("m", _fitted(), _sample())
+    controller.rebalance()
+    with pytest.raises(FleetError, match="last replica"):
+        controller.drain_replica("r0")
+
+
+def test_drain_migrates_then_retires():
+    replicas, router, controller = _controller(n=2)
+    controller.register("m", _fitted(), _sample())
+    controller.rebalance()
+    controller.drain_replica("r1")
+    assert router.replica_ids() == ("r0",)
+    assert "m" in replicas[0].hosted
+    assert "m" not in replicas[1].hosted
+    assert router.state()["models"]["m"] == ["r0"]
+
+
+def test_note_demand_buys_replication_on_next_rebalance():
+    _, router, controller = _controller(n=2)
+    model = controller.register("m", _fitted(), _sample())
+    controller.register("other", _fitted(1), _sample())
+    for rid in ("r0", "r1"):
+        controller.set_budget(rid, 3.3 * model.charge_nbytes)
+    controller.rebalance()
+    assert len(controller.placement.replicas_for("m")) == 1
+    controller.note_demand("m", qps=5000.0, warmup_s=2.0)
+    controller.rebalance()
+    assert len(controller.placement.replicas_for("m")) == 2
+    assert len(router.state()["models"]["m"]) == 2
+
+
+# -- the autoscaling reactor ------------------------------------------------
+
+def test_reactor_classifies_a_failed_probe_as_death():
+    replicas, router, controller = _controller(n=2)
+    controller.register("m", _fitted(), _sample())
+    controller.rebalance()
+    scaler = FleetAutoscaler(controller, sustain_ticks=10**6)
+    replicas[0].dead = True
+    assert scaler.tick() == "death"
+    assert "r0" not in router.replica_ids()
+
+
+def test_reactor_scales_up_only_on_sustained_congestion():
+    replicas, router, controller = _controller(n=1)
+    controller.register("m", _fitted(), _sample())
+    controller.rebalance()
+    minted = []
+
+    def provision():
+        rep = FakeReplica(f"r{len(replicas) + len(minted)}")
+        minted.append(rep)
+        return rep
+
+    scaler = FleetAutoscaler(controller, provisioner=provision,
+                             scale_up_queue_depth=16,
+                             sustain_ticks=2, max_replicas=4)
+    replicas[0].depth = 100
+    assert scaler.tick() is None          # one hot scrape: no flap
+    assert scaler.tick() == "scale_up"    # sustained: act
+    assert len(router.replica_ids()) == 2
+    # the new replica was rebalanced onto, not joined empty forever
+    assert minted[0].replica_id in router.replica_ids()
+
+
+def test_reactor_scales_down_a_sustained_idle_fleet():
+    replicas, router, controller = _controller(n=2)
+    controller.register("m", _fitted(), _sample())
+    controller.rebalance()
+    scaler = FleetAutoscaler(controller, scale_down_queue_depth=2,
+                             sustain_ticks=2, min_replicas=1)
+    assert scaler.tick() is None
+    assert scaler.tick() == "scale_down"
+    # drains the HIGHEST-numbered replica, models migrated first
+    assert router.replica_ids() == ("r0",)
+    assert "m" in replicas[0].hosted
+
+
+def test_reactor_applies_demand_drift_as_rebalance():
+    _, router, controller = _controller(n=2)
+    model = controller.register("m", _fitted(), _sample())
+    for rid in ("r0", "r1"):
+        controller.set_budget(rid, 3.3 * model.charge_nbytes)
+    controller.rebalance()
+    scaler = FleetAutoscaler(controller, scale_up_queue_depth=10**6,
+                             scale_down_queue_depth=-1,
+                             sustain_ticks=10**6)
+    controller.note_demand("m", qps=5000.0, warmup_s=2.0)
+    assert scaler.tick() == "rebalance"
+    assert len(router.state()["models"]["m"]) == 2
+
+
+# -- the loadgen trace pin --------------------------------------------------
+
+def test_trace_sha_pinned():
+    """The WHOLE trace, pinned by sha256 of a canonical serialization
+    (floats via repr — Python's shortest round-trip form). The chaos
+    floors and the fleet gate's recorded behavior are only meaningful
+    against this exact traffic; an RNG draw-order change must fail
+    here by value."""
+    spec = LoadSpec(seed=31, duration_s=3.0, rate_rps=90.0,
+                    arrival="poisson",
+                    models=("alpha", "beta", "gamma"),
+                    zipf_s=1.2, sizes=(1, 2, 4))
+    trace = generate_trace(spec)
+    canon = json.dumps(
+        [[repr(ev.t_s), ev.model, ev.n, ev.seq]
+         for ev in trace.arrivals],
+        separators=(",", ":")).encode()
+    assert len(trace.arrivals) > 200
+    assert hashlib.sha256(canon).hexdigest() == (
+        "5d3894809a7c3fb96666558c4f4829061e5125a79cd76a4e0cbdfbe7bc02c59e")

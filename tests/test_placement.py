@@ -1,0 +1,172 @@
+"""Property-style suite for the fleet placement solver
+(``serving/placement.py``): budget safety, QPS-monotone replication,
+determinism, N=1 degradation, and loud refusal — the contract the
+fleet controller and ``check --budget --replicas N`` both lean on."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from keystone_tpu.serving.placement import (ModelDemand, Placement,
+                                            PlacementError,
+                                            plan_placement)
+
+MiB = 1 << 20
+
+
+def _demands_from_rng(rng: np.random.RandomState, n_models: int):
+    """A seeded demand set: charges 1-64 MiB, half the models hot."""
+    out = []
+    for i in range(n_models):
+        hot = rng.rand() < 0.5
+        out.append(ModelDemand(
+            name=f"m{i:02d}",
+            charge_nbytes=float(rng.randint(1, 65)) * MiB,
+            qps=float(rng.randint(10, 2000)) if hot else 0.0,
+            warmup_s=float(rng.rand() * 3.0) if hot else 0.0))
+    return out
+
+
+@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("n_replicas", [1, 2, 3, 5])
+def test_never_exceeds_any_replica_budget(seed, n_replicas):
+    rng = np.random.RandomState(2000 + seed)
+    demands = _demands_from_rng(rng, n_models=10)
+    budgets = {f"r{i}": float(rng.randint(128, 512)) * MiB
+               for i in range(n_replicas)}
+    try:
+        placement = plan_placement(demands, budgets)
+    except PlacementError:
+        return  # refusal is the other legal outcome, tested below
+    by_name = {d.name: d for d in demands}
+    for replica, budget in budgets.items():
+        charged = sum(by_name[m].charge_nbytes
+                      for m in placement.models_on(replica))
+        assert charged <= budget + 1e-6, (
+            f"{replica} charged {charged / MiB:.1f} MiB over its "
+            f"{budget / MiB:.1f} MiB budget (seed {seed})")
+        assert placement.loads[replica] == pytest.approx(charged)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_every_model_is_placed_exactly_or_refused(seed):
+    rng = np.random.RandomState(3000 + seed)
+    demands = _demands_from_rng(rng, n_models=8)
+    budgets = {f"r{i}": float(rng.randint(96, 384)) * MiB
+               for i in range(3)}
+    try:
+        placement = plan_placement(demands, budgets)
+    except PlacementError as exc:
+        assert exc.model is not None  # the refusal names the model
+        assert any(d.name == exc.model for d in demands)
+        return
+    for d in demands:
+        reps = placement.replicas_for(d.name)
+        assert len(reps) >= 1, f"{d.name} silently dropped"
+        assert len(set(reps)) == len(reps), "duplicate copies"
+
+
+def test_hot_model_replication_monotone_in_qps():
+    """Raising ONE model's QPS (everything else fixed) never loses it
+    copies — the replication value is monotone in observed demand."""
+    budgets = {f"r{i}": 256.0 * MiB for i in range(3)}
+    fixed = [
+        ModelDemand("anchor", 64.0 * MiB, qps=100.0, warmup_s=1.0),
+        ModelDemand("cold", 32.0 * MiB, qps=0.0),
+    ]
+    copies_at = []
+    for qps in (0.0, 50.0, 200.0, 1000.0, 5000.0):
+        hot = ModelDemand("hot", 48.0 * MiB, qps=qps, warmup_s=2.0)
+        placement = plan_placement(fixed + [hot], budgets)
+        copies_at.append(len(placement.replicas_for("hot")))
+    assert copies_at == sorted(copies_at), (
+        f"replication not monotone in QPS: {copies_at}")
+    assert copies_at[0] == 1, "a cold model must stay single-homed"
+    assert copies_at[-1] > 1, (
+        "a hot model with fleet-wide spare capacity must replicate")
+
+
+def test_cold_models_never_replicate():
+    budgets = {"r0": 512.0 * MiB, "r1": 512.0 * MiB}
+    demands = [ModelDemand(f"m{i}", 8.0 * MiB, qps=0.0)
+               for i in range(4)]
+    placement = plan_placement(demands, budgets)
+    for d in demands:
+        assert len(placement.replicas_for(d.name)) == 1, (
+            "replication must be bought with observed demand, "
+            "never speculation")
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_deterministic_under_fixed_inputs(seed):
+    rng = np.random.RandomState(4000 + seed)
+    demands = _demands_from_rng(rng, n_models=9)
+    budgets = {f"r{i}": float(rng.randint(128, 512)) * MiB
+               for i in range(3)}
+    first = plan_placement(list(demands), budgets)
+    for _ in range(3):
+        again = plan_placement(list(reversed(demands)), dict(budgets))
+        assert again.assignments == first.assignments
+        assert again.loads == first.loads
+
+
+def test_degrades_to_single_replica_at_n1():
+    """N=1 is exactly the single-plane admission story: every model on
+    the one replica, no replication, same budget arithmetic."""
+    budget = 256.0 * MiB
+    demands = [
+        ModelDemand("a", 64.0 * MiB, qps=900.0, warmup_s=2.0),
+        ModelDemand("b", 32.0 * MiB, qps=10.0, warmup_s=0.5),
+        ModelDemand("c", 16.0 * MiB),
+    ]
+    placement = plan_placement(demands, {"r0": budget})
+    assert placement.assignments == {
+        "a": ("r0",), "b": ("r0",), "c": ("r0",)}
+    assert placement.loads["r0"] == pytest.approx(112.0 * MiB)
+
+
+def test_refusal_names_the_model():
+    demands = [ModelDemand("tiny", 4.0 * MiB),
+               ModelDemand("whale", 900.0 * MiB, qps=50.0)]
+    with pytest.raises(PlacementError) as err:
+        plan_placement(demands, {"r0": 128.0 * MiB, "r1": 128.0 * MiB})
+    assert err.value.model == "whale"
+    assert "whale" in str(err.value)
+
+
+def test_unbounded_budget_places_everything_without_replication():
+    demands = [ModelDemand("a", 512.0 * MiB, qps=1e4, warmup_s=5.0),
+               ModelDemand("b", 512.0 * MiB)]
+    placement = plan_placement(demands, {"r0": None, "r1": None})
+    for d in demands:
+        assert len(placement.replicas_for(d.name)) == 1
+
+
+def test_duplicate_names_refused():
+    demands = [ModelDemand("a", MiB), ModelDemand("a", MiB)]
+    with pytest.raises(ValueError):
+        plan_placement(demands, {"r0": None})
+
+
+def test_no_replicas_refused():
+    with pytest.raises(ValueError):
+        plan_placement([ModelDemand("a", MiB)], {})
+
+
+def test_diff_admits_before_evicting():
+    """The migration contract: capacity is briefly double-charged,
+    never zero-charged — every admit step precedes every evict step."""
+    have = Placement(assignments={"m": ("r0",)}, loads={"r0": 1.0})
+    want = Placement(assignments={"m": ("r1",)}, loads={"r1": 1.0})
+    steps = have.diff(want)
+    assert steps == [("admit", "m", "r1"), ("evict", "m", "r0")]
+    kinds = [k for k, _, _ in steps]
+    assert kinds.index("evict") > kinds.index("admit")
+
+
+def test_diff_identity_is_empty():
+    rng = np.random.RandomState(7)
+    demands = _demands_from_rng(rng, 6)
+    budgets = {f"r{i}": 512.0 * MiB for i in range(2)}
+    placement = plan_placement(demands, budgets)
+    assert placement.diff(placement) == []

@@ -409,9 +409,10 @@ def test_catalogue_registers_required_scenarios():
     from keystone_tpu.serving.scenarios import SCENARIOS, load_catalogue
 
     load_catalogue()
-    assert len(SCENARIOS) >= 6
+    assert len(SCENARIOS) >= 8
     assert {"burst", "diurnal", "zipf_churn", "straggler_dispatch",
-            "poisoned_batch", "overload_shed"} <= set(SCENARIOS)
+            "poisoned_batch", "overload_shed",
+            "replica_death", "migration_under_load"} <= set(SCENARIOS)
     for sc in SCENARIOS.values():
         assert sc.floors.p99_ms > 0
         assert 0 < sc.floors.availability <= 1.0
